@@ -1,0 +1,14 @@
+"""Test environment: force an 8-device virtual CPU mesh BEFORE jax import.
+
+This is the TPU-world answer to "fake backend" testing (SURVEY §4): all
+multi-device sharding/collective tests run on 8 virtual CPU devices, so the
+suite needs no TPU hardware (and never touches the real chip during tests).
+"""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

@@ -1,0 +1,168 @@
+"""Config-system tests.
+
+The first five tests port the reference's spec one-for-one
+(ref test/test_config.py:35-60); the rest cover behavior the reference
+left untested: safe sweeps, scalar→list coercion, optimizer/scheduler
+factories, unknown-name errors.
+"""
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass
+from pathlib import Path
+
+import pytest
+
+from torchbooster_tpu.config import (
+    BaseConfig,
+    DatasetConfig,
+    EnvConfig,
+    EnvironementConfig,
+    LoaderConfig,
+    OptimizerConfig,
+    SchedulerConfig,
+    parse_sweep,
+    read_lines,
+)
+
+CONFIGS = Path(__file__).parent / "configs"
+
+
+@dataclass
+class ChildConfig(BaseConfig):
+    x: int = 0
+    names: list(str) = None
+
+
+@dataclass
+class NestedConfig(BaseConfig):
+    scale: float = 1.0
+    child: ChildConfig = None
+
+
+@dataclass
+class FullConfig(BaseConfig):
+    epochs: int = 1
+    batch_size: int = 0
+    seed: int = 0
+    env: EnvConfig = None
+    loader: LoaderConfig = None
+    optim: OptimizerConfig = None
+    scheduler: SchedulerConfig = None
+    dataset: DatasetConfig = None
+
+
+@dataclass
+class SweepConfig(BaseConfig):
+    lr: float = 0.0
+    batch_size: int = 0
+    name: str = ""
+
+
+@dataclass
+class ScalarListConfig(BaseConfig):
+    layers: list(int) = None
+    weights: tuple(float,) = None
+
+
+# ---- reference-ported spec (ref test/test_config.py:35-60) ----------------
+
+def test_config_nested():
+    conf = NestedConfig.load(CONFIGS / "nested.yml")
+    assert conf.scale == 2.5
+    assert isinstance(conf.child, ChildConfig)
+    assert conf.child.x == 3
+    assert conf.child.names == ["alpha", "beta"]
+
+
+def test_circular_import():
+    with pytest.raises(RecursionError):
+        read_lines(CONFIGS / "circular" / "base.yml")
+
+
+def test_config_include():
+    conf = FullConfig.load(CONFIGS / "includes" / "base.yaml")
+    # innermost include provides seed; outer files override epochs/batch
+    assert conf.seed == 7
+    assert conf.batch_size == 64
+    assert conf.epochs == 10
+
+
+def test_config_extra_parameters(caplog):
+    with caplog.at_level(logging.WARNING):
+        conf = NestedConfig.load(CONFIGS / "extra.yml")
+    assert conf.scale == 1.5
+    assert any("not_a_real_key" in message for message in caplog.messages)
+
+
+def test_config_full_parameters():
+    conf = FullConfig.load(CONFIGS / "full.yml")
+    assert conf.batch_size == 1_024          # yaml underscore int parse
+    assert conf.env.distributed is True
+    assert conf.env.precision == "bf16"
+    assert conf.loader.batch_size == 1_024
+    assert conf.optim.name == "adamw"
+    assert conf.optim.lr == 1e-3             # "1e-3" str → float coercion
+    assert conf.optim.betas == (0.9, 0.999)  # comma-string → tuple(float)
+    assert conf.scheduler.decay == ("lin", "cos")
+    assert conf.scheduler.n_iter == 10_000
+    assert conf.dataset.name == "mnist"
+
+
+# ---- beyond-reference coverage -------------------------------------------
+
+def test_scalar_to_list_coercion():
+    # ref crashes on scalar-for-list (SURVEY §2.14, offline.yml layers: 29)
+    conf = ScalarListConfig.load(CONFIGS / "scalar_list.yml")
+    assert conf.layers == [29]
+    assert conf.weights == (0.5,)
+
+
+def test_parse_sweep_grammar():
+    assert parse_sweep("linspace(0.0, 1.0, 3)") == [0.0, 0.5, 1.0]
+    assert parse_sweep("range(1, 4)") == [1, 2, 3]
+    assert parse_sweep("[1, 2, 3]") == [1, 2, 3]
+    assert parse_sweep("arange(1e-4, 2.5e-4, 1e-4)") == pytest.approx([1e-4, 2e-4])
+    assert parse_sweep("not a sweep") is None
+    assert parse_sweep("__import__('os')") is None       # no eval, ever
+    assert parse_sweep("arange(__import__,)") is None
+
+
+def test_hyperparameter_sweep():
+    configs = list(SweepConfig.load(CONFIGS / "sweep.yml", hyperparams=True))
+    assert len(configs) == 3 * 2
+    lrs = sorted({c.lr for c in configs})
+    assert lrs == pytest.approx([1e-4, 2e-4, 3e-4])
+    assert sorted({c.batch_size for c in configs}) == [32, 64]
+    assert all(c.name == "fixed" for c in configs)
+    assert all(isinstance(c.lr, float) for c in configs)
+
+
+def test_optimizer_factory_and_unknown_name():
+    import optax
+
+    optim = OptimizerConfig(name="adamw", lr=1e-3, weight_decay=1e-2)
+    tx = optim.make()
+    assert isinstance(tx, optax.GradientTransformation)
+    with pytest.raises(NameError):
+        OptimizerConfig(name="nope").make()
+    with pytest.raises(NameError):
+        SchedulerConfig(name="nope").make(optim)
+
+
+def test_environement_alias():
+    assert EnvironementConfig is EnvConfig
+
+
+def test_optimizer_sgd_runs():
+    import jax.numpy as jnp
+    import optax
+
+    tx = OptimizerConfig(name="sgd", lr=0.1, momentum=0.9,
+                         weight_decay=1e-4).make()
+    params = {"w": jnp.ones((3,))}
+    state = tx.init(params)
+    grads = {"w": jnp.ones((3,))}
+    updates, _ = tx.update(grads, state, params)
+    new_params = optax.apply_updates(params, updates)
+    assert float(new_params["w"][0]) < 1.0

@@ -1,0 +1,76 @@
+"""Scheduler math tests — coverage the reference never had (SURVEY §4:
+scheduler math untested there, with two latent bugs; both fixed here)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from torchbooster_tpu.config import OptimizerConfig, SchedulerConfig
+from torchbooster_tpu.scheduler import BaseScheduler, CycleScheduler
+
+
+def test_cycle_phases():
+    sched = CycleScheduler(lr=1.0, n_iter=100, initial_multiplier=0.1,
+                           final_multiplier=0.01, warmup=10, plateau=10,
+                           decay=("lin", "cos"))
+    assert float(sched(0)) == pytest.approx(0.1)          # warmup start
+    assert float(sched(10)) == pytest.approx(1.0)         # warmup end
+    assert float(sched(15)) == pytest.approx(1.0)         # plateau (ref bug: KeyError)
+    assert float(sched(20)) == pytest.approx(1.0)         # anneal start
+    assert float(sched(100)) == pytest.approx(0.01, rel=1e-3)  # anneal end
+    assert float(sched(1000)) == pytest.approx(0.01, rel=1e-3)  # clamped past end
+
+
+def test_cycle_monotone_cos_anneal():
+    sched = CycleScheduler(lr=1e-3, n_iter=50, warmup=0, plateau=0,
+                           decay=("cos", "cos"))
+    values = [float(sched(s)) for s in range(51)]
+    assert values[0] == pytest.approx(1e-3)
+    assert all(a >= b - 1e-12 for a, b in zip(values, values[1:]))
+
+
+def test_cycle_exp_decay():
+    sched = CycleScheduler(lr=1.0, n_iter=10, final_multiplier=1e-2,
+                           warmup=0, plateau=0, decay=("exp", "exp"))
+    assert float(sched(5)) == pytest.approx(0.1, rel=1e-3)
+
+
+def test_schedule_is_jittable():
+    sched = CycleScheduler(lr=1.0, n_iter=100, warmup=10, decay=("lin", "cos"))
+    jitted = jax.jit(lambda s: sched(s))
+    assert float(jitted(jnp.asarray(10))) == pytest.approx(float(sched(10)))
+
+
+def test_stateful_adapter_roundtrip():
+    sched = BaseScheduler(CycleScheduler(lr=1.0, n_iter=10, warmup=0,
+                                         decay=("lin", "lin"),
+                                         final_multiplier=0.0))
+    for _ in range(5):
+        lr = sched.step()
+    assert lr == pytest.approx(0.5)
+    state = sched.state_dict()
+    other = BaseScheduler(sched.schedule)
+    other.load_state_dict(state)
+    assert other.step_count == 5
+    assert other.lr == pytest.approx(0.5)
+
+
+def test_scheduler_config_make_drives_optax():
+    import optax
+
+    optim_conf = OptimizerConfig(name="sgd", lr=1.0)
+    sched_conf = SchedulerConfig(name="cycle", n_iter=10, warmup=0,
+                                 decay=("lin", "lin"), final_multiplier=0.0)
+    schedule = sched_conf.make(optim_conf)
+    tx = optim_conf.make(schedule=schedule)
+    params = {"w": jnp.zeros(())}
+    state = tx.init(params)
+    # lr at step 0 is 1.0 → update = -1.0 * grad
+    updates, state = tx.update({"w": jnp.ones(())}, state, params)
+    assert float(updates["w"]) == pytest.approx(-1.0)
+    params = optax.apply_updates(params, updates)
+    # after 5 steps the linear schedule has halved the lr
+    for _ in range(4):
+        updates, state = tx.update({"w": jnp.ones(())}, state, params)
+    assert float(updates["w"]) == pytest.approx(-0.6, rel=1e-6)

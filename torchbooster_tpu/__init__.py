@@ -1,0 +1,28 @@
+"""TorchBooster-TPU: a TPU-native training bootstrap framework.
+
+A ground-up JAX/XLA/pallas re-design with the capability contract of the
+reference TorchBooster library (see /root/reference): YAML config in,
+reproducible training loop out, with one-switch distribution — except the
+device story is a `jax.sharding.Mesh` instead of CUDA+NCCL, and the train
+step is a single compiled function instead of eager autograd.
+
+Parity notes (reference file:line cited per module):
+- logging bootstrap at import mirrors reference torchbooster/__init__.py:1-9
+  (coloredlogs optional there; plain logging here).
+"""
+from __future__ import annotations
+
+import logging
+
+try:  # pragma: no cover - cosmetic only
+    import coloredlogs  # type: ignore
+
+    coloredlogs.install(level=logging.INFO)
+except ImportError:  # pragma: no cover
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(name)s[%(process)d] %(levelname)s %(message)s",
+        datefmt="%Y-%m-%d %H:%M:%S",
+    )
+
+__version__ = "0.1.0"

@@ -1,0 +1,133 @@
+"""Learning-rate schedules as pure functions of the step count.
+
+Capability parity with reference ``torchbooster/scheduler.py`` (178 LoC):
+the same warmup → plateau → anneal cycle with lin/cos/exp/flat segments
+(ref scheduler.py:15-36, 103-172) — but stateless. A schedule here is a
+jit-traceable ``step -> lr`` function, which optax consumes directly and
+which lives *inside* the compiled train step (the reference instead
+mutates ``optimizer.param_groups[*]["lr"]`` on the host each step,
+ref scheduler.py:162-163).
+
+Two reference bugs fixed by construction:
+- plateau phase registered as ``"linear"`` against a table keyed ``"lin"``
+  → KeyError on any plateau>0 schedule (ref scheduler.py:115-118 vs
+  :31-36). Here plateau is a flat segment.
+- each phase ran ``n_iter + 1`` steps (off-by-one at ref
+  scheduler.py:168-170). Here phase boundaries are exact.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+import jax.numpy as jnp
+
+
+def lin(lr_from: Any, lr_to: Any, t: Any) -> Any:
+    """Linear interpolation (ref scheduler.py:15-16)."""
+    return lr_from + (lr_to - lr_from) * t
+
+
+def cos(lr_from: Any, lr_to: Any, t: Any) -> Any:
+    """Half-cosine anneal (ref scheduler.py:19-20)."""
+    return lr_to + 0.5 * (lr_from - lr_to) * (1.0 + jnp.cos(jnp.pi * t))
+
+
+def exp(lr_from: Any, lr_to: Any, t: Any) -> Any:
+    """Exponential (geometric) anneal (ref scheduler.py:23-24)."""
+    return lr_from * (lr_to / lr_from) ** t
+
+
+def flat(lr_from: Any, lr_to: Any, t: Any) -> Any:
+    """Constant segment (ref scheduler.py:27-28)."""
+    return lr_from + 0.0 * t
+
+
+PHASE_2_FUN: dict[str, Callable] = {
+    "lin": lin,
+    "linear": lin,   # accept both spellings (the ref bug was this mismatch)
+    "cos": cos,
+    "cosine": cos,
+    "exp": exp,
+    "flat": flat,
+}
+
+
+@dataclass(frozen=True)
+class CycleScheduler:
+    """Warmup → plateau → anneal cycle as a pure ``step -> lr`` fn
+    (ref scheduler.py:70-172; ctor signature parity at :103-124).
+
+    Phases (ref :115-118):
+      1. ``decay[0]`` segment from ``lr * initial_multiplier`` to ``lr``
+         over ``warmup`` steps,
+      2. flat ``lr`` for ``plateau`` steps,
+      3. ``decay[1]`` segment from ``lr`` to ``lr * final_multiplier``
+         over the remaining ``n_iter - warmup - plateau`` steps.
+
+    Callable with either a traced ``jnp`` step (inside jit — the normal
+    path, fed to ``optax.inject_hyperparams``) or a python int.
+    """
+
+    lr: float
+    n_iter: int
+    initial_multiplier: float = 4e-2
+    final_multiplier: float = 1e-5
+    warmup: int = 0
+    plateau: int = 0
+    decay: tuple = ("cos", "cos")
+
+    def __post_init__(self) -> None:
+        for segment in self.decay:
+            if segment not in PHASE_2_FUN:
+                raise NameError(
+                    f"unknown decay segment {segment!r}; "
+                    f"expected one of {sorted(PHASE_2_FUN)}")
+
+    def __call__(self, step: Any) -> Any:
+        step = jnp.asarray(step, dtype=jnp.float32)
+        warmup_fn = PHASE_2_FUN[self.decay[0]]
+        anneal_fn = PHASE_2_FUN[self.decay[1] if len(self.decay) > 1 else self.decay[0]]
+
+        w, p = self.warmup, self.plateau
+        n_anneal = max(self.n_iter - w - p, 1)
+        t_warm = jnp.clip(step / max(w, 1), 0.0, 1.0)
+        t_anneal = jnp.clip((step - w - p) / n_anneal, 0.0, 1.0)
+
+        lr_warm = warmup_fn(self.lr * self.initial_multiplier, self.lr, t_warm)
+        lr_anneal = anneal_fn(self.lr, self.lr * self.final_multiplier, t_anneal)
+
+        out = jnp.where(step < w, lr_warm,
+                        jnp.where(step < w + p, self.lr, lr_anneal))
+        return out
+
+
+@dataclass
+class BaseScheduler:
+    """Stateful adapter over a pure schedule, for host-driven loops and
+    save/load parity (ref scheduler.py:39-67 BaseScheduler + the
+    state_dict round-trip at :126-140). State is the step count only."""
+
+    schedule: Callable[[Any], Any]
+    step_count: int = 0
+    lr: float = field(init=False, default=0.0)
+
+    def __post_init__(self) -> None:
+        self.lr = float(self.schedule(self.step_count))
+
+    def step(self) -> float:
+        """Advance one step; return the new lr (ref scheduler.py:147-172)."""
+        self.step_count += 1
+        self.lr = float(self.schedule(self.step_count))
+        return self.lr
+
+    def state_dict(self) -> dict:
+        return {"step_count": self.step_count}
+
+    def load_state_dict(self, state: dict) -> None:
+        self.step_count = int(state["step_count"])
+        self.lr = float(self.schedule(self.step_count))
+
+
+__all__ = ["BaseScheduler", "CycleScheduler", "PHASE_2_FUN", "cos", "exp",
+           "flat", "lin"]
