@@ -1,14 +1,24 @@
-"""Test environment: force an 8-device virtual CPU mesh BEFORE jax import.
+"""Test environment: force an 8-device virtual CPU mesh.
 
 This is the TPU-world answer to "fake backend" testing (SURVEY §4): all
-multi-device sharding/collective tests run on 8 virtual CPU devices, so the
-suite needs no TPU hardware (and never touches the real chip during tests).
+multi-device sharding/collective tests run on 8 virtual CPU devices, so
+the suite needs no TPU hardware (and never touches the real chip during
+tests).
+
+NOTE: this environment's sitecustomize imports jax at interpreter start
+(registering the remote TPU platform), so env vars alone are too late —
+``jax.config.update`` is required, and XLA_FLAGS must be set before the
+first backend use (which this file is early enough for).
 """
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")

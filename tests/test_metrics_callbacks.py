@@ -1,0 +1,110 @@
+"""Metrics + checkpoint save/restore tests (the reference tested neither,
+SURVEY §4; restore did not even exist there, SURVEY §5.4)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from torchbooster_tpu.callbacks import BaseCallback, SaveCallback, state_dict
+from torchbooster_tpu.metrics import (Accuracy, MetricsAccumulator,
+                                      RunningAverage, accuracy)
+from torchbooster_tpu.scheduler import BaseScheduler, CycleScheduler
+from torchbooster_tpu.utils import TrainState
+
+
+def test_accuracy_values():
+    logits = jnp.array([[2.0, 1.0], [0.0, 3.0], [5.0, 0.0]])
+    labels = jnp.array([0, 1, 1])
+    assert float(accuracy(logits, labels)) == pytest.approx(2 / 3)
+    assert float(Accuracy(topk=2)(logits, labels)) == pytest.approx(1.0)
+
+
+def test_accuracy_inside_jit():
+    @jax.jit
+    def fn(logits, labels):
+        return accuracy(logits, labels)
+
+    value = fn(jnp.eye(4), jnp.arange(4))
+    assert float(value) == 1.0
+
+
+def test_running_average_lazy():
+    avg = RunningAverage()
+    for v in (jnp.asarray(1.0), jnp.asarray(2.0), jnp.asarray(6.0)):
+        avg.update(v)
+    assert avg.value == pytest.approx(3.0)
+    avg.update(jnp.asarray(5.0), weight=3)
+    assert avg.value == pytest.approx((1 + 2 + 6 + 15) / 6)
+    avg.reset()
+    assert avg.value == 0.0
+
+
+def test_metrics_accumulator():
+    acc = MetricsAccumulator()
+    acc.update({"loss": jnp.asarray(2.0), "acc": jnp.asarray(0.5)})
+    acc.update({"loss": jnp.asarray(4.0), "acc": jnp.asarray(1.0)})
+    out = acc.compute()
+    assert out["loss"] == pytest.approx(3.0)
+    assert out["acc"] == pytest.approx(0.75)
+
+
+def test_base_callback_counts():
+    calls = []
+
+    class Probe(BaseCallback):
+        def update(self, **kw):
+            if self.current % self.every == 0:
+                calls.append(self.current)
+
+    probe = Probe(every=3)
+    for _ in range(10):
+        probe()
+    assert calls == [3, 6, 9]
+
+
+def test_save_restore_roundtrip(tmp_path):
+    tx = optax.adamw(1e-3)
+    params = {"w": jnp.arange(4.0), "b": jnp.zeros((2,))}
+    state = TrainState.create(params, tx, rng=3)
+    sched = BaseScheduler(CycleScheduler(lr=1.0, n_iter=10))
+    sched.step()
+
+    cb = SaveCallback(every=2, n_iter=100, root=tmp_path, prefix="ckpt")
+    # path zero-padding parity (ref callbacks.py:108-112)
+    assert cb.path(7).name == "ckpt_007"
+
+    cb.save(4, state=state, scheduler=sched, epoch=2)
+    assert cb.latest_step() == 4
+
+    template = {"state": TrainState.create(params, tx, rng=0),
+                "scheduler": sched, "epoch": 0}
+    restored = cb.restore(like=template)
+    np.testing.assert_array_equal(
+        np.asarray(restored["state"].params["w"]), np.arange(4.0))
+    assert int(restored["state"].step) == 0
+    assert restored["scheduler"]["step_count"] == 1
+    assert int(restored["epoch"]) == 2
+
+
+def test_restore_missing_returns_none(tmp_path):
+    cb = SaveCallback(every=1, n_iter=10, root=tmp_path / "nope")
+    assert cb.restore() is None
+
+
+def test_callback_every_gating(tmp_path):
+    cb = SaveCallback(every=2, n_iter=10, root=tmp_path)
+    params = {"w": jnp.zeros((2,))}
+    assert cb(state=params) is None          # step 1: skip
+    path = cb(state=params)                  # step 2: save (async)
+    assert path is not None
+    cb.wait()
+    assert path.exists()
+
+
+def test_state_dict_extraction():
+    sched = BaseScheduler(CycleScheduler(lr=1.0, n_iter=10))
+    assert state_dict(sched) == {"step_count": 0}
+    assert state_dict(5) == 5

@@ -1,0 +1,141 @@
+"""Callbacks: step-counted hooks + checkpoint save/restore.
+
+Capability parity with reference ``torchbooster/callbacks.py`` (134 LoC)
+plus the restore half the reference lacks (SURVEY §5.4: "Write-only — no
+resume/restore helper exists"). Checkpoints are orbax-backed: async,
+multi-host safe (every process participates; orbax coordinates the
+write), and store whole train-state pytrees — params, optimizer state,
+step, PRNG key — instead of ``.pt`` pickles.
+"""
+from __future__ import annotations
+
+import logging
+from pathlib import Path
+from typing import Any
+
+
+class BaseCallback:
+    """Step-counting callback base (ref BaseCallback callbacks.py:20-39):
+    ``__call__`` increments ``current`` then delegates to ``update``."""
+
+    def __init__(self, every: int, n_iter: int | None = None):
+        self.every = every
+        self.n_iter = n_iter
+        self.current = 0
+
+    def __call__(self, *args: Any, **kwargs: Any) -> Any:
+        self.current += 1
+        return self.update(*args, **kwargs)
+
+    def update(self, *args: Any, **kwargs: Any) -> Any:
+        raise NotImplementedError
+
+
+def state_dict(value: Any) -> Any:
+    """Extract the saveable pytree from a runtime object (ref
+    try_extract_state_dict callbacks.py:42-72 — which had to unwrap DDP
+    and call .state_dict(); functional state already *is* data, so this
+    only needs to handle the stateful host adapters)."""
+    if hasattr(value, "state_dict"):
+        return value.state_dict()
+    return value
+
+
+class SaveCallback(BaseCallback):
+    """Periodic checkpoint writer + restorer (ref SaveCallback
+    callbacks.py:75-129 for the save half).
+
+    ``SaveCallback(every, n_iter, root, prefix)(**kwargs)`` saves
+    ``{key: state_dict(value)}`` every ``every`` steps under
+    ``root/prefix_XXX`` with the step zero-padded to ``len(str(n_iter))``
+    digits (ref path scheme, callbacks.py:108-112).
+
+    The restore half: :meth:`latest_step`, :meth:`restore`.
+    """
+
+    def __init__(self, every: int, n_iter: int, root: str | Path = "checkpoints",
+                 prefix: str = "ckpt"):
+        super().__init__(every, n_iter)
+        self.root = Path(root).absolute()
+        self.prefix = prefix
+        self._checkpointer = None
+
+    @property
+    def checkpointer(self):
+        if self._checkpointer is None:
+            import orbax.checkpoint as ocp
+
+            self._checkpointer = ocp.StandardCheckpointer()
+        return self._checkpointer
+
+    def path(self, step: int) -> Path:
+        """ref callbacks.py:108-112 (zero-padded step suffix)."""
+        width = len(str(self.n_iter))
+        return self.root / f"{self.prefix}_{step:0{width}d}"
+
+    def update(self, **kwargs: Any) -> Path | None:
+        if self.current % self.every:
+            return None
+        return self.save(self.current, **kwargs)
+
+    def save(self, step: int, **kwargs: Any) -> Path:
+        """Save ``{key: state_dict(value)}`` for this step. Values may be
+        TrainState pytrees, host scheduler adapters, or raw
+        (numpy-able) values (ref callbacks.py:114-129).
+
+        The write is async: only the device→host pull blocks the loop;
+        serialization and disk IO continue in the background. The wait
+        for the *previous* save happens at the start of the next one
+        (and in :meth:`wait` / :meth:`restore` / :meth:`latest_step`)."""
+        target = {key: state_dict(value) for key, value in kwargs.items()}
+        path = self.path(step)
+        self.root.mkdir(parents=True, exist_ok=True)
+        self.checkpointer.wait_until_finished()
+        self.checkpointer.save(path, target, force=True)
+        logging.info("saving checkpoint %s (async)", path)
+        return path
+
+    def wait(self) -> None:
+        """Block until any in-flight async save has committed. Call once
+        at the end of training (or rely on restore/latest_step, which
+        wait implicitly)."""
+        if self._checkpointer is not None:
+            self._checkpointer.wait_until_finished()
+
+    def latest_step(self) -> int | None:
+        """Newest checkpoint step on disk, or None."""
+        self.wait()
+        if not self.root.exists():
+            return None
+        steps = []
+        for entry in self.root.iterdir():
+            name = entry.name
+            if name.startswith(f"{self.prefix}_"):
+                suffix = name[len(self.prefix) + 1:]
+                if suffix.isdigit():
+                    steps.append(int(suffix))
+        return max(steps) if steps else None
+
+    def restore(self, step: int | None = None, like: dict[str, Any] | None = None
+                ) -> dict[str, Any] | None:
+        """Restore the checkpoint at ``step`` (default: latest).
+
+        ``like`` is a template ``{key: object}`` matching what was
+        saved; array leaves are restored with the template's sharding —
+        which is what makes resume work unchanged on a different mesh
+        size. Returns None when no checkpoint exists (so user code can
+        write ``state = cb.restore(like=...) or fresh_state``).
+        """
+        if step is None:
+            step = self.latest_step()
+            if step is None:
+                return None
+        else:
+            self.wait()
+        template = None
+        if like is not None:
+            template = {k: state_dict(v) for k, v in like.items()}
+        return self.checkpointer.restore(self.path(step), template)
+
+
+__all__ = ["BaseCallback", "SaveCallback", "state_dict"]

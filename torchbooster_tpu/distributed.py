@@ -1,0 +1,292 @@
+"""Distributed runtime: device mesh + multi-host process helpers.
+
+Capability parity with reference ``torchbooster/distributed.py`` (204 LoC),
+re-designed for the TPU runtime model. The reference manages one process
+per GPU (``mp.spawn`` + NCCL process groups, ref distributed.py:110-205);
+on TPU there is **one process per host driving all local chips**, and
+every collective is an XLA op compiled into the step function — so this
+module's job shrinks to: (a) initialize the multi-host runtime, (b) build
+and cache the device :class:`~jax.sharding.Mesh`, (c) provide the rank /
+primary / barrier / gather helpers user code expects.
+
+Mapping table (ref → here):
+- ``launch`` + ``job`` (mp.spawn + init_process_group, ref :110-205)
+  → :func:`launch` (optional ``jax.distributed.initialize`` + direct call)
+- ``get_rank``/``get_world_size``/``is_primary`` (ref :24-75)
+  → process-level helpers below (uninitialized fallback to rank-0
+  semantics, like ref :26-27)
+- ``synchronize`` barrier (ref :63-68) → :func:`synchronize`
+- ``gather`` to rank 0 (ref :41-56) → :func:`gather` (allgather — every
+  host gets the result; strictly more capable)
+- ``LOCAL_PROCESS_GROUP`` (ref :21,193-203) → :func:`local_devices`
+  (the host's slice of the mesh)
+- ``find_free_port`` (ref :101-107) → kept for coordinator auto-config
+"""
+from __future__ import annotations
+
+import logging
+import socket
+from typing import Any, Callable, Sequence
+
+import jax
+import numpy as np
+from jax.experimental import mesh_utils, multihost_utils
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# Data-bearing mesh axes: batches shard over these; params replicate over
+# dp and shard over fsdp/tp (see parallel.sharding).
+DATA_AXES = ("dp", "fsdp")
+
+_MESH_CACHE: dict[tuple, Mesh] = {}
+
+
+# =========================================================================
+# Process helpers (ref distributed.py:24-75)
+# =========================================================================
+
+def get_rank() -> int:
+    """Global process index (ref get_rank, distributed.py:24-28)."""
+    return jax.process_index()
+
+
+def get_local_rank() -> int:
+    """Index of this process on its machine. One process drives all local
+    TPU chips, so this is always 0 (ref get_local_rank distributed.py:31-38
+    was the GPU index within the machine)."""
+    return 0
+
+
+def get_world_size() -> int:
+    """Number of processes (hosts). NOTE: the reference's world size was
+    the *GPU* count (distributed.py:71-75); the chip-level analogue here
+    is :func:`get_device_count`."""
+    return jax.process_count()
+
+
+def get_device_count() -> int:
+    """Total number of addressable chips across all hosts."""
+    return jax.device_count()
+
+
+def is_primary() -> bool:
+    """True on the coordinator process (ref is_primary distributed.py:58-60)."""
+    return jax.process_index() == 0
+
+
+def synchronize(name: str = "barrier") -> None:
+    """Cross-host barrier (ref synchronize distributed.py:63-68). No-op
+    for a single process, like the reference's uninitialized fallback."""
+    if jax.process_count() > 1:
+        multihost_utils.sync_global_devices(name)
+
+
+def gather(data: Any) -> Any:
+    """All-gather host-local (py)trees across processes (ref gather
+    distributed.py:41-56 gathered to rank 0 only; here every process gets
+    the stacked result, which subsumes the reference behavior)."""
+    if jax.process_count() == 1:
+        return jax.tree.map(lambda x: np.asarray(x)[None, ...], data)
+    return multihost_utils.process_allgather(data)
+
+
+def find_free_port() -> int:
+    """Free TCP port on localhost (ref find_free_port distributed.py:101-107)."""
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as sock:
+        sock.bind(("", 0))
+        return sock.getsockname()[1]
+
+
+# =========================================================================
+# Mesh construction
+# =========================================================================
+
+def parse_mesh_spec(spec: str, n_devices: int) -> tuple[tuple[str, ...], tuple[int, ...]]:
+    """Parse an axis spec string into (names, sizes).
+
+    Grammar: comma-separated ``name`` or ``name:size`` entries, e.g.
+    ``"dp"``, ``"dp:2,tp:4"``, ``"dp,tp:2,sp:2"``. At most one axis may
+    omit its size; it absorbs the remaining devices. This string is the
+    whole user-facing topology surface — the one-switch analogue of the
+    reference's ``n_gpu``/``n_machine`` fields (ref config.py:310-315).
+    """
+    names: list[str] = []
+    sizes: list[int] = []
+    unsized: int | None = None
+    for entry in (e.strip() for e in spec.split(",")):
+        if not entry:
+            continue
+        if ":" in entry:
+            name, _, size_text = entry.partition(":")
+            size = int(size_text)
+            if size <= 0:
+                raise ValueError(
+                    f"mesh spec {spec!r}: axis {name.strip()!r} has "
+                    f"non-positive size {size}")
+            names.append(name.strip())
+            sizes.append(size)
+        else:
+            if unsized is not None:
+                raise ValueError(
+                    f"mesh spec {spec!r} has more than one unsized axis")
+            names.append(entry)
+            sizes.append(-1)
+            unsized = len(sizes) - 1
+    if not names:
+        raise ValueError(f"empty mesh spec {spec!r}")
+    sized_product = int(np.prod([s for s in sizes if s > 0])) if any(
+        s > 0 for s in sizes) else 1
+    if unsized is not None:
+        if n_devices % sized_product:
+            raise ValueError(
+                f"mesh spec {spec!r}: {n_devices} devices not divisible by "
+                f"sized axes product {sized_product}")
+        sizes[unsized] = n_devices // sized_product
+    elif sized_product != n_devices:
+        raise ValueError(
+            f"mesh spec {spec!r} wants {sized_product} devices, "
+            f"have {n_devices}")
+    return tuple(names), tuple(sizes)
+
+
+def make_mesh(
+    spec: str = "dp",
+    n_devices: int = 0,
+    devices: Sequence[jax.Device] | None = None,
+) -> Mesh:
+    """Build a device mesh from an axis spec.
+
+    Uses ``mesh_utils.create_device_mesh`` so axis order maps onto the
+    physical ICI topology (nearest-neighbor axes innermost) — the TPU
+    analogue of NCCL ring construction (ref distributed.py:174-179),
+    except it is a layout decision, not a runtime service.
+    """
+    if devices is None:
+        devices = jax.devices()
+    if n_devices and n_devices > 0:
+        devices = devices[:n_devices]
+    names, sizes = parse_mesh_spec(spec, len(devices))
+    if len(devices) == 1:
+        device_array = np.asarray(devices).reshape(sizes)
+    else:
+        device_array = mesh_utils.create_device_mesh(sizes, devices=devices)
+    return Mesh(device_array, names)
+
+
+def get_mesh(env: Any = None) -> Mesh:
+    """Cached mesh for an :class:`~torchbooster_tpu.config.EnvConfig`
+    (or the default 1-axis ``dp`` mesh when ``env`` is None)."""
+    if env is None:
+        spec, n_devices = "dp", 0
+    else:
+        spec = env.mesh or "dp"
+        n_devices = env.n_devices or (env.n_gpu if env.n_gpu > 0 else 0)
+        if not env.distributed:
+            # one-switch contract: distributed=False degrades any topology
+            # to a single-device dp mesh (ref world_size==1 inline path,
+            # distributed.py:137-139)
+            spec, n_devices = "dp", 1
+    key = (spec, n_devices, jax.device_count())
+    if key not in _MESH_CACHE:
+        _MESH_CACHE[key] = make_mesh(spec, n_devices)
+    return _MESH_CACHE[key]
+
+
+def local_devices(mesh: Mesh) -> list[jax.Device]:
+    """This host's slice of the mesh (the analogue of the reference's
+    per-machine LOCAL_PROCESS_GROUP, ref distributed.py:193-203)."""
+    return [d for d in mesh.devices.flat if d.process_index == jax.process_index()]
+
+
+# =========================================================================
+# Placement (ref to_env, config.py:154-182)
+# =========================================================================
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def batch_sharding(mesh: Mesh, ndim: int = 1) -> NamedSharding:
+    """Leading-axis sharding over the data axes present in the mesh."""
+    present = tuple(a for a in DATA_AXES if a in mesh.axis_names)
+    spec = (present,) + (None,) * (ndim - 1) if present else ()
+    return NamedSharding(mesh, P(*spec))
+
+
+def to_env(obj: Any, mesh: Mesh) -> Any:
+    """Place an array pytree replicated over the mesh — the analogue of
+    DDP's initial parameter broadcast (ref config.py:176-178). Non-array
+    leaves pass through untouched (ref to_env passes unknown types
+    through, config.py:182)."""
+    sharding = replicated(mesh)
+
+    def place(leaf: Any) -> Any:
+        if isinstance(leaf, (jax.Array, np.ndarray, int, float, complex,
+                             np.number)) and not isinstance(leaf, bool):
+            return jax.device_put(leaf, sharding)
+        return leaf
+
+    return jax.tree.map(place, obj)
+
+
+def shard_batch(batch: Any, mesh: Mesh) -> Any:
+    """Shard a host batch along its leading axis over the mesh's data
+    axes — the analogue of per-rank batches + H2D copy (ref
+    config.py:174-175 ``.to("cuda")`` per batch)."""
+
+    def place(leaf: Any) -> Any:
+        arr = np.asarray(leaf) if not isinstance(leaf, jax.Array) else leaf
+        return jax.device_put(arr, batch_sharding(mesh, max(arr.ndim, 1)))
+
+    return jax.tree.map(place, batch)
+
+
+# =========================================================================
+# Launch (ref distributed.py:110-205)
+# =========================================================================
+
+def launch(
+    fn: Callable,
+    n_devices: int = 0,
+    n_machine: int = 1,
+    machine_rank: int = 0,
+    dist_url: str = "auto",
+    args: Sequence[Any] = (),
+) -> Any:
+    """Run ``fn(*args)`` in the distributed runtime.
+
+    Reference semantics (ref distributed.py:110-153): spawn one process
+    per GPU, rendezvous over TCP, then call ``fn``. TPU semantics: the
+    launcher (or the user, one command per host) already started one
+    process per host; multi-host just needs
+    ``jax.distributed.initialize`` before first device use. Single-host
+    calls ``fn`` directly — the analogue of the reference's
+    world_size==1 inline path (ref distributed.py:137-139), and the same
+    user code runs unchanged on 1 chip or a pod.
+    """
+    if n_machine > 1:
+        coordinator = dist_url
+        if coordinator in ("auto", "", None):
+            raise ValueError(
+                "multi-host launch needs an explicit coordinator address "
+                "(dist_url='host:port'); 'auto' only works single-host")
+        jax.distributed.initialize(
+            coordinator_address=coordinator,
+            num_processes=n_machine,
+            process_id=machine_rank,
+        )
+        logging.info(
+            "joined multi-host runtime: process %d/%d, %d devices",
+            jax.process_index(), jax.process_count(), jax.device_count())
+    if n_devices and n_devices > jax.local_device_count() and n_machine <= 1:
+        # ref distributed.py:186-189 raises on GPU over-ask
+        raise ValueError(
+            f"asked for {n_devices} devices, have {jax.local_device_count()}")
+    return fn(*args)
+
+
+__all__ = [
+    "DATA_AXES", "batch_sharding", "find_free_port", "gather",
+    "get_device_count", "get_local_rank", "get_mesh", "get_rank",
+    "get_world_size", "is_primary", "launch", "local_devices", "make_mesh",
+    "parse_mesh_spec", "replicated", "shard_batch", "synchronize", "to_env",
+]

@@ -1,0 +1,104 @@
+"""Metrics: accuracy + running averages, device-resident.
+
+Capability parity with reference ``torchbooster/metrics.py`` (74 LoC).
+The reference pulls ``loss.item()`` to host every step — a per-step
+device→host sync the TPU build must avoid (SURVEY §3.3). Here metrics
+are jnp scalars that stay on device inside the compiled step;
+:class:`RunningAverage` accumulates them lazily and only materializes a
+python float when read (``.value``), so the sync happens at logging
+cadence, not step cadence.
+"""
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def accuracy(logits: Any, labels: Any, topk: int = 1) -> Any:
+    """Batch accuracy from logits (ref accuracy metrics.py:11-27), as a
+    device-side scalar usable inside jit. ``topk>1`` extends the
+    reference (which was top-1 only)."""
+    if topk == 1:
+        predictions = jnp.argmax(logits, axis=-1)
+        return jnp.mean((predictions == labels).astype(jnp.float32))
+    top = jax.lax.top_k(logits, topk)[1]
+    hit = jnp.any(top == labels[..., None], axis=-1)
+    return jnp.mean(hit.astype(jnp.float32))
+
+
+class Accuracy:
+    """Callable-object form (ref Accuracy metrics.py:30-52 was an
+    nn.Module; no module system needed here)."""
+
+    def __init__(self, topk: int = 1):
+        self.topk = topk
+
+    def __call__(self, logits: Any, labels: Any) -> Any:
+        return accuracy(logits, labels, self.topk)
+
+
+class RunningAverage:
+    """Incremental mean (ref RunningAverage metrics.py:55-75) that keeps
+    device scalars device-side: ``update`` stores the array without
+    blocking; ``.value`` materializes the mean (the only host sync).
+
+    ``max_pending`` bounds the un-materialized backlog: draining the
+    oldest entries also bounds how many compiled steps are in flight,
+    which (a) caps memory and (b) avoids starving XLA:CPU's in-process
+    collective rendezvous when a loop never otherwise syncs (observed as
+    an all-reduce deadlock on the virtual-device test backend; a drain
+    of an already-computed scalar costs ~nothing on any backend)."""
+
+    def __init__(self, max_pending: int = 32) -> None:
+        self.max_pending = max_pending
+        self.reset()
+
+    def reset(self) -> None:
+        self._pending: list[Any] = []
+        self._total = 0.0
+        self._count = 0
+
+    def update(self, value: Any, weight: int = 1) -> None:
+        self._pending.append((value, weight))
+        if len(self._pending) >= self.max_pending:
+            self._drain()
+
+    def _drain(self) -> None:
+        for value, weight in self._pending:
+            self._total += float(jax.device_get(value)) * weight
+            self._count += weight
+        self._pending = []
+
+    @property
+    def value(self) -> float:
+        self._drain()
+        return self._total / max(self._count, 1)
+
+    def __float__(self) -> float:
+        return self.value
+
+
+class MetricsAccumulator:
+    """Dict-of-RunningAverages for whole metric pytrees — the natural
+    unit for ``(state, metrics) = train_step(...)`` outputs (beyond the
+    reference, which tracked metrics one .item() at a time)."""
+
+    def __init__(self) -> None:
+        self._averages: dict[str, RunningAverage] = {}
+
+    def update(self, metrics: dict[str, Any], weight: int = 1) -> None:
+        for key, value in metrics.items():
+            self._averages.setdefault(key, RunningAverage()).update(
+                value, weight)
+
+    def compute(self) -> dict[str, float]:
+        return {key: avg.value for key, avg in self._averages.items()}
+
+    def reset(self) -> None:
+        self._averages.clear()
+
+
+__all__ = ["Accuracy", "MetricsAccumulator", "RunningAverage", "accuracy"]

@@ -1,0 +1,21 @@
+"""Parallelism toolkit: mesh axes, sharding rules, sequence parallelism.
+
+The reference's only strategy is data parallelism over NCCL (SURVEY §2.12,
+ref distributed.py + config.py:178). Here parallelism is a *layout*
+property: a mesh with named axes and PartitionSpec rules, with XLA
+inserting the collectives. Axes used throughout the framework:
+
+- ``dp``   — data parallel (batch axis; grad psum)
+- ``fsdp`` — fully-sharded data parallel (batch axis + sharded params)
+- ``tp``   — tensor parallel (weight matrices split; activation collectives)
+- ``sp``   — sequence/context parallel (ring attention, see ring_attention)
+- ``ep``   — expert parallel (MoE expert sharding)
+- ``pp``   — pipeline parallel (stage axis)
+"""
+from torchbooster_tpu.parallel.sharding import (
+    make_param_specs,
+    make_shardings,
+    shard_params,
+)
+
+__all__ = ["make_param_specs", "make_shardings", "shard_params"]

@@ -1,0 +1,113 @@
+"""Rule-based parameter partitioning: path regex → PartitionSpec.
+
+The TPU replacement for the reference's replicate-everything DDP wrap
+(ref config.py:178). A model ships a list of ``(regex, PartitionSpec)``
+rules; parameters whose tree path matches a rule get that spec (first
+match wins), everything else replicates. The same rule table drives
+``jit``'s ``in_shardings`` for the train state, so weight layout is
+declared once and XLA inserts the matching collectives.
+
+Rules are transparent data — unlike flax's metadata-threading
+(``nn.with_partitioning``) this keeps models plain and the layout
+testable in isolation.
+"""
+from __future__ import annotations
+
+import re
+from typing import Any, Sequence
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def path_str(path: tuple) -> str:
+    """Render a jax tree path as ``"a/b/c"`` for regex matching."""
+    parts = []
+    for entry in path:
+        if hasattr(entry, "key"):
+            parts.append(str(entry.key))
+        elif hasattr(entry, "idx"):
+            parts.append(str(entry.idx))
+        elif hasattr(entry, "name"):
+            parts.append(str(entry.name))
+        else:
+            parts.append(str(entry))
+    return "/".join(parts)
+
+
+def _filter_spec(spec: P, mesh_axes: Sequence[str]) -> P:
+    """Drop axis names not present in the mesh — rules can mention tp/sp
+    axes and still work on a plain dp mesh (the one-switch contract)."""
+
+    def keep(entry: Any) -> Any:
+        if entry is None:
+            return None
+        if isinstance(entry, (tuple, list)):
+            kept = tuple(a for a in entry if a in mesh_axes)
+            return kept if kept else None
+        return entry if entry in mesh_axes else None
+
+    return P(*(keep(e) for e in spec))
+
+
+def make_param_specs(
+    params: Any,
+    rules: Sequence[tuple[str, P]],
+    mesh: Mesh | None = None,
+    default: P = P(),
+) -> Any:
+    """Map each leaf of ``params`` to a PartitionSpec via the rule table.
+
+    ``rules`` entries are ``(path_regex, PartitionSpec)``; ``re.search``
+    semantics; first match wins. When ``mesh`` is given, specs are
+    filtered to the axes the mesh actually has. A spec axis that does not
+    divide the corresponding dim falls back to replication for that leaf
+    (XLA would otherwise pad; explicit is safer for correctness)."""
+    compiled = [(re.compile(pattern), spec) for pattern, spec in rules]
+    mesh_axes = tuple(mesh.axis_names) if mesh is not None else None
+
+    def assign(path: tuple, leaf: Any) -> P:
+        name = path_str(path)
+        for pattern, spec in compiled:
+            if pattern.search(name):
+                out = _filter_spec(spec, mesh_axes) if mesh_axes else spec
+                if mesh is not None and hasattr(leaf, "shape"):
+                    out = _validate_divisibility(out, leaf.shape, mesh)
+                return out
+        return _filter_spec(default, mesh_axes) if mesh_axes else default
+
+    return jax.tree_util.tree_map_with_path(assign, params)
+
+
+def _validate_divisibility(spec: P, shape: tuple, mesh: Mesh) -> P:
+    entries = tuple(spec) + (None,) * (len(shape) - len(tuple(spec)))
+    fixed = []
+    for dim, entry in zip(shape, entries):
+        if entry is None:
+            fixed.append(None)
+            continue
+        axes = entry if isinstance(entry, (tuple, list)) else (entry,)
+        size = 1
+        for axis in axes:
+            size *= mesh.shape[axis]
+        fixed.append(entry if dim % size == 0 else None)
+    return P(*fixed)
+
+
+def make_shardings(specs: Any, mesh: Mesh) -> Any:
+    """PartitionSpec pytree → NamedSharding pytree."""
+    return jax.tree.map(
+        lambda spec: NamedSharding(mesh, spec), specs,
+        is_leaf=lambda x: isinstance(x, P))
+
+
+def shard_params(params: Any, mesh: Mesh,
+                 rules: Sequence[tuple[str, P]],
+                 default: P = P()) -> Any:
+    """Place ``params`` on the mesh according to the rule table."""
+    specs = make_param_specs(params, rules, mesh=mesh, default=default)
+    shardings = make_shardings(specs, mesh)
+    return jax.tree.map(jax.device_put, params, shardings)
+
+
+__all__ = ["make_param_specs", "make_shardings", "path_str", "shard_params"]
