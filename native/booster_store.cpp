@@ -1,0 +1,215 @@
+// BoosterStore: a memory-mapped positional record store.
+//
+// TPU-native replacement for the reference's liblmdb dependency
+// (ref torchbooster/lmdb.py:13-106 binds the lmdb package -> liblmdb C).
+// The reference used LMDB as a read-only "length"-keyed blob store
+// (ref lmdb.py:72-83: key = str(index), plus a "length" metadata key).
+// That access pattern needs no B-tree, no transactions, no MVCC - just
+// an index of (offset, size) pairs over an mmap'd payload region, which
+// is both simpler and faster (one memcpy-free pointer return per read;
+// the kernel page cache does the rest). Readers are thread-safe by
+// construction (the mapping is immutable); one writer builds a file.
+//
+// File layout (little-endian):
+//   [0..8)    magic "BSTORE1\0"
+//   [8..16)   u64 record count N
+//   [16..24)  u64 index offset
+//   [24..)    payload bytes (records, back to back)
+//   [index_offset .. index_offset + 16*N)  N x (u64 offset, u64 size)
+//
+// Build: g++ -O3 -shared -fPIC -o libbooster_store.so booster_store.cpp
+
+#include <cerrno>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr char kMagic[8] = {'B', 'S', 'T', 'O', 'R', 'E', '1', '\0'};
+constexpr uint64_t kHeaderSize = 24;
+
+thread_local std::string g_error;
+
+void set_error(const std::string& message) { g_error = message; }
+
+struct Reader {
+  int fd = -1;
+  const uint8_t* base = nullptr;
+  uint64_t file_size = 0;
+  uint64_t count = 0;
+  const uint8_t* index = nullptr;  // 16*count bytes
+};
+
+struct Writer {
+  FILE* file = nullptr;
+  std::string path;
+  std::vector<std::pair<uint64_t, uint64_t>> index;
+  uint64_t cursor = kHeaderSize;
+  bool failed = false;
+};
+
+uint64_t read_u64(const uint8_t* p) {
+  uint64_t v;
+  std::memcpy(&v, p, 8);
+  return v;
+}
+
+}  // namespace
+
+extern "C" {
+
+const char* bs_error() { return g_error.c_str(); }
+
+// ---------------------------------------------------------------- reader
+
+void* bs_open(const char* path) {
+  int fd = ::open(path, O_RDONLY);
+  if (fd < 0) {
+    set_error(std::string("open failed: ") + std::strerror(errno));
+    return nullptr;
+  }
+  struct stat st;
+  if (fstat(fd, &st) != 0 || static_cast<uint64_t>(st.st_size) < kHeaderSize) {
+    set_error("not a BoosterStore file (too small)");
+    ::close(fd);
+    return nullptr;
+  }
+  uint64_t file_size = static_cast<uint64_t>(st.st_size);
+  void* base = mmap(nullptr, file_size, PROT_READ, MAP_SHARED, fd, 0);
+  if (base == MAP_FAILED) {
+    set_error(std::string("mmap failed: ") + std::strerror(errno));
+    ::close(fd);
+    return nullptr;
+  }
+  const uint8_t* bytes = static_cast<const uint8_t*>(base);
+  if (std::memcmp(bytes, kMagic, 8) != 0) {
+    set_error("bad magic: not a BoosterStore file");
+    munmap(base, file_size);
+    ::close(fd);
+    return nullptr;
+  }
+  uint64_t count = read_u64(bytes + 8);
+  uint64_t index_offset = read_u64(bytes + 16);
+  if (index_offset > file_size || count > (file_size - index_offset) / 16) {
+    set_error("corrupt header: index out of bounds");
+    munmap(base, file_size);
+    ::close(fd);
+    return nullptr;
+  }
+  Reader* reader = new Reader;
+  reader->fd = fd;
+  reader->base = bytes;
+  reader->file_size = file_size;
+  reader->count = count;
+  reader->index = bytes + index_offset;
+  // Random-access reads: tell the kernel not to read ahead aggressively.
+  madvise(base, file_size, MADV_RANDOM);
+  return reader;
+}
+
+int64_t bs_count(void* handle) {
+  return static_cast<Reader*>(handle)->count;
+}
+
+int bs_get(void* handle, uint64_t idx, const uint8_t** data, uint64_t* size) {
+  Reader* reader = static_cast<Reader*>(handle);
+  if (idx >= reader->count) {
+    set_error("index out of range");
+    return -1;
+  }
+  const uint8_t* entry = reader->index + 16 * idx;
+  uint64_t offset = read_u64(entry);
+  uint64_t length = read_u64(entry + 8);
+  if (offset > reader->file_size || length > reader->file_size - offset) {
+    set_error("corrupt index entry");
+    return -1;
+  }
+  *data = reader->base + offset;
+  *size = length;
+  return 0;
+}
+
+void bs_close(void* handle) {
+  Reader* reader = static_cast<Reader*>(handle);
+  if (reader->base != nullptr) {
+    munmap(const_cast<uint8_t*>(reader->base), reader->file_size);
+  }
+  if (reader->fd >= 0) ::close(reader->fd);
+  delete reader;
+}
+
+// ---------------------------------------------------------------- writer
+
+void* bs_writer_open(const char* path) {
+  FILE* file = std::fopen(path, "wb");
+  if (file == nullptr) {
+    set_error(std::string("fopen failed: ") + std::strerror(errno));
+    return nullptr;
+  }
+  Writer* writer = new Writer;
+  writer->file = file;
+  writer->path = path;
+  // Header placeholder; patched on close.
+  uint8_t header[kHeaderSize] = {0};
+  std::memcpy(header, kMagic, 8);
+  if (std::fwrite(header, 1, kHeaderSize, file) != kHeaderSize) {
+    set_error("header write failed");
+    std::fclose(file);
+    delete writer;
+    return nullptr;
+  }
+  return writer;
+}
+
+int bs_writer_append(void* handle, const uint8_t* data, uint64_t size) {
+  Writer* writer = static_cast<Writer*>(handle);
+  if (writer->failed) return -1;
+  if (size > 0 && std::fwrite(data, 1, size, writer->file) != size) {
+    set_error("record write failed");
+    writer->failed = true;
+    return -1;
+  }
+  writer->index.emplace_back(writer->cursor, size);
+  writer->cursor += size;
+  return 0;
+}
+
+int bs_writer_close(void* handle) {
+  Writer* writer = static_cast<Writer*>(handle);
+  int status = 0;
+  if (!writer->failed) {
+    uint64_t index_offset = writer->cursor;
+    for (const auto& entry : writer->index) {
+      uint64_t pair[2] = {entry.first, entry.second};
+      if (std::fwrite(pair, 1, 16, writer->file) != 16) {
+        set_error("index write failed");
+        status = -1;
+        break;
+      }
+    }
+    if (status == 0) {
+      uint64_t count = writer->index.size();
+      std::fseek(writer->file, 8, SEEK_SET);
+      if (std::fwrite(&count, 1, 8, writer->file) != 8 ||
+          std::fwrite(&index_offset, 1, 8, writer->file) != 8) {
+        set_error("header patch failed");
+        status = -1;
+      }
+    }
+  } else {
+    status = -1;
+  }
+  std::fclose(writer->file);
+  delete writer;
+  return status;
+}
+
+}  // extern "C"

@@ -12,6 +12,12 @@ first backend use (which this file is early enough for).
 """
 import os
 
+# zero-egress environment: make HuggingFace resolution fail fast instead
+# of stalling in network retries (the offline→synthetic fallback is the
+# behavior under test)
+os.environ.setdefault("HF_HUB_OFFLINE", "1")
+os.environ.setdefault("HF_DATASETS_OFFLINE", "1")
+
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (

@@ -1,0 +1,14 @@
+"""Host data path: loaders, sharding, device prefetch, dataset sources."""
+from torchbooster_tpu.data.pipeline import (
+    DataLoader,
+    ShardedIterable,
+    SizedIterable,
+    default_collate,
+    prefetch_to_device,
+)
+from torchbooster_tpu.data.sources import register_dataset, resolve_dataset
+
+__all__ = [
+    "DataLoader", "ShardedIterable", "SizedIterable", "default_collate",
+    "prefetch_to_device", "register_dataset", "resolve_dataset",
+]

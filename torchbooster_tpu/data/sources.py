@@ -1,0 +1,202 @@
+"""Dataset resolution: builtin registry → local store → HuggingFace.
+
+TPU-native analogue of the reference's resolution chain
+torchvision → torchtext → HuggingFace → fatal (ref config.py:541-617).
+torchvision/torchtext have no role here; instead:
+
+1. **registry** — names registered via :func:`register_dataset`,
+   including network-free synthetic families (``synthetic_mnist``,
+   ``synthetic_cifar10``, ``synthetic_imagenet``, ``synthetic_lm``)
+   sized/shaped like the real datasets, so every example recipe runs in
+   a zero-egress environment;
+2. **local record store** — ``root/<split>.bstore`` built by
+   ``BaseDataset.prepare`` (or any BoosterStore file);
+3. **HuggingFace ``datasets``** — by name (+ ``task`` as config name),
+   with the reference's 80/20 train-split fallback when a dataset lacks
+   a test split (ref config.py:589-614); real ``mnist``/``cifar10``
+   resolve here when the network allows, else fall back to their
+   synthetic twins with a loud warning;
+4. otherwise ``logging.fatal`` + ``exit(1)`` (ref config.py:616-617).
+"""
+from __future__ import annotations
+
+import logging
+import sys
+from pathlib import Path
+from typing import Any, Callable
+
+import numpy as np
+
+from torchbooster_tpu.dataset import ArrayDataset, BaseDataset, Split
+
+_REGISTRY: dict[str, Callable] = {}
+
+
+def register_dataset(name: str, builder: Callable | None = None):
+    """Register a dataset builder ``(conf, split, **kw) -> Dataset``.
+    Usable as a decorator. This is the extension point user config
+    subclasses used in the reference (ref CocoDatasetConfig,
+    online.py:73-82) hook into without subclassing DatasetConfig."""
+    if builder is None:
+        return lambda fn: register_dataset(name, fn)
+    _REGISTRY[name.lower()] = builder
+    return builder
+
+
+# ---------------------------------------------------------------- synthetic
+
+def _synthetic_classification(n: int, shape: tuple, classes: int,
+                              split: Split, seed: int = 0):
+    """Deterministic class-conditional Gaussian images: learnable (a
+    linear probe separates them) so example recipes show real training
+    curves, not noise-fitting."""
+    rng = np.random.RandomState(seed + {"train": 0, "validation": 1,
+                                        "test": 2}[split.value])
+    labels = rng.randint(0, classes, n).astype(np.int32)
+    prototypes = np.random.RandomState(seed).randn(classes, *shape) \
+        .astype(np.float32)
+    images = prototypes[labels] + 0.5 * rng.randn(n, *shape).astype(np.float32)
+    return ArrayDataset(images.astype(np.float32), labels)
+
+
+@register_dataset("synthetic_mnist")
+def _synthetic_mnist(conf: Any, split: Split, **kw):
+    n = 8_192 if split == Split.TRAIN else 1_024
+    return _synthetic_classification(n, (28, 28, 1), 10, split)
+
+
+@register_dataset("synthetic_cifar10")
+def _synthetic_cifar10(conf: Any, split: Split, **kw):
+    n = 8_192 if split == Split.TRAIN else 1_024
+    return _synthetic_classification(n, (32, 32, 3), 10, split)
+
+
+@register_dataset("synthetic_imagenet")
+def _synthetic_imagenet(conf: Any, split: Split, **kw):
+    n = 2_048 if split == Split.TRAIN else 256
+    return _synthetic_classification(n, (224, 224, 3), 1000, split)
+
+
+@register_dataset("synthetic_lm")
+def _synthetic_lm(conf: Any, split: Split, seq_len: int = 256,
+                  vocab: int = 1_024, **kw):
+    """Token streams from a fixed-transition Markov chain — compressible
+    structure a language model can actually learn."""
+    n = 4_096 if split == Split.TRAIN else 512
+    rng = np.random.RandomState(0 if split == Split.TRAIN else 1)
+    transitions = np.random.RandomState(7).randint(0, vocab, (vocab, 4))
+    tokens = np.empty((n, seq_len), np.int32)
+    state = rng.randint(0, vocab, n)
+    for t in range(seq_len):
+        tokens[:, t] = state
+        choice = rng.randint(0, 4, n)
+        state = transitions[state, choice]
+    return ArrayDataset(tokens)
+
+
+# ---------------------------------------------------------------- stores
+
+class StoreDataset(BaseDataset):
+    """Concrete BaseDataset over an existing ``root/<split>.bstore``."""
+
+
+# ---------------------------------------------------------------- HF
+
+class HFDataset:
+    """Map-style wrapper over a HuggingFace dataset split
+    (ref config.py:589-614)."""
+
+    def __init__(self, hf_split: Any):
+        self.hf_split = hf_split
+
+    def __len__(self) -> int:
+        return len(self.hf_split)
+
+    def __getitem__(self, index: int) -> Any:
+        item = self.hf_split[int(index)]
+        return {k: np.asarray(v) for k, v in item.items()}
+
+
+def _try_huggingface(conf: Any, split: Split):
+    try:
+        from datasets import load_dataset  # type: ignore
+    except ImportError:
+        return None
+    name = conf.name
+    task = getattr(conf, "task", "") or None
+    try:
+        def has_split(wanted: str) -> bool:
+            try:
+                load_dataset(name, task, split=f"{wanted}[:1]")
+                return True
+            except ValueError:
+                return False
+
+        # 80/20 train-split fallback when no test split exists
+        # (ref config.py:589-614) — splits must be DISJOINT: train
+        # becomes train[:80%] whenever test/validation fall back.
+        if split == Split.TEST:
+            data = load_dataset(name, task, split="test") \
+                if has_split("test") else \
+                load_dataset(name, task, split="train[80%:]")
+        elif split == Split.VALIDATION:
+            data = load_dataset(name, task, split="validation") \
+                if has_split("validation") else \
+                load_dataset(name, task, split="train[80%:]")
+        else:
+            data = load_dataset(name, task, split="train") \
+                if has_split("test") or has_split("validation") else \
+                load_dataset(name, task, split="train[:80%]")
+        return HFDataset(data)
+    except Exception as error:  # offline / unknown dataset
+        logging.warning("huggingface load of %r failed: %s", name, error)
+        return None
+
+
+_SYNTHETIC_TWINS = {"mnist": "synthetic_mnist", "cifar10": "synthetic_cifar10",
+                    "imagenet": "synthetic_imagenet",
+                    "imagenet-1k": "synthetic_imagenet"}
+
+
+def resolve_dataset(conf: Any, split: Split | str, download: bool = True,
+                    distributed: bool = False,
+                    acceptance_fn: Callable | None = None,
+                    **kwargs: Any) -> Any:
+    """The resolution chain (see module docstring). ``distributed`` and
+    ``acceptance_fn`` apply to stream datasets (ref config.py:578-587);
+    map datasets shard in the loader instead."""
+    if isinstance(split, str):
+        split = Split(split)
+    name = conf.name.lower()
+
+    if name in _REGISTRY:
+        dataset = _REGISTRY[name](conf, split, **kwargs)
+    else:
+        store = StoreDataset.store_path(conf.root, split)
+        if Path(store).exists():
+            dataset = StoreDataset(conf.root, split)
+        else:
+            dataset = _try_huggingface(conf, split)
+            if dataset is None and name in _SYNTHETIC_TWINS:
+                logging.warning(
+                    "dataset %r unavailable (offline?); using %s stand-in",
+                    conf.name, _SYNTHETIC_TWINS[name])
+                dataset = _REGISTRY[_SYNTHETIC_TWINS[name]](conf, split,
+                                                            **kwargs)
+            if dataset is None:
+                # ref config.py:616-617
+                logging.fatal("cannot resolve dataset %r", conf.name)
+                sys.exit(1)
+
+    if acceptance_fn is not None and hasattr(dataset, "__iter__") \
+            and not hasattr(dataset, "__getitem__"):
+        from torchbooster_tpu.data.pipeline import SizedIterable
+
+        # pre-filter size when the stream declares one (an upper bound,
+        # like the reference's NUM_LINES, ref config.py:578-587)
+        size = len(dataset) if hasattr(dataset, "__len__") else None
+        dataset = SizedIterable(dataset, size, acceptance_fn)
+    return dataset
+
+
+__all__ = ["HFDataset", "StoreDataset", "register_dataset", "resolve_dataset"]

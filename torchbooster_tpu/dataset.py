@@ -1,0 +1,121 @@
+"""Dataset base: Split enum + record-store-backed map datasets.
+
+Capability parity with reference ``torchbooster/dataset.py`` (78 LoC):
+``Split`` (ref dataset.py:15-22) and the abstract store-backed
+``BaseDataset`` with its ``prepare()`` classmethod hook
+(ref dataset.py:25-73) — re-pointed from LMDB to the BoosterStore
+(:mod:`torchbooster_tpu.store`).
+"""
+from __future__ import annotations
+
+import pickle
+from enum import Enum
+from pathlib import Path
+from typing import Any, Callable, Iterable, Iterator
+
+from torchbooster_tpu.store import RecordReader, RecordWriter
+
+
+class Split(Enum):
+    """ref dataset.py:15-22."""
+
+    TRAIN = "train"
+    VALIDATION = "validation"
+    TEST = "test"
+
+
+class Dataset:
+    """Minimal map-style dataset protocol: ``__len__`` + ``__getitem__``
+    (the torch.utils.data.Dataset contract the reference built on,
+    without the torch dependency)."""
+
+    def __len__(self) -> int:
+        raise NotImplementedError
+
+    def __getitem__(self, index: int) -> Any:
+        raise NotImplementedError
+
+
+class IterableDataset:
+    """Marker base for stream datasets (torch IterableDataset analogue);
+    loaders iterate instead of indexing."""
+
+    def __iter__(self) -> Iterator[Any]:
+        raise NotImplementedError
+
+
+class BaseDataset(Dataset):
+    """Record-store-backed dataset (ref BaseDataset dataset.py:25-73).
+
+    Subclasses implement :meth:`process` (bytes → example; the
+    reference's abstract ``__getitem__``) and optionally override
+    :meth:`prepare` to build the store from a source corpus
+    (ref prepare classmethod hook, dataset.py:49-56).
+    """
+
+    def __init__(self, root: str | Path, split: Split):
+        self.root = Path(root)
+        self.split = split
+        self.reader = RecordReader(self.store_path(self.root, split))
+
+    @classmethod
+    def store_path(cls, root: str | Path, split: Split) -> Path:
+        """``root/<split>.bstore`` (ref per-split root subdir,
+        config.py:567)."""
+        return Path(root) / f"{split.value}.bstore"
+
+    @classmethod
+    def prepare(cls, root: str | Path, split: Split,
+                examples: Iterable[Any],
+                encode: Callable[[Any], bytes] = pickle.dumps) -> Path:
+        """Build the record store for ``split`` from ``examples``."""
+        path = cls.store_path(root, split)
+        with RecordWriter(path) as writer:
+            for example in examples:
+                writer.append(encode(example))
+        return path
+
+    def process(self, raw: bytes) -> Any:
+        """bytes → example (decode + transform). Default: unpickle."""
+        return pickle.loads(raw)
+
+    def __len__(self) -> int:
+        return len(self.reader)
+
+    def __getitem__(self, index: int) -> Any:
+        return self.process(self.reader[index])
+
+
+class TransformDataset(Dataset):
+    """Apply a per-example transform lazily (the role torchvision
+    transforms played in the reference examples, host-side)."""
+
+    def __init__(self, base: Dataset, transform: Callable[[Any], Any]):
+        self.base = base
+        self.transform = transform
+
+    def __len__(self) -> int:
+        return len(self.base)
+
+    def __getitem__(self, index: int) -> Any:
+        return self.transform(self.base[index])
+
+
+class ArrayDataset(Dataset):
+    """In-memory dataset over parallel arrays (used by the synthetic
+    sources and small benchmarks)."""
+
+    def __init__(self, *arrays: Any):
+        assert arrays and all(len(a) == len(arrays[0]) for a in arrays)
+        self.arrays = arrays
+
+    def __len__(self) -> int:
+        return len(self.arrays[0])
+
+    def __getitem__(self, index: int) -> Any:
+        items = tuple(a[index] for a in self.arrays)
+        return items if len(items) > 1 else items[0]
+
+
+__all__ = ["ArrayDataset", "BaseDataset", "Dataset", "IterableDataset",
+           "Split", "TransformDataset"]
