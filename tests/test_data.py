@@ -248,3 +248,43 @@ def test_prefetch_early_break_no_leak():
             break  # consumer abandons the generator immediately
     # producer threads must retire, not accumulate
     assert threading.active_count() <= before + 1
+
+
+def test_sharded_iterable_len_counts_remainder():
+    from torchbooster_tpu.data.pipeline import ShardedIterable
+
+    base = list(range(22))
+    for shift in range(4):
+        shard = ShardedIterable(base, shift=shift, mod=4)
+        assert len(shard) == len(list(shard)), f"shift={shift}"
+
+
+def test_prefetch_sentinel_survives_full_queue():
+    import time
+    from torchbooster_tpu.data.pipeline import prefetch_to_device
+    from torchbooster_tpu.distributed import make_mesh
+
+    mesh = make_mesh("dp:1", n_devices=1)
+    batches = [{"x": np.ones((2, 2)) * i} for i in range(6)]
+    seen = 0
+    # slow consumer with a tiny queue: producer finishes while full
+    for batch in prefetch_to_device(iter(batches), mesh=mesh, size=1):
+        time.sleep(0.05)
+        seen += 1
+    assert seen == 6
+
+
+def test_record_writer_abort_on_exception(tmp_path):
+    from torchbooster_tpu.store import RecordReader, RecordWriter
+
+    path = tmp_path / "partial.bstore"
+    with pytest.raises(RuntimeError):
+        with RecordWriter(path) as writer:
+            writer.append(b"one")
+            raise RuntimeError("simulated crash mid-build")
+    assert not path.exists(), "crashed build must not leave a store behind"
+
+    with RecordWriter(path) as writer:
+        writer.append(b"one")
+    with RecordReader(path) as reader:
+        assert len(reader) == 1

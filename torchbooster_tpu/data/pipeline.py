@@ -87,7 +87,11 @@ class ShardedIterable(IterableDataset):
         self.mod = dist.get_world_size() if mod is None else mod
 
     def __len__(self) -> int:
-        return len(self.base) // self.mod
+        # exact count of i in [0, n) with (i + shift) % mod == 0:
+        # first match is (-shift) % mod, then every mod-th item
+        n = len(self.base)
+        first = (-self.shift) % self.mod
+        return max(0, -(-(n - first) // self.mod)) if first < n else 0
 
     def __iter__(self) -> Iterator[Any]:
         for i, item in enumerate(self.base):
@@ -256,10 +260,15 @@ def prefetch_to_device(loader: Iterable, mesh=None, size: int = 2
         except BaseException as exc:  # propagate into consumer
             error.append(exc)
         finally:
-            try:
-                q.put_nowait(sentinel)
-            except queue.Full:
-                pass
+            # the sentinel must use the same stop-aware blocking put as
+            # batches: put_nowait on a full queue would drop it and leave
+            # the consumer blocked on q.get() forever
+            while not stop.is_set():
+                try:
+                    q.put(sentinel, timeout=0.1)
+                    break
+                except queue.Full:
+                    continue
 
     thread = threading.Thread(target=producer, daemon=True)
     thread.start()

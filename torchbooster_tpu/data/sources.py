@@ -125,16 +125,23 @@ def _try_huggingface(conf: Any, split: Split):
     name = conf.name
     task = getattr(conf, "task", "") or None
     try:
-        def has_split(wanted: str) -> bool:
-            try:
-                load_dataset(name, task, split=f"{wanted}[:1]")
-                return True
-            except ValueError:
-                return False
+        # metadata-only split listing (one fetch, not a load per probe)
+        try:
+            from datasets import get_dataset_split_names  # type: ignore
 
-        # 80/20 train-split fallback when no test split exists
-        # (ref config.py:589-614) — splits must be DISJOINT: train
-        # becomes train[:80%] whenever test/validation fall back.
+            available = set(get_dataset_split_names(name, task))
+        except Exception:
+            available = {"train"}
+
+        def has_split(wanted: str) -> bool:
+            return wanted in available
+
+        # 80/20 train-split fallback when no test/validation split
+        # exists (ref config.py:589-614) — splits must be DISJOINT:
+        # whenever ANY eval split falls back onto train[80%:], train
+        # must shrink to train[:80%] (eval data must never appear in
+        # the training set).
+        eval_falls_back = not (has_split("test") and has_split("validation"))
         if split == Split.TEST:
             data = load_dataset(name, task, split="test") \
                 if has_split("test") else \
@@ -144,9 +151,9 @@ def _try_huggingface(conf: Any, split: Split):
                 if has_split("validation") else \
                 load_dataset(name, task, split="train[80%:]")
         else:
-            data = load_dataset(name, task, split="train") \
-                if has_split("test") or has_split("validation") else \
-                load_dataset(name, task, split="train[:80%]")
+            data = load_dataset(name, task, split="train[:80%]") \
+                if eval_falls_back else \
+                load_dataset(name, task, split="train")
         return HFDataset(data)
     except Exception as error:  # offline / unknown dataset
         logging.warning("huggingface load of %r failed: %s", name, error)

@@ -1,0 +1,30 @@
+"""Model zoo: plain-functional JAX models, TPU-first.
+
+Every model family the reference's examples exercise (SURVEY §2.14) plus
+the north-star transformer. Models here are *plain functions over plain
+pytrees* — ``init(rng, ...) -> params`` and ``apply(params, x, ...)`` —
+no module system, no mutable state. Sharding is declared as data: each
+model ships ``SHARDING_RULES`` (path-regex → PartitionSpec) consumed by
+:mod:`torchbooster_tpu.parallel.sharding`.
+
+Design choices vs the reference's torch models:
+- NHWC layout for convs (channels on the TPU lane dimension).
+- Stateless norms (GroupNorm / LayerNorm) instead of BatchNorm: no
+  running stats to thread through the compiled step, and no cross-replica
+  stat sync riding ICI every step.
+- No forward hooks (ref offline.py:67-70): models that need feature taps
+  expose them as explicit multi-output apply functions.
+"""
+from torchbooster_tpu.models import layers
+from torchbooster_tpu.models.lenet import LeNet
+from torchbooster_tpu.models.resnet import ResNet
+from torchbooster_tpu.models.vae import VAE
+from torchbooster_tpu.models.gan import GAN
+from torchbooster_tpu.models.vgg import VGGFeatures
+from torchbooster_tpu.models.stylenet import StyleNet
+from torchbooster_tpu.models.gpt import GPT
+
+__all__ = [
+    "GAN", "GPT", "LeNet", "ResNet", "StyleNet", "VAE", "VGGFeatures",
+    "layers",
+]

@@ -1,0 +1,174 @@
+"""GPT-style transformer LM — the north-star model (SURVEY §6: stretch
+GPT-2 config; the reference has no transformer at all, SURVEY §5.7).
+
+TPU-first design:
+- **scan over layers**: block params are stacked on a leading layer
+  axis and the forward is one ``lax.scan`` — O(1) compile time in
+  depth, and the natural substrate for pipeline stages later;
+- **remat**: ``remat=True`` wraps the scanned block in
+  ``jax.checkpoint`` — activations are recomputed in backward, trading
+  MXU FLOPs for HBM (SURVEY's "jax.checkpoint" guidance);
+- **Megatron-style tp rules**: qkv/fc1 column-parallel, proj/fc2
+  row-parallel — XLA inserts exactly one psum per row-parallel matmul;
+  ``fsdp`` shards the other dim (ZeRO-style), ``sp`` shards the
+  sequence axis of activations;
+- attention runs through :func:`torchbooster_tpu.ops.attention`
+  (pallas flash kernel on TPU) or, when the mesh has a real ``sp``
+  axis, ring attention (:mod:`torchbooster_tpu.parallel.ring`).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from torchbooster_tpu.models import layers as L
+from torchbooster_tpu.ops.attention import attention
+
+
+@dataclass(frozen=True)
+class GPTConfig:
+    vocab: int = 50257
+    n_layers: int = 12
+    d_model: int = 768
+    n_heads: int = 12
+    seq_len: int = 1024
+    mlp_ratio: int = 4
+    dropout: float = 0.0      # recipe-level; models stay deterministic
+    tie_embeddings: bool = True
+
+
+# path-regex → PartitionSpec (leading None = the stacked layer axis).
+# Consumed by parallel.sharding.make_param_specs; axes not in the mesh
+# are filtered out, so the same table serves dp-only through dp+fsdp+tp.
+SHARDING_RULES = [
+    (r"wte/table", P("tp", "fsdp")),
+    (r"wpe/table", P(None, None)),
+    (r"attn_qkv/kernel", P(None, "fsdp", "tp")),
+    (r"attn_qkv/bias", P(None, "tp")),
+    (r"attn_proj/kernel", P(None, "tp", "fsdp")),
+    (r"mlp_fc1/kernel", P(None, "fsdp", "tp")),
+    (r"mlp_fc1/bias", P(None, "tp")),
+    (r"mlp_fc2/kernel", P(None, "tp", "fsdp")),
+    (r"head/kernel", P("fsdp", "tp")),
+    (r".*", P()),
+]
+
+# activations: batch over data axes, sequence over sp
+def batch_spec() -> P:
+    return P(("dp", "fsdp"), "sp")
+
+
+def _block_init(rng: jax.Array, cfg: GPTConfig, dtype: Any) -> dict:
+    ks = jax.random.split(rng, 4)
+    d, h = cfg.d_model, cfg.mlp_ratio * cfg.d_model
+    # GPT-2 init: N(0, 0.02), residual projections scaled by 1/√(2L)
+    res_std = 0.02 / (2 * cfg.n_layers) ** 0.5
+    return {
+        "ln1": L.norm_init(d, dtype),
+        "attn_qkv": L.dense_init(ks[0], d, 3 * d, std=0.02, dtype=dtype),
+        "attn_proj": L.dense_init(ks[1], d, d, std=res_std, dtype=dtype),
+        "ln2": L.norm_init(d, dtype),
+        "mlp_fc1": L.dense_init(ks[2], d, h, std=0.02, dtype=dtype),
+        "mlp_fc2": L.dense_init(ks[3], h, d, std=res_std, dtype=dtype),
+    }
+
+
+class GPT:
+    """``init(rng, cfg)`` → params (blocks stacked over layer axis);
+    ``apply(params, ids, cfg)`` → logits (B, S, vocab)."""
+
+    Config = GPTConfig
+    SHARDING_RULES = SHARDING_RULES
+
+    @staticmethod
+    def init(rng: jax.Array, cfg: GPTConfig = GPTConfig(),
+             dtype: Any = jnp.float32) -> dict:
+        k_wte, k_wpe, k_blocks, k_head = jax.random.split(rng, 4)
+        blocks = jax.vmap(
+            lambda k: _block_init(k, cfg, dtype)
+        )(jax.random.split(k_blocks, cfg.n_layers))
+        params = {
+            "wte": L.embedding_init(k_wte, cfg.vocab, cfg.d_model,
+                                    dtype=dtype),
+            "wpe": L.embedding_init(k_wpe, cfg.seq_len, cfg.d_model,
+                                    std=0.01, dtype=dtype),
+            "blocks": blocks,
+            "ln_f": L.norm_init(cfg.d_model, dtype),
+        }
+        if not cfg.tie_embeddings:
+            params["head"] = L.dense_init(k_head, cfg.d_model, cfg.vocab,
+                                          use_bias=False, std=0.02,
+                                          dtype=dtype)
+        return params
+
+    @staticmethod
+    def apply(params: dict, ids: jax.Array,
+              cfg: GPTConfig = GPTConfig(),
+              mesh: Mesh | None = None,
+              compute_dtype: Any = jnp.bfloat16,
+              remat: bool = True,
+              attn_impl: str = "auto") -> jax.Array:
+        b, s = ids.shape
+        n_heads, d = cfg.n_heads, cfg.d_model
+        head_dim = d // n_heads
+
+        constrain = _make_constrainer(mesh)
+
+        x = L.embedding(params["wte"], ids, dtype=compute_dtype)
+        x = x + L.embedding(params["wpe"], jnp.arange(s),
+                            dtype=compute_dtype)
+        x = constrain(x)
+
+        use_ring = (mesh is not None and "sp" in mesh.axis_names
+                    and mesh.shape["sp"] > 1)
+
+        def block(x: jax.Array, bp: dict) -> tuple[jax.Array, None]:
+            h = L.layer_norm(bp["ln1"], x)
+            qkv = L.dense(bp["attn_qkv"], h)
+            qkv = qkv.reshape(b, s, 3, n_heads, head_dim)
+            q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+            if use_ring:
+                from torchbooster_tpu.parallel.ring import ring_attention
+
+                o = ring_attention(q, k, v, mesh=mesh, causal=True)
+            else:
+                o = attention(q, k, v, causal=True, impl=attn_impl)
+            o = o.reshape(b, s, d)
+            x = constrain(x + L.dense(bp["attn_proj"], o))
+            h = L.layer_norm(bp["ln2"], x)
+            h = jax.nn.gelu(L.dense(bp["mlp_fc1"], h))
+            x = constrain(x + L.dense(bp["mlp_fc2"], h))
+            return x, None
+
+        scan_block = jax.checkpoint(block) if remat else block
+        x, _ = jax.lax.scan(lambda carry, bp: scan_block(carry, bp),
+                            x, params["blocks"])
+
+        x = L.layer_norm(params["ln_f"], x)
+        if "head" in params:
+            logits = L.dense(params["head"], x)
+        else:
+            logits = x @ params["wte"]["table"].astype(x.dtype).T
+        return logits
+
+
+def _make_constrainer(mesh: Mesh | None):
+    if mesh is None:
+        return lambda x: x
+    axes = mesh.axis_names
+    data = tuple(a for a in ("dp", "fsdp") if a in axes) or None
+    seq = "sp" if "sp" in axes else None
+    spec = P(data, seq)
+
+    def constrain(x: jax.Array) -> jax.Array:
+        return jax.lax.with_sharding_constraint(
+            x, jax.sharding.NamedSharding(mesh, spec))
+
+    return constrain
+
+
+__all__ = ["GPT", "GPTConfig", "SHARDING_RULES", "batch_spec"]

@@ -1,0 +1,201 @@
+"""Functional neural-net layers: init fns returning plain dicts, apply
+fns taking (params, x).
+
+The building blocks for the model zoo. Conventions:
+- images are NHWC (batch, height, width, channels) — channels ride the
+  TPU lane dimension so convs tile straight onto the MXU;
+- params are nested dicts of jnp arrays; init fns split their key as
+  needed; dtype of params defaults to fp32 (master weights), compute
+  casting is the caller's choice;
+- every apply fn is shape-polymorphic over the batch dim and jit-safe
+  (no python control flow on traced values).
+"""
+from __future__ import annotations
+
+import math
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+# =========================================================================
+# Initializers
+# =========================================================================
+
+def _fan_in_scale(rng: jax.Array, shape: Sequence[int], fan_in: int,
+                  dtype: Any, distribution: str = "uniform") -> jax.Array:
+    """Kaiming/LeCun-style fan-in scaled init (torch Linear/Conv default
+    is kaiming-uniform with a=sqrt(5) → uniform(±1/sqrt(fan_in)))."""
+    if distribution == "uniform":
+        bound = 1.0 / math.sqrt(fan_in)
+        return jax.random.uniform(rng, shape, dtype, -bound, bound)
+    std = math.sqrt(2.0 / fan_in)
+    return jax.random.normal(rng, shape, dtype) * std
+
+
+def normal_init(rng: jax.Array, shape: Sequence[int], std: float = 0.02,
+                dtype: Any = jnp.float32) -> jax.Array:
+    return jax.random.normal(rng, shape, dtype) * std
+
+
+# =========================================================================
+# Dense
+# =========================================================================
+
+def dense_init(rng: jax.Array, din: int, dout: int, use_bias: bool = True,
+               std: float | None = None, dtype: Any = jnp.float32) -> dict:
+    kr, _ = jax.random.split(rng)
+    if std is None:
+        kernel = _fan_in_scale(kr, (din, dout), din, dtype)
+    else:
+        kernel = normal_init(kr, (din, dout), std, dtype)
+    params = {"kernel": kernel}
+    if use_bias:
+        params["bias"] = jnp.zeros((dout,), dtype)
+    return params
+
+
+def dense(params: dict, x: jax.Array) -> jax.Array:
+    y = x @ params["kernel"].astype(x.dtype)
+    if "bias" in params:
+        y = y + params["bias"].astype(x.dtype)
+    return y
+
+
+# =========================================================================
+# Convolution (NHWC, HWIO kernels)
+# =========================================================================
+
+def conv_init(rng: jax.Array, kernel: int | tuple[int, int], cin: int,
+              cout: int, use_bias: bool = True,
+              dtype: Any = jnp.float32) -> dict:
+    kh, kw = (kernel, kernel) if isinstance(kernel, int) else kernel
+    kr, _ = jax.random.split(rng)
+    fan_in = kh * kw * cin
+    params = {"kernel": _fan_in_scale(kr, (kh, kw, cin, cout), fan_in, dtype)}
+    if use_bias:
+        params["bias"] = jnp.zeros((cout,), dtype)
+    return params
+
+
+def conv(params: dict, x: jax.Array, stride: int | tuple[int, int] = 1,
+         padding: str | int = "SAME") -> jax.Array:
+    strides = (stride, stride) if isinstance(stride, int) else tuple(stride)
+    if isinstance(padding, int):
+        padding = [(padding, padding), (padding, padding)]
+    y = lax.conv_general_dilated(
+        x, params["kernel"].astype(x.dtype), strides, padding,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    if "bias" in params:
+        y = y + params["bias"].astype(x.dtype)
+    return y
+
+
+def conv_transpose(params: dict, x: jax.Array,
+                   stride: int | tuple[int, int] = 2,
+                   padding: str = "SAME") -> jax.Array:
+    strides = (stride, stride) if isinstance(stride, int) else tuple(stride)
+    y = lax.conv_transpose(
+        x, params["kernel"].astype(x.dtype), strides, padding,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    if "bias" in params:
+        y = y + params["bias"].astype(x.dtype)
+    return y
+
+
+# =========================================================================
+# Pooling
+# =========================================================================
+
+def max_pool(x: jax.Array, window: int = 2, stride: int | None = None,
+             padding: str = "VALID") -> jax.Array:
+    stride = window if stride is None else stride
+    return lax.reduce_window(
+        x, -jnp.inf, lax.max, (1, window, window, 1),
+        (1, stride, stride, 1), padding)
+
+
+def avg_pool(x: jax.Array, window: int = 2, stride: int | None = None,
+             padding: str = "VALID") -> jax.Array:
+    stride = window if stride is None else stride
+    summed = lax.reduce_window(
+        x, 0.0, lax.add, (1, window, window, 1), (1, stride, stride, 1),
+        padding)
+    return summed / (window * window)
+
+
+def global_avg_pool(x: jax.Array) -> jax.Array:
+    return x.mean(axis=(1, 2))
+
+
+# =========================================================================
+# Normalization (stateless — see models/__init__ design note)
+# =========================================================================
+
+def norm_init(channels: int, dtype: Any = jnp.float32) -> dict:
+    return {"scale": jnp.ones((channels,), dtype),
+            "bias": jnp.zeros((channels,), dtype)}
+
+
+def group_norm(params: dict, x: jax.Array, groups: int = 32,
+               eps: float = 1e-5) -> jax.Array:
+    """GroupNorm over NHWC (the BatchNorm replacement: batch-independent,
+    sync-free across replicas). ``groups`` is clipped to the channel
+    count so narrow layers degrade to InstanceNorm-ish behavior."""
+    n, h, w, c = x.shape
+    groups = min(groups, c)
+    while c % groups:
+        groups -= 1
+    xg = x.reshape(n, h, w, groups, c // groups)
+    mean = xg.mean(axis=(1, 2, 4), keepdims=True)
+    var = xg.var(axis=(1, 2, 4), keepdims=True)
+    xg = (xg - mean) * lax.rsqrt(var + eps)
+    x = xg.reshape(n, h, w, c)
+    return x * params["scale"].astype(x.dtype) + params["bias"].astype(x.dtype)
+
+
+def layer_norm(params: dict, x: jax.Array, eps: float = 1e-5) -> jax.Array:
+    mean = x.mean(axis=-1, keepdims=True)
+    var = x.var(axis=-1, keepdims=True)
+    y = (x - mean) * lax.rsqrt(var + eps)
+    return y * params["scale"].astype(x.dtype) + params["bias"].astype(x.dtype)
+
+
+def rms_norm(params: dict, x: jax.Array, eps: float = 1e-6) -> jax.Array:
+    var = jnp.mean(jnp.square(x), axis=-1, keepdims=True)
+    return x * lax.rsqrt(var + eps) * params["scale"].astype(x.dtype)
+
+
+def instance_norm(x: jax.Array, eps: float = 1e-5) -> jax.Array:
+    """Parameter-free instance norm over NHWC spatial dims (the core of
+    AdaIN, ref adain.py:55-63)."""
+    mean = x.mean(axis=(1, 2), keepdims=True)
+    var = x.var(axis=(1, 2), keepdims=True)
+    return (x - mean) * lax.rsqrt(var + eps)
+
+
+# =========================================================================
+# Embedding
+# =========================================================================
+
+def embedding_init(rng: jax.Array, vocab: int, dim: int, std: float = 0.02,
+                   dtype: Any = jnp.float32) -> dict:
+    return {"table": normal_init(rng, (vocab, dim), std, dtype)}
+
+
+def embedding(params: dict, ids: jax.Array,
+              dtype: Any = None) -> jax.Array:
+    table = params["table"]
+    if dtype is not None:
+        table = table.astype(dtype)
+    return jnp.take(table, ids, axis=0)
+
+
+__all__ = [
+    "avg_pool", "conv", "conv_init", "conv_transpose", "dense",
+    "dense_init", "embedding", "embedding_init", "global_avg_pool",
+    "group_norm", "instance_norm", "layer_norm", "max_pool", "norm_init",
+    "normal_init", "rms_norm",
+]

@@ -1,0 +1,53 @@
+"""LeNet-5 classifier — the minimum end-to-end model (SURVEY §7 stage 6).
+
+Capability parity with the reference's LeNet recipe (ref
+examples/img_cls/lenet/lenet.py:29-36: two conv+norm+GELU+pool blocks
+then a 256→120→84→10 GELU MLP). BatchNorm2d there becomes GroupNorm here
+(stateless; see models/__init__ design note). Input is NHWC 28×28×1.
+"""
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from torchbooster_tpu.models import layers as L
+
+
+class LeNet:
+    """``LeNet.init(rng)`` → params; ``LeNet.apply(params, x)`` → logits."""
+
+    num_classes = 10
+
+    @staticmethod
+    def init(rng: jax.Array, num_classes: int = 10,
+             dtype: Any = jnp.float32) -> dict:
+        ks = jax.random.split(rng, 5)
+        return {
+            "conv1": L.conv_init(ks[0], 5, 1, 6, dtype=dtype),
+            "norm1": L.norm_init(6, dtype),
+            "conv2": L.conv_init(ks[1], 5, 6, 16, dtype=dtype),
+            "norm2": L.norm_init(16, dtype),
+            "fc1": L.dense_init(ks[2], 256, 120, dtype=dtype),
+            "fc2": L.dense_init(ks[3], 120, 84, dtype=dtype),
+            "head": L.dense_init(ks[4], 84, num_classes, dtype=dtype),
+        }
+
+    @staticmethod
+    def apply(params: dict, x: jax.Array, train: bool = False,
+              rng: jax.Array | None = None) -> jax.Array:
+        del train, rng
+        x = L.conv(params["conv1"], x, padding="VALID")     # 28→24
+        x = jax.nn.gelu(L.group_norm(params["norm1"], x, groups=6))
+        x = L.max_pool(x, 2)                                # 24→12
+        x = L.conv(params["conv2"], x, padding="VALID")     # 12→8
+        x = jax.nn.gelu(L.group_norm(params["norm2"], x, groups=16))
+        x = L.max_pool(x, 2)                                # 8→4
+        x = x.reshape(x.shape[0], -1)                       # 4*4*16 = 256
+        x = jax.nn.gelu(L.dense(params["fc1"], x))
+        x = jax.nn.gelu(L.dense(params["fc2"], x))
+        return L.dense(params["head"], x)
+
+
+__all__ = ["LeNet"]

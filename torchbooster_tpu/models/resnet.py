@@ -1,0 +1,173 @@
+"""ResNet family (18/34/50/101) — NHWC, GroupNorm, MXU-friendly.
+
+Capability parity with the reference's ResNet recipe (ref
+examples/img_cls/resnet/resnet.py:104-112: torchvision resnet18 with its
+fc head swapped for the target class count). The reference imports a
+pretrained torch model; here the architecture is implemented natively
+(pretrained torchvision weights can be loaded via
+:func:`load_torch_state` which maps NCHW→NHWC kernels).
+
+Design: basic block (two 3×3) for 18/34, bottleneck (1-3-1) for 50/101;
+GroupNorm instead of BatchNorm (stateless, no cross-replica sync — see
+models/__init__); ``stem="cifar"`` swaps the 7×7/s2+pool ImageNet stem
+for the 3×3/s1 CIFAR stem.
+"""
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from torchbooster_tpu.models import layers as L
+
+# depth → (block kind, stage repeats)
+_CONFIGS = {
+    18: ("basic", (2, 2, 2, 2)),
+    34: ("basic", (3, 4, 6, 3)),
+    50: ("bottleneck", (3, 4, 6, 3)),
+    101: ("bottleneck", (3, 4, 23, 3)),
+}
+_STAGE_WIDTHS = (64, 128, 256, 512)
+_GROUPS = 32
+
+
+def _norm(rng_unused: None, c: int, dtype: Any) -> dict:
+    return L.norm_init(c, dtype)
+
+
+def _basic_block_init(rng: jax.Array, cin: int, cout: int, stride: int,
+                      dtype: Any) -> dict:
+    ks = jax.random.split(rng, 3)
+    block = {
+        "conv1": L.conv_init(ks[0], 3, cin, cout, use_bias=False, dtype=dtype),
+        "norm1": L.norm_init(cout, dtype),
+        "conv2": L.conv_init(ks[1], 3, cout, cout, use_bias=False, dtype=dtype),
+        "norm2": L.norm_init(cout, dtype),
+    }
+    if stride != 1 or cin != cout:
+        block["proj"] = L.conv_init(ks[2], 1, cin, cout, use_bias=False,
+                                    dtype=dtype)
+        block["proj_norm"] = L.norm_init(cout, dtype)
+    return block
+
+
+def _basic_block(params: dict, x: jax.Array, stride: int) -> jax.Array:
+    y = L.conv(params["conv1"], x, stride=stride)
+    y = jax.nn.relu(L.group_norm(params["norm1"], y, _GROUPS))
+    y = L.conv(params["conv2"], y)
+    y = L.group_norm(params["norm2"], y, _GROUPS)
+    if "proj" in params:
+        x = L.group_norm(params["proj_norm"],
+                         L.conv(params["proj"], x, stride=stride), _GROUPS)
+    return jax.nn.relu(x + y)
+
+
+def _bottleneck_init(rng: jax.Array, cin: int, cmid: int, stride: int,
+                     dtype: Any) -> dict:
+    cout = cmid * 4
+    ks = jax.random.split(rng, 4)
+    block = {
+        "conv1": L.conv_init(ks[0], 1, cin, cmid, use_bias=False, dtype=dtype),
+        "norm1": L.norm_init(cmid, dtype),
+        "conv2": L.conv_init(ks[1], 3, cmid, cmid, use_bias=False, dtype=dtype),
+        "norm2": L.norm_init(cmid, dtype),
+        "conv3": L.conv_init(ks[2], 1, cmid, cout, use_bias=False, dtype=dtype),
+        "norm3": L.norm_init(cout, dtype),
+    }
+    if stride != 1 or cin != cout:
+        block["proj"] = L.conv_init(ks[3], 1, cin, cout, use_bias=False,
+                                    dtype=dtype)
+        block["proj_norm"] = L.norm_init(cout, dtype)
+    return block
+
+
+def _bottleneck(params: dict, x: jax.Array, stride: int) -> jax.Array:
+    y = jax.nn.relu(L.group_norm(params["norm1"],
+                                 L.conv(params["conv1"], x), _GROUPS))
+    y = jax.nn.relu(L.group_norm(params["norm2"],
+                                 L.conv(params["conv2"], y, stride=stride),
+                                 _GROUPS))
+    y = L.group_norm(params["norm3"], L.conv(params["conv3"], y), _GROUPS)
+    if "proj" in params:
+        x = L.group_norm(params["proj_norm"],
+                         L.conv(params["proj"], x, stride=stride), _GROUPS)
+    return jax.nn.relu(x + y)
+
+
+class ResNet:
+    """``ResNet.init(rng, depth=18/34/50/101, num_classes, stem)`` →
+    (params, meta). ``apply(params, x)`` → logits. ``meta`` (block kind,
+    repeats, stem) rides inside params under the ``"_meta"``-free
+    convention: apply re-derives structure from the params tree itself,
+    so params remain a pure array pytree (jit-donatable)."""
+
+    @staticmethod
+    def init(rng: jax.Array, depth: int = 18, num_classes: int = 10,
+             stem: str = "imagenet", in_channels: int = 3,
+             dtype: Any = jnp.float32) -> dict:
+        kind, repeats = _CONFIGS[depth]
+        ks = iter(jax.random.split(rng, 2 + sum(repeats)))
+        stem_kernel, stem_stride = ((7, 2) if stem == "imagenet" else (3, 1))
+        params: dict = {
+            "stem": {
+                "conv": L.conv_init(next(ks), stem_kernel, in_channels, 64,
+                                    use_bias=False, dtype=dtype),
+                "norm": L.norm_init(64, dtype),
+            },
+        }
+        cin = 64
+        for si, (width, n_blocks) in enumerate(zip(_STAGE_WIDTHS, repeats)):
+            stage = {}
+            for bi in range(n_blocks):
+                stride = 2 if (bi == 0 and si > 0) else 1
+                if kind == "basic":
+                    stage[f"block{bi}"] = _basic_block_init(
+                        next(ks), cin, width, stride, dtype)
+                    cin = width
+                else:
+                    stage[f"block{bi}"] = _bottleneck_init(
+                        next(ks), cin, width, stride, dtype)
+                    cin = width * 4
+            params[f"stage{si}"] = stage
+        params["head"] = L.dense_init(next(ks), cin, num_classes, dtype=dtype)
+        return params
+
+    @staticmethod
+    def apply(params: dict, x: jax.Array, train: bool = False,
+              rng: jax.Array | None = None,
+              pool_stem: bool | None = None) -> jax.Array:
+        del train, rng
+        stem = params["stem"]
+        stem_stride = 2 if stem["conv"]["kernel"].shape[0] == 7 else 1
+        if pool_stem is None:
+            pool_stem = stem_stride == 2
+        x = L.conv(stem["conv"], x, stride=stem_stride)
+        x = jax.nn.relu(L.group_norm(stem["norm"], x, _GROUPS))
+        if pool_stem:
+            x = L.max_pool(x, 3, 2, padding="SAME")
+        si = 0
+        while f"stage{si}" in params:
+            stage = params[f"stage{si}"]
+            bi = 0
+            while f"block{bi}" in stage:
+                block = stage[f"block{bi}"]
+                stride = 2 if (bi == 0 and si > 0) else 1
+                if "conv3" in block:
+                    x = _bottleneck(block, x, stride)
+                else:
+                    x = _basic_block(block, x, stride)
+                bi += 1
+            si += 1
+        x = L.global_avg_pool(x)
+        return L.dense(params["head"], x)
+
+    @staticmethod
+    def swap_head(params: dict, rng: jax.Array, num_classes: int) -> dict:
+        """Transfer-learning head swap (ref resnet.py:111-112 replaces
+        ``model.fc``)."""
+        din = params["head"]["kernel"].shape[0]
+        return {**params, "head": L.dense_init(rng, din, num_classes)}
+
+
+__all__ = ["ResNet"]

@@ -1,0 +1,139 @@
+"""Fast style transfer nets: StyleNet (ref online.py:36-57) and the
+AdaIN decoder (ref adain.py:41-63).
+
+Shared vocabulary (ref online.py:45-49): reflection-padded convs,
+affine InstanceNorm + GELU, nearest-upsample "deconv", residual
+bottlenecks. The AdaIN op itself lives here too — it is the model's
+core, not a framework op.
+"""
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from torchbooster_tpu.models import layers as L
+
+
+def _reflect_pad(x: jax.Array, pad: int) -> jax.Array:
+    if pad == 0:
+        return x
+    return jnp.pad(x, ((0, 0), (pad, pad), (pad, pad), (0, 0)),
+                   mode="reflect")
+
+
+def _conv(params: dict, x: jax.Array, kernel: int, stride: int = 1) -> jax.Array:
+    """ReflectionPad(k//2) + conv VALID (ref Conv, online.py:46)."""
+    return L.conv(params, _reflect_pad(x, kernel // 2), stride=stride,
+                  padding="VALID")
+
+
+def _conv_in(params: dict, x: jax.Array, kernel: int,
+             stride: int = 1) -> jax.Array:
+    """Conv + affine InstanceNorm + GELU (ref ConvIN, online.py:47)."""
+    y = _conv(params["conv"], x, kernel, stride)
+    y = L.instance_norm(y)
+    y = y * params["in_scale"].astype(y.dtype) + params["in_bias"].astype(y.dtype)
+    return jax.nn.gelu(y)
+
+
+def _conv_in_init(rng: jax.Array, kernel: int, cin: int, cout: int,
+                  dtype: Any) -> dict:
+    return {"conv": L.conv_init(rng, kernel, cin, cout, dtype=dtype),
+            "in_scale": jnp.ones((cout,), dtype),
+            "in_bias": jnp.zeros((cout,), dtype)}
+
+
+def _upsample2(x: jax.Array) -> jax.Array:
+    """Nearest ×2 (ref Upsample, online.py:48)."""
+    n, h, w, c = x.shape
+    x = jnp.broadcast_to(x[:, :, None, :, None, :], (n, h, 2, w, 2, c))
+    return x.reshape(n, h * 2, w * 2, c)
+
+
+def mu_std(feat: jax.Array, eps: float = 1e-5) -> tuple[jax.Array, jax.Array]:
+    """Per-channel spatial mean/std of NHWC features (ref adain.py:55-58)."""
+    mu = feat.mean(axis=(1, 2), keepdims=True)
+    std = jnp.sqrt(feat.var(axis=(1, 2), keepdims=True) + eps)
+    return mu, std
+
+
+def adain(s_feat: jax.Array, c_feat: jax.Array) -> jax.Array:
+    """Adaptive instance norm: content features re-statted to the style's
+    channel statistics (ref adaIN, adain.py:61-63)."""
+    (s_mu, s_std), (c_mu, c_std) = mu_std(s_feat), mu_std(c_feat)
+    return s_std * (c_feat - c_mu) / c_std + s_mu
+
+
+class StyleNet:
+    """Hourglass transformer net (ref online.py:52-57): 9×9 stem →
+    two stride-2 ConvIN encoders → 5 residual bottlenecks at 128ch →
+    two upsample decoders → 9×9 head."""
+
+    @staticmethod
+    def init(rng: jax.Array, dtype: Any = jnp.float32) -> dict:
+        ks = iter(jax.random.split(rng, 20))
+        res = {}
+        for i in range(5):
+            res[f"res{i}"] = {
+                "a": _conv_in_init(next(ks), 3, 128, 128, dtype),
+                "b": _conv_in_init(next(ks), 3, 128, 128, dtype),
+            }
+        return {
+            "stem": _conv_in_init(next(ks), 9, 3, 32, dtype),
+            "down1": _conv_in_init(next(ks), 3, 32, 64, dtype),
+            "down2": _conv_in_init(next(ks), 3, 64, 128, dtype),
+            **res,
+            "up1": _conv_in_init(next(ks), 3, 128, 64, dtype),
+            "up2": _conv_in_init(next(ks), 3, 64, 32, dtype),
+            "head": L.conv_init(next(ks), 9, 32, 3, dtype=dtype),
+        }
+
+    @staticmethod
+    def apply(params: dict, x: jax.Array) -> jax.Array:
+        x = _conv_in(params["stem"], x, 9)
+        x = _conv_in(params["down1"], x, 3, stride=2)
+        x = _conv_in(params["down2"], x, 3, stride=2)
+        for i in range(5):
+            res = params[f"res{i}"]
+            y = _conv_in(res["a"], x, 3)
+            y = _conv_in(res["b"], y, 3)
+            x = x + y                      # ref Residual, online.py:36-42
+        x = _conv_in(params["up1"], _upsample2(x), 3)
+        x = _conv_in(params["up2"], _upsample2(x), 3)
+        return _conv(params["head"], x, 9)
+
+
+class AdaINDecoder:
+    """Decoder from VGG relu4_1 features back to RGB (ref Decoder,
+    adain.py:41-52): 512→256 → up → 256×2 →128 → up → 128→64 → up →
+    64→3 with a 9×9 head."""
+
+    @staticmethod
+    def init(rng: jax.Array, dtype: Any = jnp.float32) -> dict:
+        ks = iter(jax.random.split(rng, 8))
+        return {
+            "c1": _conv_in_init(next(ks), 3, 512, 256, dtype),
+            "u1": _conv_in_init(next(ks), 3, 256, 256, dtype),
+            "c2": _conv_in_init(next(ks), 3, 256, 256, dtype),
+            "c3": _conv_in_init(next(ks), 3, 256, 128, dtype),
+            "u2": _conv_in_init(next(ks), 3, 128, 128, dtype),
+            "c4": _conv_in_init(next(ks), 3, 128, 64, dtype),
+            "u3": _conv_in_init(next(ks), 3, 64, 64, dtype),
+            "head": L.conv_init(next(ks), 9, 64, 3, dtype=dtype),
+        }
+
+    @staticmethod
+    def apply(params: dict, feat: jax.Array) -> jax.Array:
+        x = _conv_in(params["c1"], feat, 3)
+        x = _conv_in(params["u1"], _upsample2(x), 3)
+        x = _conv_in(params["c2"], x, 3)
+        x = _conv_in(params["c3"], x, 3)
+        x = _conv_in(params["u2"], _upsample2(x), 3)
+        x = _conv_in(params["c4"], x, 3)
+        x = _conv_in(params["u3"], _upsample2(x), 3)
+        return _conv(params["head"], x, 9)
+
+
+__all__ = ["AdaINDecoder", "StyleNet", "adain", "mu_std"]

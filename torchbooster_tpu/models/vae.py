@@ -1,0 +1,72 @@
+"""MLP VAE for 28×28 images (ref examples/img_gen/vae/vae.py:32-70).
+
+Encoder 784→512→512→2·z (GELU), reparameterized sample, decoder
+z→512→512→784 sigmoid. The torch version samples with
+``torch.randn_like`` inside forward (ref vae.py:45); here the PRNG key
+is an explicit argument — determinism by construction.
+"""
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from torchbooster_tpu.models import layers as L
+
+
+def kl_divergence(mu: jax.Array, log_var: jax.Array) -> jax.Array:
+    """KL(q(z|x) ‖ N(0,I)) averaged over batch (ref vae.py:72-75)."""
+    kl = 1.0 + log_var - jnp.square(mu) - jnp.exp(log_var)
+    return (-0.5 * kl.sum(axis=1)).mean()
+
+
+class VAE:
+    """``init(rng, z_dim)`` → params; ``apply(params, x, rng)`` →
+    ``(recon_logits, mu, log_var)``. ``decode(params, z)`` → images."""
+
+    @staticmethod
+    def init(rng: jax.Array, z_dim: int = 32, image_dim: int = 784,
+             hidden: int = 512, dtype: Any = jnp.float32) -> dict:
+        ks = jax.random.split(rng, 6)
+        return {
+            "enc1": L.dense_init(ks[0], image_dim, hidden, dtype=dtype),
+            "enc2": L.dense_init(ks[1], hidden, hidden, dtype=dtype),
+            "enc_out": L.dense_init(ks[2], hidden, 2 * z_dim, dtype=dtype),
+            "dec1": L.dense_init(ks[3], z_dim, hidden, dtype=dtype),
+            "dec2": L.dense_init(ks[4], hidden, hidden, dtype=dtype),
+            "dec_out": L.dense_init(ks[5], hidden, image_dim, dtype=dtype),
+        }
+
+    @staticmethod
+    def encode(params: dict, x: jax.Array) -> tuple[jax.Array, jax.Array]:
+        x = x.reshape(x.shape[0], -1)
+        x = jax.nn.gelu(L.dense(params["enc1"], x))
+        x = jax.nn.gelu(L.dense(params["enc2"], x))
+        mu, log_var = jnp.split(L.dense(params["enc_out"], x), 2, axis=1)
+        return mu, log_var
+
+    @staticmethod
+    def decode(params: dict, z: jax.Array,
+               image_shape: tuple = (28, 28, 1)) -> jax.Array:
+        """Returns logits; apply sigmoid for pixels (the sigmoid at ref
+        vae.py:56 moves into the loss for a stable bce_with_logits)."""
+        z = jax.nn.gelu(L.dense(params["dec1"], z))
+        z = jax.nn.gelu(L.dense(params["dec2"], z))
+        logits = L.dense(params["dec_out"], z)
+        return logits.reshape(z.shape[0], *image_shape)
+
+    @staticmethod
+    def apply(params: dict, x: jax.Array, rng: jax.Array,
+              train: bool = True) -> tuple[jax.Array, jax.Array, jax.Array]:
+        mu, log_var = VAE.encode(params, x)
+        if train:
+            eps = jax.random.normal(rng, log_var.shape, mu.dtype)
+            z = mu + jnp.exp(0.5 * log_var) * eps   # ref vae.py:45
+        else:
+            z = mu
+        shape = x.shape[1:] if x.ndim > 2 else (28, 28, 1)
+        return VAE.decode(params, z, shape), mu, log_var
+
+
+__all__ = ["VAE", "kl_divergence"]

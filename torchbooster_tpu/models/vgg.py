@@ -1,0 +1,142 @@
+"""VGG-16/19 feature extractor with explicit feature taps.
+
+The reference taps torchvision VGG activations via forward hooks
+(ref offline.py:67-70, adain.py:130-131, online.py:166). JAX has no
+hooks, so tapping is first-class here: ``apply(params, x, taps=[...])``
+returns the activations after the requested layer indices. Layer
+indexing matches torchvision's ``vgg.features`` Sequential numbering
+(conv/relu/pool each count one slot) so reference configs like
+``style_layers: [1, 6, 11, 20]`` work unchanged.
+
+Pretrained torchvision weights can be imported with
+:func:`load_torch_features` (torch is in the image, CPU-only); without
+them the extractor still works as a random-feature critic for tests.
+"""
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from torchbooster_tpu.models import layers as L
+
+# torchvision cfgs: numbers = conv output channels, "M" = maxpool
+_CFGS = {
+    16: (64, 64, "M", 128, 128, "M", 256, 256, 256, "M",
+         512, 512, 512, "M", 512, 512, 512, "M"),
+    19: (64, 64, "M", 128, 128, "M", 256, 256, 256, 256, "M",
+         512, 512, 512, 512, "M", 512, 512, 512, 512, "M"),
+}
+
+IMAGENET_MEAN = jnp.array([0.485, 0.456, 0.406])
+IMAGENET_STD = jnp.array([0.229, 0.224, 0.225])
+
+
+def _plan(depth: int) -> list[tuple[str, Any]]:
+    """Expand a cfg into the per-slot op list mirroring torchvision's
+    Sequential: conv → relu → … → maxpool, one slot each."""
+    plan: list[tuple[str, Any]] = []
+    cin = 3
+    for entry in _CFGS[depth]:
+        if entry == "M":
+            plan.append(("pool", None))
+        else:
+            plan.append(("conv", (cin, int(entry))))
+            plan.append(("relu", None))
+            cin = int(entry)
+    return plan
+
+
+class VGGFeatures:
+    """``init(rng, depth=19)`` → params; ``apply(params, x, taps)`` →
+    list of tapped activations (always also returns the final map when
+    ``taps`` is None)."""
+
+    @staticmethod
+    def init(rng: jax.Array, depth: int = 19,
+             dtype: Any = jnp.float32) -> dict:
+        plan = _plan(depth)
+        n_convs = sum(1 for kind, _ in plan if kind == "conv")
+        ks = iter(jax.random.split(rng, n_convs))
+        params: dict = {}
+        for slot, (kind, spec) in enumerate(plan):
+            if kind == "conv":
+                cin, cout = spec
+                params[f"conv{slot}"] = L.conv_init(next(ks), 3, cin, cout,
+                                                    dtype=dtype)
+        return params
+
+    @staticmethod
+    def _depth_of(params: dict) -> int:
+        # params stay a pure array pytree (jit-donatable); depth is
+        # recoverable from the conv count: 13 convs → vgg16, 16 → vgg19
+        n_convs = sum(1 for k in params if k.startswith("conv"))
+        for depth, cfg in _CFGS.items():
+            if sum(1 for e in cfg if e != "M") == n_convs:
+                return depth
+        raise ValueError(f"unrecognized VGG param tree ({n_convs} convs)")
+
+    @staticmethod
+    def apply(params: dict, x: jax.Array,
+              taps: Sequence[int] | None = None) -> list[jax.Array]:
+        plan = _plan(VGGFeatures._depth_of(params))
+        taps = sorted(set(taps)) if taps is not None else []
+        last = max(taps) if taps else len(plan) - 1
+        out: list[jax.Array] = []
+        for slot, (kind, _) in enumerate(plan):
+            if slot > last:
+                break
+            if kind == "conv":
+                x = L.conv(params[f"conv{slot}"], x)
+            elif kind == "relu":
+                x = jax.nn.relu(x)
+            else:
+                x = L.max_pool(x, 2)
+            if slot in taps:
+                out.append(x)
+        if not taps:
+            out.append(x)
+        return out
+
+    @staticmethod
+    def normalize(x: jax.Array) -> jax.Array:
+        """ImageNet-normalize [0,1] NHWC images (ref offline.py:108)."""
+        return (x - IMAGENET_MEAN.astype(x.dtype)) / IMAGENET_STD.astype(x.dtype)
+
+
+def load_torch_features(params: dict, depth: int = 19) -> dict:
+    """Import torchvision pretrained VGG features into ``params``
+    (NCHW OIHW conv weights → NHWC HWIO). Requires network access for
+    the torchvision download; offline environments keep random weights."""
+    from torchvision.models import vgg16, vgg19  # type: ignore
+
+    model = (vgg19 if depth == 19 else vgg16)(weights="DEFAULT").features
+    out = dict(params)
+    for slot, module in enumerate(model):
+        if module.__class__.__name__ == "Conv2d":
+            w = module.weight.detach().numpy().transpose(2, 3, 1, 0)
+            b = module.bias.detach().numpy()
+            out[f"conv{slot}"] = {"kernel": jnp.asarray(w),
+                                  "bias": jnp.asarray(b)}
+    return out
+
+
+def gram_matrix(features: jax.Array) -> jax.Array:
+    """Per-batch gram of NHWC features (ref offline.py:25-28 computes a
+    single flattened gram over B·C×HW; here the batched NHWC form)."""
+    b, h, w, c = features.shape
+    flat = features.reshape(b, h * w, c)
+    gram = jnp.einsum("bpc,bpd->bcd", flat, flat)
+    return gram / (b * c * h * w)
+
+
+def total_variation(x: jax.Array) -> jax.Array:
+    """Anisotropic TV over NHWC (ref offline.py:31-34)."""
+    a = jnp.abs(x[:, :, :-1, :] - x[:, :, 1:, :]).sum()
+    b = jnp.abs(x[:, :-1, :, :] - x[:, 1:, :, :]).sum()
+    return a + b
+
+
+__all__ = ["IMAGENET_MEAN", "IMAGENET_STD", "VGGFeatures", "gram_matrix",
+           "load_torch_features", "total_variation"]

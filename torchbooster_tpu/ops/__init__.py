@@ -1,0 +1,14 @@
+"""TPU compute ops: attention (jnp + pallas flash), losses, collectives.
+
+The hot-op layer under the model zoo. Everything here is jit-safe and
+shape-static; pallas kernels gate on backend (TPU → custom kernel,
+CPU → interpret/reference path) so the same call sites run everywhere.
+"""
+from torchbooster_tpu.ops.attention import attention, mha_reference
+from torchbooster_tpu.ops.losses import (
+    bce_with_logits, cross_entropy, l2_loss, mse_loss)
+
+__all__ = [
+    "attention", "bce_with_logits", "cross_entropy", "l2_loss",
+    "mha_reference", "mse_loss",
+]

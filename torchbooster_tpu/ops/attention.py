@@ -1,0 +1,63 @@
+"""Attention dispatch: one call site, backend-appropriate kernel.
+
+``attention(q, k, v, causal=...)`` takes (B, S, H, D) tensors and
+routes to the pallas flash kernel on TPU (ops.flash_attention) or the
+fused-by-XLA jnp reference elsewhere. The reference implementation is
+also the numerical ground truth for kernel tests.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+
+def mha_reference(q: jax.Array, k: jax.Array, v: jax.Array,
+                  causal: bool = True,
+                  sm_scale: float | None = None) -> jax.Array:
+    """Plain attention over (B, S, H, D): softmax(QKᵀ/√d + mask)V.
+    Softmax in fp32 regardless of compute dtype (bf16 scores lose too
+    much around the max)."""
+    *_, head_dim = q.shape
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(head_dim)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
+    scores = scores * sm_scale
+    if causal:
+        seq_q, seq_k = scores.shape[-2:]
+        mask = jnp.tril(jnp.ones((seq_q, seq_k), bool), seq_k - seq_q)
+        scores = jnp.where(mask, scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def _on_tpu() -> bool:
+    return jax.default_backend() == "tpu"
+
+
+def attention(q: jax.Array, k: jax.Array, v: jax.Array,
+              causal: bool = True, sm_scale: float | None = None,
+              impl: str = "auto") -> jax.Array:
+    """(B, S, H, D) attention. ``impl``: "auto" (flash on TPU, reference
+    elsewhere), "flash", "flash_interpret" (CPU-debuggable kernel), or
+    "reference"."""
+    if impl == "auto":
+        impl = "flash" if _on_tpu() else "reference"
+    if impl == "reference":
+        return mha_reference(q, k, v, causal, sm_scale)
+
+    from torchbooster_tpu.ops.flash_attention import flash_attention
+
+    b, s_q, h, d = q.shape
+    s_kv = k.shape[1]
+    # fold heads into batch: kernel grid parallelizes over B*H
+    qf = q.transpose(0, 2, 1, 3).reshape(b * h, s_q, d)
+    kf = k.transpose(0, 2, 1, 3).reshape(b * h, s_kv, d)
+    vf = v.transpose(0, 2, 1, 3).reshape(b * h, s_kv, d)
+    out = flash_attention(qf, kf, vf, causal=causal, sm_scale=sm_scale,
+                          interpret=(impl == "flash_interpret"))
+    return out.reshape(b, h, s_q, d).transpose(0, 2, 1, 3)
+
+
+__all__ = ["attention", "mha_reference"]

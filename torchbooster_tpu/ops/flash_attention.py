@@ -1,0 +1,120 @@
+"""Pallas flash attention for TPU: blocked online-softmax attention.
+
+The reference framework has no attention at all (SURVEY §5.7); this is
+the TPU-native hot op for the north-star transformer. Memory-bound
+naive attention materializes the (S, S) score matrix in HBM; this
+kernel streams K/V blocks through VMEM with the online-softmax
+recurrence so scores never leave the chip.
+
+Kernel shape contract: q (B*H, S_q, D), k/v (B*H, S_kv, D). Grid is
+(batch·heads, q_blocks); the kernel loops KV blocks with a fori_loop
+carrying the running (max, sum, accumulator). Causal masking skips
+fully-masked KV blocks (upper-triangle blocks are never even read).
+Block sizes default to MXU/VPU-friendly (128, 128).
+
+On CPU (tests) the kernel runs in interpret mode; `attention` in
+ops.attention only dispatches here on TPU backends.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+NEG_INF = -1e30
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
+                  sm_scale: float, seq_q: int, seq_kv: int):
+    block_q, head_dim = q_ref.shape
+    q_index = pl.program_id(1)
+
+    q = q_ref[:].astype(jnp.float32) * sm_scale
+    m = jnp.full((block_q,), NEG_INF, jnp.float32)
+    l = jnp.zeros((block_q,), jnp.float32)
+    acc = jnp.zeros((block_q, head_dim), jnp.float32)
+
+    # causal alignment matches mha_reference's tril(offset=seq_kv-seq_q):
+    # query row i attends keys [0, i + seq_kv - seq_q] — queries align to
+    # the *last* keys (the decode-with-KV-cache convention)
+    offset = seq_kv - seq_q
+    n_kv_blocks = pl.cdiv(seq_kv, block_k)
+    if causal:
+        # last KV block this q block attends to (block-diagonal boundary)
+        max_k = (q_index + 1) * block_q + offset   # exclusive key bound
+        n_kv_blocks = jnp.minimum(n_kv_blocks, pl.cdiv(max_k, block_k))
+
+    def body(ki, carry):
+        m_prev, l_prev, acc_prev = carry
+        k = k_ref[pl.ds(ki * block_k, block_k), :]
+        v = v_ref[pl.ds(ki * block_k, block_k), :]
+        scores = q @ k.astype(jnp.float32).T        # (block_q, block_k) on MXU
+
+        if causal:
+            q_pos = q_index * block_q + offset + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            scores = jnp.where(q_pos >= k_pos, scores, NEG_INF)
+
+        m_cur = jnp.maximum(m_prev, scores.max(axis=1))
+        correction = jnp.exp(m_prev - m_cur)
+        p = jnp.exp(scores - m_cur[:, None])
+        l_cur = l_prev * correction + p.sum(axis=1)
+        acc_cur = acc_prev * correction[:, None] + p @ v.astype(jnp.float32)
+        return m_cur, l_cur, acc_cur
+
+    m, l, acc = jax.lax.fori_loop(0, n_kv_blocks, body, (m, l, acc))
+    o_ref[:] = (acc / l[:, None]).astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("causal", "sm_scale", "block_q", "block_k", "interpret"))
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = True,
+    sm_scale: float | None = None,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool = False,
+) -> jax.Array:
+    """Blocked attention over (BH, S, D) tensors. Sequence lengths must
+    be multiples of the block sizes (the model layer pads/blocks its
+    sequence axis; static shapes are the XLA contract anyway)."""
+    bh, seq_q, head_dim = q.shape
+    _, seq_kv, _ = k.shape
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(head_dim)
+    block_q = min(block_q, seq_q)
+    block_k = min(block_k, seq_kv)
+    if seq_q % block_q or seq_kv % block_k:
+        raise ValueError(
+            f"sequence lengths ({seq_q}, {seq_kv}) must be multiples of "
+            f"block sizes ({block_q}, {block_k})")
+
+    kernel = functools.partial(
+        _flash_kernel, block_k=block_k, causal=causal, sm_scale=sm_scale,
+        seq_q=seq_q, seq_kv=seq_kv)
+    grid = (bh, seq_q // block_q)
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((None, block_q, head_dim), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, seq_kv, head_dim), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, seq_kv, head_dim), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, block_q, head_dim),
+                               lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, seq_q, head_dim), q.dtype),
+        interpret=interpret,
+    )(q, k, v)
+
+
+__all__ = ["flash_attention"]

@@ -1,0 +1,46 @@
+"""Loss functions the reference recipes use (torch.nn.functional there:
+cross_entropy w/ label smoothing ref resnet.py:61, bce_with_logits ref
+vae.py:112, mse ref adain.py:134-135). All reduce to scalar means and
+compute in fp32 for bf16 safety.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def cross_entropy(logits: jax.Array, labels: jax.Array,
+                  label_smoothing: float = 0.0) -> jax.Array:
+    """Softmax cross entropy with integer labels (+ label smoothing,
+    ref resnet.py:61)."""
+    logits = logits.astype(jnp.float32)
+    n_classes = logits.shape[-1]
+    log_probs = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(log_probs, labels[..., None], axis=-1)[..., 0]
+    if label_smoothing:
+        smooth = -log_probs.mean(axis=-1)
+        nll = (1.0 - label_smoothing) * nll + label_smoothing * smooth
+        del n_classes
+    return nll.mean()
+
+
+def bce_with_logits(logits: jax.Array, targets: jax.Array) -> jax.Array:
+    """Numerically-stable binary cross entropy from logits
+    (ref vae.py:112)."""
+    logits = logits.astype(jnp.float32)
+    targets = targets.astype(jnp.float32)
+    return jnp.mean(
+        jnp.maximum(logits, 0.0) - logits * targets
+        + jnp.log1p(jnp.exp(-jnp.abs(logits))))
+
+
+def mse_loss(pred: jax.Array, target: jax.Array) -> jax.Array:
+    return jnp.mean(jnp.square(pred.astype(jnp.float32)
+                               - target.astype(jnp.float32)))
+
+
+def l2_loss(pred: jax.Array, target: jax.Array) -> jax.Array:
+    return 0.5 * mse_loss(pred, target)
+
+
+__all__ = ["bce_with_logits", "cross_entropy", "l2_loss", "mse_loss"]

@@ -1,0 +1,108 @@
+"""Ring attention: exact attention over a sequence-sharded (`sp`) axis.
+
+Long-context story (SURVEY §5.7 notes the reference has none; here it
+is first-class). The sequence axis of q/k/v is sharded over the mesh's
+``sp`` axis; each device holds an S/sp slice. K/V blocks rotate around
+the ring with ``ppermute`` while each device folds every visiting block
+into its local queries' online-softmax state — attention memory stays
+O(S·S/sp²) per device and the (S, S) score matrix never exists.
+
+The ppermute for step t+1 is issued *before* step t's matmuls so XLA
+can overlap the ICI transfer with MXU work (the ring-attention
+compute/comm overlap, done by the compiler rather than hand-rolled
+double buffering).
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+NEG_INF = -1e30
+
+
+def _ring_local(q: jax.Array, k: jax.Array, v: jax.Array, *, axis: str,
+                sp_size: int, causal: bool, sm_scale: float) -> jax.Array:
+    """Per-device body under shard_map: q/k/v are local
+    (B, S_loc, H, D) chunks; global chunk id = axis_index."""
+    b, s_loc, h, d = q.shape
+    my_chunk = lax.axis_index(axis)
+    perm = [(j, (j + 1) % sp_size) for j in range(sp_size)]
+
+    qf = q.astype(jnp.float32) * sm_scale
+    m = jnp.full((b, h, s_loc), NEG_INF, jnp.float32)
+    l = jnp.zeros((b, h, s_loc), jnp.float32)
+    acc = jnp.zeros((b, s_loc, h, d), jnp.float32)
+
+    iq = lax.broadcasted_iota(jnp.int32, (s_loc, s_loc), 0)
+    ik = lax.broadcasted_iota(jnp.int32, (s_loc, s_loc), 1)
+
+    def step(t, carry):
+        k_t, v_t, m_prev, l_prev, acc_prev = carry
+        # rotate early: independent of the matmuls below → overlappable
+        k_next = lax.ppermute(k_t, axis, perm)
+        v_next = lax.ppermute(v_t, axis, perm)
+
+        src_chunk = (my_chunk - t) % sp_size
+        scores = jnp.einsum("bqhd,bkhd->bhqk", qf,
+                            k_t.astype(jnp.float32))
+        if causal:
+            # src < mine: fully visible; src == mine: lower triangle;
+            # src > mine (wrapped future): fully masked
+            tri = iq >= ik
+            visible = jnp.where(
+                src_chunk == my_chunk, tri,
+                (src_chunk < my_chunk)[None, None])
+            mask = jnp.broadcast_to(visible, scores.shape)
+        else:
+            mask = jnp.ones_like(scores, bool)
+
+        scores = jnp.where(mask, scores, NEG_INF)
+        m_cur = jnp.maximum(m_prev, scores.max(axis=-1))
+        correction = jnp.exp(m_prev - m_cur)
+        # multiply by mask so fully-masked blocks contribute exactly 0
+        # (avoids exp(-inf − -inf) = 1 poisoning)
+        p = jnp.exp(scores - m_cur[..., None]) * mask
+        l_cur = l_prev * correction + p.sum(axis=-1)
+        pv = jnp.einsum("bhqk,bkhd->bqhd", p, v_t.astype(jnp.float32))
+        acc_cur = (acc_prev * correction.transpose(0, 2, 1)[..., None]
+                   + pv)
+        return k_next, v_next, m_cur, l_cur, acc_cur
+
+    _, _, m, l, acc = lax.fori_loop(0, sp_size, step, (k, v, m, l, acc))
+    out = acc / l.transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
+                   causal: bool = True,
+                   sm_scale: float | None = None,
+                   axis: str = "sp") -> jax.Array:
+    """Exact attention over (B, S, H, D) with S sharded on ``axis``.
+
+    Drop-in for :func:`torchbooster_tpu.ops.attention.attention` when the
+    mesh has a real ``sp`` axis. Batch stays sharded over the data axes;
+    heads replicate over ``tp`` handling happens upstream via the qkv
+    projection's output sharding.
+    """
+    *_, head_dim = q.shape
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(head_dim)
+    sp_size = mesh.shape[axis]
+    data = tuple(a for a in ("dp", "fsdp") if a in mesh.axis_names) or None
+    tp = "tp" if "tp" in mesh.axis_names else None
+    spec = P(data, axis, tp, None)
+
+    body = functools.partial(_ring_local, axis=axis, sp_size=sp_size,
+                             causal=causal, sm_scale=sm_scale)
+    fn = shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
+                   out_specs=spec, check_vma=False)
+    return fn(q, k, v)
+
+
+__all__ = ["ring_attention"]

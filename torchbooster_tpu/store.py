@@ -233,10 +233,13 @@ class RecordWriter:
         if self._native:
             lib = _load_native()
             if self._handle is not None:
-                if lib.bs_writer_close(self._handle) != 0:
+                handle, self._handle = self._handle, None
+                # bs_writer_close frees the Writer on every path — clear
+                # the handle BEFORE raising so a second close can never
+                # pass freed memory back into the library
+                if lib.bs_writer_close(handle) != 0:
                     raise OSError(
                         f"finalize failed: {lib.bs_error().decode()}")
-                self._handle = None
         else:
             if self._file is None:
                 return
@@ -248,11 +251,31 @@ class RecordWriter:
             self._file.close()
             self._file = None
 
+    def abort(self) -> None:
+        """Discard the store: release resources and delete the partial
+        file (never leaves a valid-looking header behind)."""
+        if self._native:
+            lib = _load_native()
+            if self._handle is not None:
+                handle, self._handle = self._handle, None
+                lib.bs_writer_close(handle)
+        else:
+            if self._file is not None:
+                self._file.close()
+                self._file = None
+        self.path.unlink(missing_ok=True)
+
     def __enter__(self) -> "RecordWriter":
         return self
 
     def __exit__(self, exc_type, *exc) -> None:
-        self.close()
+        # a crashed with-body must not finalize a valid-looking store:
+        # a half-built file would be indistinguishable from a complete
+        # one to the store-exists checks downstream
+        if exc_type is not None:
+            self.abort()
+        else:
+            self.close()
 
 
 # Reference-parity alias (ref lmdb.py class name, [sic] LMBDReader at
