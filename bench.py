@@ -1,0 +1,174 @@
+"""Headline benchmark: ResNet-50 train-step throughput, images/sec/chip.
+
+BASELINE.json's metric is "img_cls ResNet-50 images/sec/chip". The
+reference publishes no numbers (SURVEY §6), so the baseline is the
+reference's own stack (torch, as shipped in this image: CPU) running the
+same fwd+bwd+SGD step on the same host — measured live each run, with a
+recorded fallback constant if torch is unavailable. ``vs_baseline`` is
+our-chip-throughput / reference-stack-throughput.
+
+Prints exactly ONE JSON line:
+    {"metric": ..., "value": N, "unit": "images/sec/chip", "vs_baseline": N}
+
+Env knobs: BENCH_BATCH, BENCH_STEPS, BENCH_IMAGE (side), BENCH_SKIP_TORCH.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from torchbooster_tpu.models.resnet import ResNet
+from torchbooster_tpu.ops.losses import cross_entropy
+from torchbooster_tpu.utils import TrainState, make_step
+
+# torch-CPU ResNet-50 fwd+bwd+SGD, measured on this image's host
+# (fallback when live measurement is disabled or fails)
+FALLBACK_TORCH_CPU_IPS = 8.0
+
+
+def bench_tpu(batch: int, image: int, steps: int) -> float:
+    rng = jax.random.PRNGKey(0)
+    params = ResNet.init(rng, depth=50, num_classes=1000, stem="imagenet")
+
+    def loss_fn(params, batch_data, rng):
+        del rng
+        logits = ResNet.apply(params, batch_data["images"])
+        return cross_entropy(logits, batch_data["labels"]), {}
+
+    tx = optax.sgd(1e-3, momentum=0.9)
+    state = TrainState.create(params, tx, rng=0)
+    step = make_step(loss_fn, tx, compute_dtype=jnp.bfloat16)
+
+    x = jax.device_put(
+        jax.random.normal(rng, (batch, image, image, 3), jnp.bfloat16))
+    y = jax.device_put(jnp.zeros((batch,), jnp.int32))
+    data = {"images": x, "labels": y}
+
+    # warmup: compile + one steady-state step. Sync via host read of the
+    # loss — on the tunneled device runtime block_until_ready returns
+    # before execution finishes; a D2H of the result cannot.
+    for _ in range(2):
+        state, metrics = step(state, data)
+    np.asarray(metrics["loss"])
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = step(state, data)
+    np.asarray(metrics["loss"])
+    dt = time.perf_counter() - t0
+    return batch * steps / dt
+
+
+def _torch_resnet50():
+    """Standard torchvision-architecture ResNet-50 in plain torch
+    (torchvision is not in this image)."""
+    import torch.nn as nn
+
+    class Bottleneck(nn.Module):
+        def __init__(self, cin, cmid, stride):
+            super().__init__()
+            cout = cmid * 4
+            self.conv1 = nn.Conv2d(cin, cmid, 1, bias=False)
+            self.bn1 = nn.BatchNorm2d(cmid)
+            self.conv2 = nn.Conv2d(cmid, cmid, 3, stride, 1, bias=False)
+            self.bn2 = nn.BatchNorm2d(cmid)
+            self.conv3 = nn.Conv2d(cmid, cout, 1, bias=False)
+            self.bn3 = nn.BatchNorm2d(cout)
+            self.relu = nn.ReLU(inplace=True)
+            self.down = None
+            if stride != 1 or cin != cout:
+                self.down = nn.Sequential(
+                    nn.Conv2d(cin, cout, 1, stride, bias=False),
+                    nn.BatchNorm2d(cout))
+
+        def forward(self, x):
+            idn = self.down(x) if self.down is not None else x
+            y = self.relu(self.bn1(self.conv1(x)))
+            y = self.relu(self.bn2(self.conv2(y)))
+            y = self.bn3(self.conv3(y))
+            return self.relu(y + idn)
+
+    class ResNet50(nn.Module):
+        def __init__(self, classes=1000):
+            super().__init__()
+            self.stem = nn.Sequential(
+                nn.Conv2d(3, 64, 7, 2, 3, bias=False), nn.BatchNorm2d(64),
+                nn.ReLU(inplace=True), nn.MaxPool2d(3, 2, 1))
+            layers, cin = [], 64
+            for cmid, blocks, stride in ((64, 3, 1), (128, 4, 2),
+                                         (256, 6, 2), (512, 3, 2)):
+                for b in range(blocks):
+                    layers.append(Bottleneck(cin, cmid, stride if b == 0 else 1))
+                    cin = cmid * 4
+            self.body = nn.Sequential(*layers)
+            self.pool = nn.AdaptiveAvgPool2d(1)
+            self.fc = nn.Linear(cin, classes)
+
+        def forward(self, x):
+            x = self.pool(self.body(self.stem(x)))
+            return self.fc(x.flatten(1))
+
+    return ResNet50()
+
+
+def bench_torch_cpu(batch: int, image: int, steps: int) -> float:
+    """The reference's stack (torch, as shipped in this image: CPU-only)
+    running the same fwd+bwd+SGD step."""
+    import torch
+    import torch.nn.functional as F
+
+    torch.set_num_threads(os.cpu_count() or 8)
+    model = _torch_resnet50()
+    opt = torch.optim.SGD(model.parameters(), lr=1e-3, momentum=0.9)
+    x = torch.randn(batch, 3, image, image)
+    y = torch.zeros(batch, dtype=torch.long)
+
+    def one_step():
+        opt.zero_grad(set_to_none=True)
+        loss = F.cross_entropy(model(x), y)
+        loss.backward()
+        opt.step()
+
+    one_step()  # warmup
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        one_step()
+    dt = time.perf_counter() - t0
+    return batch * steps / dt
+
+
+def main() -> None:
+    on_tpu = jax.default_backend() not in ("cpu",)
+    batch = int(os.environ.get("BENCH_BATCH", 128 if on_tpu else 8))
+    image = int(os.environ.get("BENCH_IMAGE", 224 if on_tpu else 64))
+    steps = int(os.environ.get("BENCH_STEPS", 20 if on_tpu else 3))
+
+    value = bench_tpu(batch, image, steps)
+
+    baseline = FALLBACK_TORCH_CPU_IPS
+    if not os.environ.get("BENCH_SKIP_TORCH"):
+        try:
+            tb = min(batch, 16)
+            baseline = bench_torch_cpu(tb, image, max(2, steps // 8))
+        except Exception as exc:  # noqa: BLE001 — baseline is best-effort
+            print(f"torch baseline failed ({exc}); using fallback",
+                  file=sys.stderr)
+
+    print(json.dumps({
+        "metric": "ResNet-50 train images/sec/chip "
+                  f"(batch {batch}, {image}x{image}, bf16)",
+        "value": round(value, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(value / baseline, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()
