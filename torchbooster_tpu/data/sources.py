@@ -125,16 +125,29 @@ def _try_huggingface(conf: Any, split: Split):
     name = conf.name
     task = getattr(conf, "task", "") or None
     try:
-        # metadata-only split listing (one fetch, not a load per probe)
+        # metadata-only split listing (one fetch, not a load per probe);
+        # when the listing itself fails (offline with a cached dataset,
+        # transient hub error) fall back to probing each needed split
+        # from cache — real test/validation splits must win over the
+        # 80/20 train fallback whenever they are loadable
+        available: set[str] | None
         try:
             from datasets import get_dataset_split_names  # type: ignore
 
             available = set(get_dataset_split_names(name, task))
         except Exception:
-            available = {"train"}
+            available = None
 
         def has_split(wanted: str) -> bool:
-            return wanted in available
+            if available is not None:
+                return wanted in available
+            if wanted == "train":
+                return True
+            try:
+                load_dataset(name, task, split=f"{wanted}[:1]")
+                return True
+            except Exception:
+                return False
 
         # 80/20 train-split fallback when no test/validation split
         # exists (ref config.py:589-614) — splits must be DISJOINT:

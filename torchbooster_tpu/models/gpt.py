@@ -113,6 +113,11 @@ class GPT:
               remat: bool = True,
               attn_impl: str = "auto") -> jax.Array:
         b, s = ids.shape
+        if s > cfg.seq_len:
+            # jnp.take would silently fill NaN embeddings for positions
+            # beyond the wpe table; shapes are static, so fail loudly
+            raise ValueError(
+                f"sequence length {s} exceeds cfg.seq_len={cfg.seq_len}")
         n_heads, d = cfg.n_heads, cfg.d_model
         head_dim = d // n_heads
 

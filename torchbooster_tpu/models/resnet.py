@@ -32,10 +32,6 @@ _STAGE_WIDTHS = (64, 128, 256, 512)
 _GROUPS = 32
 
 
-def _norm(rng_unused: None, c: int, dtype: Any) -> dict:
-    return L.norm_init(c, dtype)
-
-
 def _basic_block_init(rng: jax.Array, cin: int, cout: int, stride: int,
                       dtype: Any) -> dict:
     ks = jax.random.split(rng, 3)

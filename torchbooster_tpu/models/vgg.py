@@ -18,6 +18,7 @@ from typing import Any, Sequence
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from torchbooster_tpu.models import layers as L
 
@@ -29,8 +30,10 @@ _CFGS = {
          512, 512, 512, 512, "M", 512, 512, 512, 512, "M"),
 }
 
-IMAGENET_MEAN = jnp.array([0.485, 0.456, 0.406])
-IMAGENET_STD = jnp.array([0.229, 0.224, 0.225])
+# plain numpy: importing the models package must not initialize the JAX
+# backend (multi-host setups call jax.distributed.initialize first)
+IMAGENET_MEAN = np.array([0.485, 0.456, 0.406], np.float32)
+IMAGENET_STD = np.array([0.229, 0.224, 0.225], np.float32)
 
 
 def _plan(depth: int) -> list[tuple[str, Any]]:
@@ -102,15 +105,20 @@ class VGGFeatures:
     @staticmethod
     def normalize(x: jax.Array) -> jax.Array:
         """ImageNet-normalize [0,1] NHWC images (ref offline.py:108)."""
-        return (x - IMAGENET_MEAN.astype(x.dtype)) / IMAGENET_STD.astype(x.dtype)
+        mean = jnp.asarray(IMAGENET_MEAN, x.dtype)
+        std = jnp.asarray(IMAGENET_STD, x.dtype)
+        return (x - mean) / std
 
 
-def load_torch_features(params: dict, depth: int = 19) -> dict:
+def load_torch_features(params: dict) -> dict:
     """Import torchvision pretrained VGG features into ``params``
-    (NCHW OIHW conv weights → NHWC HWIO). Requires network access for
-    the torchvision download; offline environments keep random weights."""
+    (NCHW OIHW conv weights → NHWC HWIO); the VGG depth is derived from
+    the param tree so weights cannot be loaded into a mismatched model.
+    Requires network access for the torchvision download; offline
+    environments keep random weights."""
     from torchvision.models import vgg16, vgg19  # type: ignore
 
+    depth = VGGFeatures._depth_of(params)
     model = (vgg19 if depth == 19 else vgg16)(weights="DEFAULT").features
     out = dict(params)
     for slot, module in enumerate(model):

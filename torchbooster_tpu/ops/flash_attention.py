@@ -7,9 +7,13 @@ kernel streams K/V blocks through VMEM with the online-softmax
 recurrence so scores never leave the chip.
 
 Kernel shape contract: q (B*H, S_q, D), k/v (B*H, S_kv, D). Grid is
-(batch·heads, q_blocks); the kernel loops KV blocks with a fori_loop
-carrying the running (max, sum, accumulator). Causal masking skips
-fully-masked KV blocks (upper-triangle blocks are never even read).
+(batch·heads, q_blocks, kv_blocks) with the KV dimension innermost and
+sequential ("arbitrary" semantics): each grid step sees only one
+(block_k, D) K/V tile in VMEM — VMEM use is O(block_q·D + block_k·D)
+regardless of sequence length — while the online-softmax state
+(running max / sum / accumulator) persists in VMEM scratch across the
+KV sweep. Causal masking skips fully-masked KV blocks via pl.when
+(upper-triangle tiles cost one predicated no-op, no MXU work).
 Block sizes default to MXU/VPU-friendly (128, 128).
 
 On CPU (tests) the kernel runs in interpret mode; `attention` in
@@ -23,52 +27,63 @@ import math
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
-                  sm_scale: float, seq_q: int, seq_kv: int):
-    block_q, head_dim = q_ref.shape
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
+                  block_q: int, block_k: int, causal: bool, sm_scale: float,
+                  seq_q: int, seq_kv: int):
+    head_dim = q_ref.shape[-1]
     q_index = pl.program_id(1)
+    kv_index = pl.program_id(2)
+    n_kv = pl.num_programs(2)
 
-    q = q_ref[:].astype(jnp.float32) * sm_scale
-    m = jnp.full((block_q,), NEG_INF, jnp.float32)
-    l = jnp.zeros((block_q,), jnp.float32)
-    acc = jnp.zeros((block_q, head_dim), jnp.float32)
+    @pl.when(kv_index == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
 
     # causal alignment matches mha_reference's tril(offset=seq_kv-seq_q):
     # query row i attends keys [0, i + seq_kv - seq_q] — queries align to
     # the *last* keys (the decode-with-KV-cache convention)
     offset = seq_kv - seq_q
-    n_kv_blocks = pl.cdiv(seq_kv, block_k)
     if causal:
-        # last KV block this q block attends to (block-diagonal boundary)
-        max_k = (q_index + 1) * block_q + offset   # exclusive key bound
-        n_kv_blocks = jnp.minimum(n_kv_blocks, pl.cdiv(max_k, block_k))
+        # any key in this tile visible to any query in the q tile?
+        visible = (q_index + 1) * block_q + offset > kv_index * block_k
+    else:
+        visible = True
 
-    def body(ki, carry):
-        m_prev, l_prev, acc_prev = carry
-        k = k_ref[pl.ds(ki * block_k, block_k), :]
-        v = v_ref[pl.ds(ki * block_k, block_k), :]
-        scores = q @ k.astype(jnp.float32).T        # (block_q, block_k) on MXU
+    @pl.when(visible)
+    def _body():
+        q = q_ref[:].astype(jnp.float32) * sm_scale
+        k = k_ref[:]
+        v = v_ref[:]
+        scores = q @ k.astype(jnp.float32).T      # (block_q, block_k) on MXU
 
         if causal:
             q_pos = q_index * block_q + offset + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
-            k_pos = ki * block_k + jax.lax.broadcasted_iota(
+            k_pos = kv_index * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
             scores = jnp.where(q_pos >= k_pos, scores, NEG_INF)
 
+        m_prev = m_scr[:, 0]
+        l_prev = l_scr[:, 0]
         m_cur = jnp.maximum(m_prev, scores.max(axis=1))
         correction = jnp.exp(m_prev - m_cur)
         p = jnp.exp(scores - m_cur[:, None])
-        l_cur = l_prev * correction + p.sum(axis=1)
-        acc_cur = acc_prev * correction[:, None] + p @ v.astype(jnp.float32)
-        return m_cur, l_cur, acc_cur
+        l_scr[:, 0] = l_prev * correction + p.sum(axis=1)
+        m_scr[:, 0] = m_cur
+        acc_scr[:] = (acc_scr[:] * correction[:, None]
+                      + p @ v.astype(jnp.float32))
 
-    m, l, acc = jax.lax.fori_loop(0, n_kv_blocks, body, (m, l, acc))
-    o_ref[:] = (acc / l[:, None]).astype(o_ref.dtype)
+    @pl.when(kv_index == n_kv - 1)
+    def _finalize():
+        o_ref[:] = (acc_scr[:] / l_scr[:, 0][:, None]).astype(o_ref.dtype)
+    del head_dim
 
 
 @functools.partial(
@@ -99,20 +114,27 @@ def flash_attention(
             f"block sizes ({block_q}, {block_k})")
 
     kernel = functools.partial(
-        _flash_kernel, block_k=block_k, causal=causal, sm_scale=sm_scale,
-        seq_q=seq_q, seq_kv=seq_kv)
-    grid = (bh, seq_q // block_q)
+        _flash_kernel, block_q=block_q, block_k=block_k, causal=causal,
+        sm_scale=sm_scale, seq_q=seq_q, seq_kv=seq_kv)
+    grid = (bh, seq_q // block_q, seq_kv // block_k)
     return pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((None, block_q, head_dim), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((None, seq_kv, head_dim), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((None, seq_kv, head_dim), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, block_q, head_dim), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((None, block_k, head_dim), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((None, block_k, head_dim), lambda b, i, j: (b, j, 0)),
         ],
         out_specs=pl.BlockSpec((None, block_q, head_dim),
-                               lambda b, i: (b, i, 0)),
+                               lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, seq_q, head_dim), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 1), jnp.float32),       # running max
+            pltpu.VMEM((block_q, 1), jnp.float32),       # running sum
+            pltpu.VMEM((block_q, head_dim), jnp.float32),  # accumulator
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(q, k, v)
 
