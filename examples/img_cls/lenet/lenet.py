@@ -1,0 +1,139 @@
+"""LeNet on MNIST — the minimal end-to-end recipe.
+
+TPU-native analogue of reference ``examples/img_cls/lenet/lenet.py``
+(123 LoC): same skeleton — ``Config.load`` → ``utils.seed`` →
+``utils.boost`` → ``dist.launch(main)`` (ref lenet.py:111-124) — but the
+per-batch body (ref lenet.py:63-73: H2D copy, autocast forward, loss,
+``utils.step``, ``.item()`` sync) is ONE compiled train step: forward,
+backward, optimizer, and schedule fused by XLA, batch sharded over the
+mesh's data axes, metrics accumulated without per-step host syncs
+(SURVEY §3.3's ``.item()`` hazard).
+
+Run from this directory: ``python lenet.py``.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+from tqdm import tqdm
+
+import torchbooster_tpu.distributed as dist
+import torchbooster_tpu.utils as utils
+from torchbooster_tpu.config import (
+    BaseConfig,
+    DatasetConfig,
+    EnvConfig,
+    LoaderConfig,
+    OptimizerConfig,
+    SchedulerConfig,
+)
+from torchbooster_tpu.dataset import Split
+from torchbooster_tpu.metrics import MetricsAccumulator, accuracy
+from torchbooster_tpu.models import LeNet
+from torchbooster_tpu.ops.losses import cross_entropy
+
+
+@dataclass
+class Config(BaseConfig):
+    """ref lenet.py:24-34 (epochs/seed + the five bundled configs)."""
+
+    epochs: int
+    seed: int
+
+    env: EnvConfig
+    loader: LoaderConfig
+    optim: OptimizerConfig
+    scheduler: SchedulerConfig
+    dataset: DatasetConfig
+
+
+def unpack(batch):
+    """(images, labels) from tuple batches (synthetic/store) or dict
+    batches (HuggingFace rows, ref config.py:589-614)."""
+    if isinstance(batch, dict):
+        images = batch.get("image", batch.get("images"))
+        labels = batch.get("label", batch.get("labels"))
+        return images, labels
+    return batch
+
+
+def make_loss_fn(train: bool):
+    def loss_fn(params, batch, rng):
+        images, labels = unpack(batch)
+        if images.ndim == 3:                   # grayscale w/o channel dim
+            images = images[..., None]
+        logits = LeNet.apply(params, images, train=train, rng=rng)
+        return cross_entropy(logits, labels), {"acc": accuracy(logits, labels)}
+    return loss_fn
+
+
+def run_epoch(conf, loader, state, train_step, desc: str):
+    """One training epoch (ref lenet.py:51-75's ``step`` loop)."""
+    metrics = MetricsAccumulator()
+    bar = tqdm(loader, desc=desc, disable=not dist.is_primary())
+    for batch in bar:
+        batch = conf.env.shard_batch(batch)
+        state, step_metrics = train_step(state, batch)
+        metrics.update(step_metrics)          # async: no per-step sync
+    return state, metrics.compute()           # one device→host pull/epoch
+
+
+def evaluate(conf, loader, params, eval_step, rng):
+    metrics = MetricsAccumulator()
+    for batch in tqdm(loader, desc="test", disable=not dist.is_primary()):
+        batch = conf.env.shard_batch(batch)
+        metrics.update(eval_step(params, batch, rng))
+    return metrics.compute()
+
+
+def main(conf: Config) -> dict:
+    rng = utils.seed(conf.seed)
+
+    train_set = conf.dataset.make(Split.TRAIN)
+    test_set = conf.dataset.make(Split.TEST)
+    train_loader = conf.loader.make(train_set, shuffle=True,
+                                    distributed=conf.env.distributed,
+                                    seed=conf.seed)
+    test_loader = conf.loader.make(test_set, shuffle=False,
+                                   distributed=conf.env.distributed)
+
+    # params replicated over the mesh (the DDP-broadcast analogue,
+    # ref conf.env.make(model) lenet.py:42)
+    params = conf.env.make(LeNet.init(rng))
+    schedule = conf.scheduler.make(conf.optim)
+    tx = conf.optim.make(schedule)
+    state = utils.TrainState.create(params, tx, rng=rng)
+
+    train_step = utils.make_step(make_loss_fn(train=True), tx,
+                                 compute_dtype=conf.env.compute_dtype())
+    eval_step = utils.make_eval_step(make_loss_fn(train=False),
+                                     compute_dtype=conf.env.compute_dtype())
+
+    results = {}
+    for epoch in range(conf.epochs):
+        state, train_metrics = run_epoch(
+            conf, train_loader, state, train_step, f"train {epoch}")
+        test_metrics = evaluate(conf, test_loader, state.params, eval_step,
+                                jax.random.PRNGKey(conf.seed))
+        results = {"epoch": epoch,
+                   **{f"train_{k}": v for k, v in train_metrics.items()},
+                   **{f"test_{k}": v for k, v in test_metrics.items()}}
+        if dist.is_primary():
+            print({k: round(v, 4) if isinstance(v, float) else v
+                   for k, v in results.items()})
+    return results
+
+
+if __name__ == "__main__":
+    # ref lenet.py:111-124: hardcoded config path, seed, boost, launch
+    conf = Config.load("lenet.yml")
+    utils.boost()
+    dist.launch(
+        main,
+        conf.env.n_devices,
+        conf.env.n_machine,
+        conf.env.machine_rank,
+        conf.env.dist_url,
+        args=(conf,),
+    )

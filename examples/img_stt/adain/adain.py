@@ -1,0 +1,184 @@
+"""AdaIN arbitrary style transfer — two datasets, two loaders.
+
+TPU-native analogue of reference ``examples/img_stt/adain/adain.py``
+(201 LoC): **two concurrent dataloaders zipped** via ``iter_loader``
+(ref adain.py:136-141), two user dataset configs (COCO content +
+paintings style, ref adain.py:67-94), the AdaIN op re-statting content
+features to style statistics (ref adain.py:55-63), and a decoder trained
+from VGG19 relu4_1 features with content + style (mean/std matching)
+losses (ref adain.py:126-141). The VGG19 encoder is frozen — never part
+of the TrainState.
+
+Zero-egress: both dataset configs fall back to deterministic procedural
+images when no local record store exists.
+
+Run from this directory: ``python adain.py``.
+"""
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from tqdm import tqdm
+
+import torchbooster_tpu.distributed as dist
+import torchbooster_tpu.utils as utils
+from torchbooster_tpu.config import (
+    BaseConfig,
+    DatasetConfig,
+    EnvConfig,
+    LoaderConfig,
+    OptimizerConfig,
+    SchedulerConfig,
+)
+from torchbooster_tpu.dataset import Split
+from torchbooster_tpu.data import resolve_dataset
+from torchbooster_tpu.data.sources import ProceduralImages
+from torchbooster_tpu.metrics import MetricsAccumulator
+from torchbooster_tpu.models import VGGFeatures
+from torchbooster_tpu.models.stylenet import AdaINDecoder, adain, mu_std
+from torchbooster_tpu.ops.losses import mse_loss
+
+RELU4_1 = 20                      # torchvision vgg19.features slot
+
+
+@dataclass
+class ContentDatasetConfig(DatasetConfig):
+    """COCO content photos (ref CocoDatasetConfig adain.py:67-75)."""
+
+    image_size: int = 256
+    n_images: int = 2_048
+    palette: float = 0.0
+
+    def make(self, split: Split, **kwargs):
+        from torchbooster_tpu.data.sources import StoreDataset
+
+        if StoreDataset.store_path(self.root, split).exists():
+            return resolve_dataset(self, split, **kwargs)
+        logging.warning("no %r store (offline?); procedural images",
+                        self.name)
+        import zlib
+
+        return ProceduralImages(self.n_images, self.image_size,
+                                seed=zlib.crc32(self.name.encode()) % 1_000,
+                                palette=self.palette)
+
+
+@dataclass
+class PaintingsDatasetConfig(ContentDatasetConfig):
+    """Paintings style corpus (ref scrape side-effect adain.py:77-94);
+    same resolution contract, skewed palette by default."""
+
+    palette: float = 0.5
+
+
+@dataclass
+class Config(BaseConfig):
+    """ref adain.py:97-112 — note TWO dataset configs."""
+
+    n_iter: int
+    seed: int
+    style_weight: float
+    sample_every: int
+    samples_path: str
+
+    env: EnvConfig
+    loader: LoaderConfig
+    optim: OptimizerConfig
+    scheduler: SchedulerConfig
+    content: ContentDatasetConfig
+    style: PaintingsDatasetConfig
+
+
+def main(conf: Config) -> dict:
+    rng = utils.seed(conf.seed)
+
+    content_loader = conf.loader.make(conf.content.make(Split.TRAIN),
+                                      shuffle=True,
+                                      distributed=conf.env.distributed,
+                                      seed=conf.seed)
+    style_loader = conf.loader.make(conf.style.make(Split.TRAIN),
+                                    shuffle=True,
+                                    distributed=conf.env.distributed,
+                                    seed=conf.seed + 1)
+
+    vgg = VGGFeatures.init(rng, depth=19)
+    try:
+        from torchbooster_tpu.models.vgg import load_torch_features
+
+        vgg = load_torch_features(vgg)
+    except Exception:
+        pass
+    vgg = conf.env.make(vgg)
+    style_taps = [1, 6, 11, RELU4_1]            # relu1_1..4_1 (adain.py:130)
+
+    def encode(x, taps):
+        return VGGFeatures.apply(vgg, VGGFeatures.normalize(x), taps=taps)
+
+    def loss_fn(params, batch, rng):
+        del rng
+        content_imgs, style_imgs = batch
+        c_feat = encode(content_imgs, [RELU4_1])[0]
+        s_feats = encode(style_imgs, style_taps)
+        target = adain(s_feats[-1], c_feat)      # ref adain.py:126
+        out = jax.nn.sigmoid(AdaINDecoder.apply(params, target))
+        o_feats = encode(out, style_taps)
+
+        c_loss = mse_loss(o_feats[-1], target)   # ref adain.py:134
+        s_loss = 0.0                             # ref adain.py:135-139
+        for o, s in zip(o_feats, s_feats):
+            (o_mu, o_std), (s_mu, s_std) = mu_std(o), mu_std(s)
+            s_loss = s_loss + mse_loss(o_mu, s_mu) + mse_loss(o_std, s_std)
+        return c_loss + conf.style_weight * s_loss, {
+            "content": c_loss, "style": s_loss}
+
+    params = conf.env.make(AdaINDecoder.init(rng))
+    schedule = conf.scheduler.make(conf.optim)
+    tx = conf.optim.make(schedule)
+    state = utils.TrainState.create(params, tx, rng=rng)
+    step = utils.make_step(loss_fn, tx,
+                           compute_dtype=conf.env.compute_dtype())
+
+    samples_dir = Path(conf.samples_path)
+    metrics = MetricsAccumulator()
+    results = {}
+    # two loaders zipped through one infinite iterator (ref adain.py:136-141)
+    pairs = zip(utils.iter_loader(content_loader),
+                utils.iter_loader(style_loader))
+    bar = tqdm(range(conf.n_iter), desc="train",
+               disable=not dist.is_primary())
+    for it in bar:
+        (epoch, content_batch), (_, style_batch) = next(pairs)
+        batch = (conf.env.shard_batch(content_batch),
+                 conf.env.shard_batch(style_batch))
+        state, step_metrics = step(state, batch)
+        metrics.update(step_metrics)
+        if (it + 1) % conf.sample_every == 0:
+            results = {"iter": it + 1, "epoch": epoch, **metrics.compute()}
+            metrics.reset()
+            if dist.is_primary():
+                bar.set_postfix({k: f"{v:.4f}" for k, v in results.items()
+                                 if isinstance(v, float)})
+    if dist.is_primary():
+        # final stylization preview
+        (_, content_batch), (_, style_batch) = next(pairs)
+        c = jnp.asarray(content_batch[:1])
+        s = jnp.asarray(style_batch[:1])
+        c_feat = encode(c, [RELU4_1])[0]
+        s_feat = encode(s, [RELU4_1])[0]
+        out = jax.nn.sigmoid(
+            AdaINDecoder.apply(state.params, adain(s_feat, c_feat)))
+        samples_dir.mkdir(parents=True, exist_ok=True)
+        np.save(samples_dir / "adain_final.npy", np.asarray(out))
+    return results
+
+
+if __name__ == "__main__":
+    conf = Config.load("adain.yml")
+    utils.boost()
+    dist.launch(main, conf.env.n_devices, conf.env.n_machine,
+                conf.env.machine_rank, conf.env.dist_url, args=(conf,))

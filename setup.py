@@ -1,0 +1,4 @@
+"""Packaging shim (ref setup.py:5-16); metadata lives in pyproject.toml."""
+from setuptools import setup
+
+setup()

@@ -1,0 +1,135 @@
+"""Example-recipe smoke tests — the integration layer the reference
+never had (its examples were manual-only GPU runs, SURVEY §4). Each test
+loads the recipe's real YAML, shrinks the sizes, and runs ``main`` to
+completion on the virtual CPU mesh. The distributed variants flip
+``env.distributed: true`` over the 8-device mesh with ZERO user-code
+change — the product contract (SURVEY §7 minimum E2E slice).
+"""
+from __future__ import annotations
+
+import importlib.util
+import sys
+from pathlib import Path
+
+import pytest
+
+EXAMPLES = Path(__file__).resolve().parent.parent / "examples"
+
+
+def load_example(monkeypatch, *parts: str):
+    """Import ``examples/<parts>/<name>.py`` under a unique module name,
+    chdir'd into its directory (configs are CWD-relative, ref
+    lenet.py:112)."""
+    directory = EXAMPLES.joinpath(*parts)
+    name = parts[-1]
+    monkeypatch.chdir(directory)
+    spec = importlib.util.spec_from_file_location(
+        f"example_{'_'.join(parts)}", directory / f"{name}.py")
+    module = importlib.util.module_from_spec(spec)
+    sys.modules[spec.name] = module
+    spec.loader.exec_module(module)
+    return module
+
+
+def tiny_env(conf, distributed: bool = False):
+    conf.env.precision = "fp32"
+    conf.env.distributed = distributed
+    conf.env.mesh = "dp"
+    conf.env.n_devices = 0
+    if hasattr(conf, "dataset") and hasattr(conf.dataset, "n_examples"):
+        conf.dataset.n_examples = 256
+
+
+def test_lenet(monkeypatch, tmp_path):
+    lenet = load_example(monkeypatch, "img_cls", "lenet")
+    conf = lenet.Config.load("lenet.yml")
+    conf.epochs, conf.loader.batch_size = 1, 32
+    tiny_env(conf)
+    results = lenet.main(conf)
+    assert 0.0 <= results["test_acc"] <= 1.0
+    assert results["train_loss"] > 0.0
+
+
+def test_lenet_distributed_flip(monkeypatch):
+    """The one-switch product: same recipe, 8-way dp mesh."""
+    lenet = load_example(monkeypatch, "img_cls", "lenet")
+    conf = lenet.Config.load("lenet.yml")
+    conf.epochs, conf.loader.batch_size = 1, 32
+    tiny_env(conf, distributed=True)
+    results = lenet.main(conf)
+    assert 0.0 <= results["test_acc"] <= 1.0
+
+
+def test_resnet(monkeypatch):
+    resnet = load_example(monkeypatch, "img_cls", "resnet")
+    conf = resnet.Config.load("resnet.yml")
+    conf.epochs, conf.loader.batch_size = 1, 32
+    conf.freeze_backbone = True
+    tiny_env(conf)
+    # shrink the dataset: one batch is enough to exercise the loop
+    conf.dataset.name = "synthetic_cifar10"
+    results = resnet.main(conf)
+    assert results["train_loss"] > 0.0
+
+
+def test_vae(monkeypatch, tmp_path):
+    vae = load_example(monkeypatch, "img_gen", "vae")
+    conf = vae.Config.load("vae.yml")
+    conf.epochs, conf.loader.batch_size = 1, 32
+    conf.samples_path = str(tmp_path / "samples.npy")
+    conf.n_samples = 4
+    tiny_env(conf)
+    results = vae.main(conf)
+    assert results["kld"] >= 0.0
+    assert (tmp_path / "samples.npy").exists()
+
+
+def test_gan(monkeypatch, tmp_path):
+    gan = load_example(monkeypatch, "img_gen", "gan")
+    conf = gan.Config.load("gan.yml")
+    conf.epochs, conf.loader.batch_size = 1, 32
+    conf.samples_path = str(tmp_path / "samples.npy")
+    conf.n_samples = 4
+    tiny_env(conf)
+    results = gan.main(conf)
+    assert "d_loss" in results and "g_loss" in results and "gp" in results
+    assert (tmp_path / "samples.npy").exists()
+
+
+def test_offline(monkeypatch, tmp_path):
+    offline = load_example(monkeypatch, "img_stt", "offline")
+    conf = offline.Config.load("offline.yml")
+    conf.n_iter, conf.image_size = 2, 32
+    conf.output_path = str(tmp_path / "out.npy")
+    results = offline.main(conf)
+    assert results["loss"] >= 0.0
+    assert (tmp_path / "out.npy").exists()
+    # scalar-for-list coercion (the reference crashed here, SURVEY §2.14)
+    assert conf.content_layers == [29]
+
+
+def test_online(monkeypatch, tmp_path):
+    online = load_example(monkeypatch, "img_stt", "online")
+    conf = online.Config.load("online.yml")
+    conf.n_iter, conf.sample_every = 2, 2
+    conf.dataset.image_size, conf.dataset.n_images = 32, 16
+    conf.loader.batch_size = 4
+    conf.samples_path = str(tmp_path / "samples")
+    tiny_env(conf)
+    results = online.main(conf)
+    assert results["loss"] >= 0.0
+    assert list(Path(conf.samples_path).glob("styled_*.npy"))
+
+
+def test_adain(monkeypatch, tmp_path):
+    adain = load_example(monkeypatch, "img_stt", "adain")
+    conf = adain.Config.load("adain.yml")
+    conf.n_iter, conf.sample_every = 2, 2
+    for dataset in (conf.content, conf.style):
+        dataset.image_size, dataset.n_images = 32, 16
+    conf.loader.batch_size = 4
+    conf.samples_path = str(tmp_path / "samples")
+    tiny_env(conf)
+    results = adain.main(conf)
+    assert results["style"] >= 0.0
+    assert (Path(conf.samples_path) / "adain_final.npy").exists()

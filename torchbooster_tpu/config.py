@@ -489,6 +489,7 @@ class DatasetConfig(BaseConfig):
     name: str = "mnist"
     root: str = "dataset"
     task: str = ""                     # HF config name (ref task field)
+    n_examples: int = 0                # synthetic-family size override (0 = default)
 
     def make(
         self,

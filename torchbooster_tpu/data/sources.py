@@ -27,7 +27,7 @@ from typing import Any, Callable
 
 import numpy as np
 
-from torchbooster_tpu.dataset import ArrayDataset, BaseDataset, Split
+from torchbooster_tpu.dataset import ArrayDataset, BaseDataset, Dataset, Split
 
 _REGISTRY: dict[str, Callable] = {}
 
@@ -59,22 +59,71 @@ def _synthetic_classification(n: int, shape: tuple, classes: int,
     return ArrayDataset(images.astype(np.float32), labels)
 
 
+def _synthetic_size(conf: Any, split: Split, default_train: int) -> int:
+    n = getattr(conf, "n_examples", 0) or 0
+    if n:
+        return n if split == Split.TRAIN else max(n // 8, 1)
+    return default_train if split == Split.TRAIN else default_train // 8
+
+
 @register_dataset("synthetic_mnist")
 def _synthetic_mnist(conf: Any, split: Split, **kw):
-    n = 8_192 if split == Split.TRAIN else 1_024
+    n = _synthetic_size(conf, split, 8_192)
     return _synthetic_classification(n, (28, 28, 1), 10, split)
 
 
 @register_dataset("synthetic_cifar10")
 def _synthetic_cifar10(conf: Any, split: Split, **kw):
-    n = 8_192 if split == Split.TRAIN else 1_024
+    n = _synthetic_size(conf, split, 8_192)
     return _synthetic_classification(n, (32, 32, 3), 10, split)
 
 
 @register_dataset("synthetic_imagenet")
 def _synthetic_imagenet(conf: Any, split: Split, **kw):
-    n = 2_048 if split == Split.TRAIN else 256
+    n = _synthetic_size(conf, split, 2_048)
     return _synthetic_classification(n, (224, 224, 3), 1000, split)
+
+
+def procedural_image(size: int, seed: int, palette: float = 0.0) -> np.ndarray:
+    """One deterministic procedural RGB image in [0,1]: a smooth random
+    color field (8×8 noise bicubic-upsampled). The zero-egress stand-in
+    for downloaded photos (COCO/style images in the reference's
+    img_stt recipes). ``palette`` skews the color distribution so
+    different corpora (photos vs paintings) look different."""
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(seed % (2 ** 32 - 1))
+    base = rng.rand(8, 8, 3).astype(np.float32)
+    if palette:
+        base = np.clip(base + palette * np.sin(base * np.pi), 0.0, 1.0)
+    image = jax.image.resize(jnp.asarray(base), (size, size, 3), "bicubic")
+    return np.clip(np.asarray(image, np.float32), 0.0, 1.0)
+
+
+class ProceduralImages(Dataset):
+    """Per-index deterministic procedural RGB images (offline stand-in
+    for an image corpus; see :func:`procedural_image`)."""
+
+    def __init__(self, n: int, size: int, seed: int = 0,
+                 palette: float = 0.0):
+        self.n, self.size, self.seed, self.palette = n, size, seed, palette
+
+    def __len__(self) -> int:
+        return self.n
+
+    def __getitem__(self, index: int) -> np.ndarray:
+        return procedural_image(self.size,
+                                self.seed * 1_000_003 + index,
+                                self.palette)
+
+
+@register_dataset("synthetic_images")
+def _synthetic_images(conf: Any, split: Split, size: int = 256,
+                      palette: float = 0.0, **kw):
+    n = _synthetic_size(conf, split, 2_048)
+    seed = {"train": 0, "validation": 1, "test": 2}[split.value]
+    return ProceduralImages(n, size, seed=seed, palette=palette)
 
 
 @register_dataset("synthetic_lm")
@@ -82,7 +131,7 @@ def _synthetic_lm(conf: Any, split: Split, seq_len: int = 256,
                   vocab: int = 1_024, **kw):
     """Token streams from a fixed-transition Markov chain — compressible
     structure a language model can actually learn."""
-    n = 4_096 if split == Split.TRAIN else 512
+    n = _synthetic_size(conf, split, 4_096)
     rng = np.random.RandomState(0 if split == Split.TRAIN else 1)
     transitions = np.random.RandomState(7).randint(0, vocab, (vocab, 4))
     tokens = np.empty((n, seq_len), np.int32)
@@ -219,4 +268,5 @@ def resolve_dataset(conf: Any, split: Split | str, download: bool = True,
     return dataset
 
 
-__all__ = ["HFDataset", "StoreDataset", "register_dataset", "resolve_dataset"]
+__all__ = ["HFDataset", "ProceduralImages", "StoreDataset",
+           "procedural_image", "register_dataset", "resolve_dataset"]
