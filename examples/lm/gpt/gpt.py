@@ -1,0 +1,159 @@
+"""GPT language modeling — the north-star recipe (SURVEY §6).
+
+The reference has no transformer at all (SURVEY §5.7); this recipe is
+the framework's stretch case: the full 4-D parallel train step driven
+entirely from YAML. ``env.mesh`` picks the topology —
+
+- ``dp``                 : pure data parallel (the reference's world)
+- ``dp:2,fsdp:2,tp:2``   : + ZeRO-style weight sharding + Megatron tp
+- ``dp:1,fsdp:2,tp:2,sp:2``: + ring-attention sequence parallelism
+
+— and the SAME script runs on one chip, the virtual CPU mesh, or a pod.
+Weights/optimizer state are laid out by ``GPT.SHARDING_RULES`` via
+``parallel.sharding.shard_state``; the batch is sharded (batch over
+dp+fsdp, sequence over sp); XLA compiles the matching collectives into
+the step.
+
+Run from this directory: ``python gpt.py``.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+from tqdm import tqdm
+
+import torchbooster_tpu.distributed as dist
+import torchbooster_tpu.utils as utils
+from torchbooster_tpu.config import (
+    BaseConfig,
+    DatasetConfig,
+    EnvConfig,
+    LoaderConfig,
+    OptimizerConfig,
+    SchedulerConfig,
+)
+from torchbooster_tpu.dataset import Split
+from torchbooster_tpu.metrics import MetricsAccumulator
+from torchbooster_tpu.models import GPT
+from torchbooster_tpu.models.gpt import GPTConfig
+from torchbooster_tpu.ops.losses import cross_entropy
+from torchbooster_tpu.parallel.sharding import shard_state
+
+
+@dataclass
+class ModelConfig(BaseConfig):
+    """GPT dims, YAML-driven (a user config subclass resolved by name)."""
+
+    vocab: int = 1_024
+    n_layers: int = 4
+    d_model: int = 256
+    n_heads: int = 8
+    seq_len: int = 256
+    remat: bool = True
+
+    def make(self) -> GPTConfig:
+        return GPTConfig(vocab=self.vocab, n_layers=self.n_layers,
+                         d_model=self.d_model, n_heads=self.n_heads,
+                         seq_len=self.seq_len)
+
+
+@dataclass
+class Config(BaseConfig):
+    n_iter: int
+    seed: int
+    clip: float
+    accumulate_every: int
+    log_every: int
+
+    model: ModelConfig
+    env: EnvConfig
+    loader: LoaderConfig
+    optim: OptimizerConfig
+    scheduler: SchedulerConfig
+    dataset: DatasetConfig
+
+
+def batch_sharding(mesh) -> NamedSharding:
+    """Batch over the data axes, sequence over sp (GPT.batch_spec,
+    filtered to the axes this mesh actually has)."""
+    axes = mesh.axis_names
+    data = tuple(a for a in ("dp", "fsdp") if a in axes) or None
+    seq = "sp" if "sp" in axes else None
+    return NamedSharding(mesh, P(data, seq))
+
+
+def main(conf: Config) -> dict:
+    rng = utils.seed(conf.seed)
+    cfg = conf.model.make()
+    mesh = dist.get_mesh(conf.env)
+
+    dataset = conf.dataset.make(Split.TRAIN, seq_len=cfg.seq_len + 1,
+                                vocab=cfg.vocab)
+    loader = conf.loader.make(dataset, shuffle=True,
+                              distributed=conf.env.distributed,
+                              seed=conf.seed)
+
+    def loss_fn(params, batch, rng):
+        del rng
+        ids, labels = batch["ids"], batch["labels"]
+        logits = GPT.apply(params, ids, cfg=cfg, mesh=mesh,
+                           compute_dtype=conf.env.compute_dtype(),
+                           remat=conf.model.remat)
+        loss = cross_entropy(logits, labels)
+        return loss, {"ppl": jax.numpy.exp(loss)}
+
+    schedule = conf.scheduler.make(conf.optim)
+    tx = conf.optim.make(schedule)
+    state = utils.TrainState.create(
+        GPT.init(rng, cfg), tx, rng=rng,
+        accumulate=conf.accumulate_every > 1)
+    # rule-table layout instead of DDP replicate-everything
+    state = shard_state(state, GPT.SHARDING_RULES, mesh)
+    step = utils.make_step(loss_fn, tx, clip=conf.clip,
+                           accumulate_every=conf.accumulate_every,
+                           mesh=mesh)
+
+    sharding = batch_sharding(mesh)
+
+    def shard(tokens) -> dict:
+        # pre-shift on host so ids/labels both shard cleanly over sp
+        tokens = np.asarray(tokens)
+        return {
+            "ids": jax.device_put(
+                np.ascontiguousarray(tokens[:, :-1]), sharding),
+            "labels": jax.device_put(
+                np.ascontiguousarray(tokens[:, 1:]), sharding),
+        }
+
+    metrics = MetricsAccumulator()
+    results = {}
+    batches = utils.iter_loader(loader)
+    bar = tqdm(range(conf.n_iter), desc="train",
+               disable=not dist.is_primary())
+    with mesh:
+        for it in bar:
+            epoch, tokens = next(batches)
+            state, step_metrics = step(state, shard(tokens))
+            metrics.update(step_metrics)
+            if (it + 1) % conf.log_every == 0:
+                results = {"iter": it + 1, "epoch": epoch,
+                           **metrics.compute()}
+                metrics.reset()
+                if dist.is_primary():
+                    bar.set_postfix({k: f"{v:.4f}" for k, v in
+                                     results.items()
+                                     if isinstance(v, float)})
+    if dist.is_primary():
+        print({k: round(v, 4) if isinstance(v, float) else v
+               for k, v in results.items()})
+    return results
+
+
+if __name__ == "__main__":
+    conf = Config.load("gpt.yml")
+    utils.boost()
+    dist.launch(main, conf.env.n_devices, conf.env.n_machine,
+                conf.env.machine_rank, conf.env.dist_url, args=(conf,))

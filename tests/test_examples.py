@@ -121,6 +121,26 @@ def test_online(monkeypatch, tmp_path):
     assert list(Path(conf.samples_path).glob("styled_*.npy"))
 
 
+def test_gpt_single_vs_4d_mesh(monkeypatch):
+    """North-star recipe: same YAML on one device and on a
+    dp:1,fsdp:2,tp:2,sp:2 mesh must give (near-)identical losses —
+    sharding is a layout, not a math change."""
+    gpt = load_example(monkeypatch, "lm", "gpt")
+    conf = gpt.Config.load("gpt.yml")
+    conf.n_iter, conf.log_every = 4, 4
+    conf.model.n_layers, conf.model.d_model = 2, 64
+    conf.model.seq_len, conf.model.vocab, conf.model.n_heads = 64, 256, 4
+    conf.loader.batch_size = 8
+    conf.dataset.n_examples = 64
+    tiny_env(conf)
+    single = gpt.main(conf)
+
+    conf.env.distributed = True
+    conf.env.mesh = "dp:1,fsdp:2,tp:2,sp:2"
+    sharded = gpt.main(conf)
+    assert abs(single["loss"] - sharded["loss"]) < 1e-2
+
+
 def test_adain(monkeypatch, tmp_path):
     adain = load_example(monkeypatch, "img_stt", "adain")
     conf = adain.Config.load("adain.yml")

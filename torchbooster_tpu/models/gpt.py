@@ -45,7 +45,12 @@ class GPTConfig:
 # Consumed by parallel.sharding.make_param_specs; axes not in the mesh
 # are filtered out, so the same table serves dp-only through dp+fsdp+tp.
 SHARDING_RULES = [
-    (r"wte/table", P("tp", "fsdp")),
+    # replicated: any sharding of the table forces XLA into involuntary
+    # full-remat reshards around the token gather (and, tied, the head
+    # matmul) because gather output wants the activation layout
+    # P(data, sp, None); at GPT-2 scale the table is small next to the
+    # blocks, so replication is the fast layout
+    (r"wte/table", P()),
     (r"wpe/table", P(None, None)),
     (r"attn_qkv/kernel", P(None, "fsdp", "tp")),
     (r"attn_qkv/bias", P(None, "tp")),

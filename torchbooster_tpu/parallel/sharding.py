@@ -110,4 +110,41 @@ def shard_params(params: Any, mesh: Mesh,
     return jax.tree.map(jax.device_put, params, shardings)
 
 
-__all__ = ["make_param_specs", "make_shardings", "path_str", "shard_params"]
+def _is_param_shaped(leaf: Any, params: Any) -> bool:
+    """True when an opt-state node is a pytree congruent with params
+    (adam mu/nu, sgd momentum); those inherit the param shardings."""
+    if not isinstance(leaf, dict) or not isinstance(params, dict):
+        return False
+    return set(leaf.keys()) == set(params.keys())
+
+
+def make_state_specs(state: Any, rules: Sequence[tuple[str, P]],
+                     mesh: Mesh) -> Any:
+    """Spec pytree for a full :class:`~torchbooster_tpu.utils.TrainState`:
+    params by the rule table, optimizer-state nodes congruent with params
+    (adam m/v etc.) mirror the param specs, scalars/rng replicate."""
+    param_specs = make_param_specs(state.params, rules, mesh=mesh)
+    specs = jax.tree.map(lambda _: P(), state,
+                         is_leaf=lambda x: x is None)
+    specs = specs.replace(params=param_specs)
+    return specs.replace(
+        opt_state=jax.tree.map(
+            lambda leaf: param_specs if _is_param_shaped(leaf, state.params)
+            else P(), state.opt_state,
+            is_leaf=lambda x: _is_param_shaped(x, state.params)))
+
+
+def shard_state(state: Any, rules: Sequence[tuple[str, P]],
+                mesh: Mesh) -> Any:
+    """Place a TrainState on the mesh: the one-call replacement for DDP's broadcast
+    — params laid out by the rule table, optimizer state following suit
+    (ZeRO-style when rules shard weights over fsdp)."""
+    specs = make_state_specs(state, rules, mesh)
+    shardings = make_shardings(specs, mesh)
+    return jax.tree.map(
+        lambda x, s: None if x is None else jax.device_put(x, s),
+        state, shardings, is_leaf=lambda x: x is None)
+
+
+__all__ = ["make_param_specs", "make_shardings", "make_state_specs",
+           "path_str", "shard_params", "shard_state"]
