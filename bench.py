@@ -146,7 +146,7 @@ def bench_torch_cpu(batch: int, image: int, steps: int) -> float:
 
 def main() -> None:
     on_tpu = jax.default_backend() not in ("cpu",)
-    batch = int(os.environ.get("BENCH_BATCH", 128 if on_tpu else 8))
+    batch = int(os.environ.get("BENCH_BATCH", 256 if on_tpu else 8))
     image = int(os.environ.get("BENCH_IMAGE", 224 if on_tpu else 64))
     steps = int(os.environ.get("BENCH_STEPS", 20 if on_tpu else 3))
 

@@ -207,15 +207,26 @@ def make_step(
     """
     accumulate = accumulate_every > 1
 
+    def _cast(tree: Any) -> Any:
+        return jax.tree.map(
+            lambda x: x.astype(compute_dtype)
+            if jnp.issubdtype(x.dtype, jnp.floating) else x, tree)
+
     def step_fn(state: TrainState, batch: Any) -> tuple[TrainState, dict]:
         rng, step_rng = jax.random.split(state.rng)
-        batch_cast = batch
-        if compute_dtype is not None:
-            batch_cast = jax.tree.map(
-                lambda x: x.astype(compute_dtype)
-                if jnp.issubdtype(x.dtype, jnp.floating) else x, batch)
+        batch_cast = batch if compute_dtype is None else _cast(batch)
 
-        grad_fn = jax.value_and_grad(loss_fn, has_aux=has_aux)
+        if compute_dtype is None:
+            grad_fn = jax.value_and_grad(loss_fn, has_aux=has_aux)
+        else:
+            # mixed precision, TPU-style: fp32 master params, bf16
+            # compute — the whole fwd+bwd runs on the MXU in bf16 (cast
+            # inside the differentiated fn so its grad is fp32 w.r.t.
+            # the masters), no loss scaling needed (SURVEY §7)
+            def cast_loss_fn(params: Any, batch: Any, rng: jax.Array):
+                return loss_fn(_cast(params), batch, rng)
+
+            grad_fn = jax.value_and_grad(cast_loss_fn, has_aux=has_aux)
         if has_aux:
             (loss, aux), grads = grad_fn(state.params, batch_cast, step_rng)
         else:
