@@ -67,6 +67,8 @@ class Config(BaseConfig):
     clip: float
     accumulate_every: int
     log_every: int
+    save_every: int                 # 0 disables checkpointing
+    checkpoint_root: str
 
     model: ModelConfig
     env: EnvConfig
@@ -112,6 +114,23 @@ def main(conf: Config) -> dict:
         accumulate=conf.accumulate_every > 1)
     # rule-table layout instead of DDP replicate-everything
     state = shard_state(state, GPT.SHARDING_RULES, mesh)
+
+    # checkpoint + the resume half the reference lacked (SURVEY §5.4):
+    # restoring `like=state` re-applies the mesh layout, so resume works
+    # unchanged across mesh sizes
+    save_cb = None
+    start_iter = 0
+    if conf.save_every:
+        from torchbooster_tpu.callbacks import SaveCallback
+
+        save_cb = SaveCallback(conf.save_every, conf.n_iter,
+                               root=conf.checkpoint_root)
+        restored = save_cb.restore(like={"state": state})
+        if restored is not None:
+            state = restored["state"]
+            start_iter = int(np.asarray(state.step))
+            if dist.is_primary():
+                print(f"resumed from step {start_iter}")
     step = utils.make_step(loss_fn, tx, clip=conf.clip,
                            accumulate_every=conf.accumulate_every,
                            mesh=mesh)
@@ -131,7 +150,7 @@ def main(conf: Config) -> dict:
     metrics = MetricsAccumulator()
     results = {}
     batches = utils.iter_loader(loader)
-    bar = tqdm(range(conf.n_iter), desc="train",
+    bar = tqdm(range(start_iter, conf.n_iter), desc="train",
                disable=not dist.is_primary())
     with mesh:
         for it in bar:
@@ -146,6 +165,10 @@ def main(conf: Config) -> dict:
                     bar.set_postfix({k: f"{v:.4f}" for k, v in
                                      results.items()
                                      if isinstance(v, float)})
+            if save_cb is not None and (it + 1) % conf.save_every == 0:
+                save_cb.save(it + 1, state=state)
+    if save_cb is not None:
+        save_cb.wait()
     if dist.is_primary():
         print({k: round(v, 4) if isinstance(v, float) else v
                for k, v in results.items()})

@@ -161,3 +161,15 @@ def test_seed_accepts_deterministic_flag():
                                   np.asarray(jax.random.key_data(key2)))
     sample = jax.random.normal(key, (3,))
     assert sample.shape == (3,)
+
+
+def test_trace_context(tmp_path):
+    """utils.trace captures a profiler trace (SURVEY §5.1)."""
+    import jax.numpy as jnp
+
+    from torchbooster_tpu import utils
+
+    with utils.trace(str(tmp_path), annotate="step"):
+        jnp.ones((8, 8)).sum().block_until_ready()
+    produced = list(tmp_path.rglob("*"))
+    assert produced, "trace produced no files"

@@ -55,6 +55,41 @@ def boost(enable: bool = True) -> None:
     jax.config.update("jax_disable_jit", not enable)
 
 
+class trace:
+    """Profiler trace context (SURVEY §5.1: the reference constructs
+    torch profiler objects without entering them, ref utils.py:42-45 —
+    its NVTX story; here the real one): captures an XLA/TPU trace
+    viewable in TensorBoard or Perfetto.
+
+    >>> with utils.trace("/tmp/profile"):
+    ...     state, metrics = step(state, batch)
+
+    ``trace(path, annotate="step")`` also wraps the body in a named
+    TraceAnnotation so device ops group under one label."""
+
+    def __init__(self, path: str = "profile", annotate: str | None = None):
+        self.path = str(path)
+        self.annotate = annotate
+        self._annotation = None
+
+    def __enter__(self) -> "trace":
+        jax.profiler.start_trace(self.path)
+        if self.annotate:
+            self._annotation = jax.profiler.TraceAnnotation(self.annotate)
+            self._annotation.__enter__()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        if self._annotation is not None:
+            self._annotation.__exit__(*exc)
+        jax.profiler.stop_trace()
+
+
+def annotate(name: str):
+    """Named trace region for host-side code (NVTX-range analogue)."""
+    return jax.profiler.TraceAnnotation(name)
+
+
 def seed(value: int = 42, deterministic: bool = True) -> jax.Array:
     """Seed python/numpy RNGs and return the root PRNG key
     (ref seed utils.py:48-64). Determinism needs no flags here: JAX
@@ -297,6 +332,7 @@ def make_eval_step(loss_fn: Callable, has_aux: bool = True,
 
 
 __all__ = [
-    "TrainState", "boost", "detach", "freeze", "iter_loader", "make_step",
-    "make_eval_step", "seed", "stack_dictionaries", "to_array",
+    "TrainState", "annotate", "boost", "detach", "freeze", "iter_loader",
+    "make_step", "make_eval_step", "seed", "stack_dictionaries", "to_array",
+    "trace",
 ]
