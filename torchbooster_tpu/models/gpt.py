@@ -154,7 +154,12 @@ class GPT:
             x = constrain(x + L.dense(bp["mlp_fc2"], h))
             return x, None
 
-        scan_block = jax.checkpoint(block) if remat else block
+        # save matmul outputs, recompute the cheap elementwise ops —
+        # measured ≥ plain full remat on v5e with much less recompute
+        scan_block = jax.checkpoint(
+            block,
+            policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+        ) if remat else block
         x, _ = jax.lax.scan(lambda carry, bp: scan_block(carry, bp),
                             x, params["blocks"])
 
