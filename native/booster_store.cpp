@@ -137,6 +137,41 @@ int bs_get(void* handle, uint64_t idx, const uint8_t** data, uint64_t* size) {
   return 0;
 }
 
+// Batched gather: one FFI round-trip per batch instead of per record.
+// Pass 1 (out == nullptr): fill sizes[], return total bytes needed.
+// Pass 2: copy the records back-to-back into out (capacity checked),
+// fill sizes[], return total bytes written. Returns -1 on any bad
+// index/corrupt entry.
+int64_t bs_get_batch(void* handle, const uint64_t* indices, uint64_t n,
+                     uint8_t* out, uint64_t capacity, uint64_t* sizes) {
+  Reader* reader = static_cast<Reader*>(handle);
+  uint64_t total = 0;
+  for (uint64_t i = 0; i < n; ++i) {
+    uint64_t idx = indices[i];
+    if (idx >= reader->count) {
+      set_error("index out of range");
+      return -1;
+    }
+    const uint8_t* entry = reader->index + 16 * idx;
+    uint64_t offset = read_u64(entry);
+    uint64_t length = read_u64(entry + 8);
+    if (offset > reader->file_size || length > reader->file_size - offset) {
+      set_error("corrupt index entry");
+      return -1;
+    }
+    if (out != nullptr) {
+      if (total + length > capacity) {
+        set_error("output buffer too small");
+        return -1;
+      }
+      std::memcpy(out + total, reader->base + offset, length);
+    }
+    sizes[i] = length;
+    total += length;
+  }
+  return static_cast<int64_t>(total);
+}
+
 void bs_close(void* handle) {
   Reader* reader = static_cast<Reader*>(handle);
   if (reader->base != nullptr) {

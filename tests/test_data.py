@@ -13,7 +13,7 @@ from torchbooster_tpu.config import DatasetConfig, LoaderConfig
 from torchbooster_tpu.data import (DataLoader, ShardedIterable, SizedIterable,
                                    default_collate, prefetch_to_device,
                                    resolve_dataset)
-from torchbooster_tpu.dataset import (ArrayDataset, BaseDataset, Split,
+from torchbooster_tpu.dataset import (ArrayDataset, BaseDataset, Dataset, Split,
                                       TransformDataset)
 from torchbooster_tpu.store import RecordReader, RecordWriter
 
@@ -288,3 +288,57 @@ def test_record_writer_abort_on_exception(tmp_path):
         writer.append(b"one")
     with RecordReader(path) as reader:
         assert len(reader) == 1
+
+
+def test_store_get_batch_both_paths(tmp_path):
+    """Batched gather equals per-record reads through both readers."""
+    from torchbooster_tpu.store import RecordReader, RecordWriter
+
+    path = tmp_path / "batch.bstore"
+    records = [bytes([i]) * (i + 1) for i in range(64)]
+    with RecordWriter(path) as writer:
+        for record in records:
+            writer.append(record)
+    for native in (False, True):
+        reader = RecordReader(path, native=native)
+        indices = [3, 0, 63, 10, 10]
+        assert reader.get_batch(indices) == [records[i] for i in indices]
+        assert reader.get_batch([]) == []
+        with pytest.raises((OSError, IndexError)):
+            reader.get(64)
+        reader.close()
+
+
+def test_loader_uses_getitems(tmp_path):
+    """DataLoader routes through the __getitems__ batched-fetch protocol
+    when the dataset provides it."""
+    calls = {"batched": 0, "single": 0}
+
+    class Batched(Dataset):
+        def __len__(self):
+            return 32
+
+        def __getitem__(self, index):
+            calls["single"] += 1
+            return np.float32(index)
+
+        def __getitems__(self, indices):
+            calls["batched"] += 1
+            return [np.float32(i) for i in indices]
+
+    loader = DataLoader(Batched(), batch_size=8, shuffle=False)
+    batches = list(loader)
+    assert len(batches) == 4 and calls["batched"] == 4
+    assert calls["single"] == 0
+    assert batches[0].tolist() == [0.0, 1.0, 2.0, 3.0, 4.0, 5.0, 6.0, 7.0]
+
+
+def test_base_dataset_getitems(tmp_path):
+    """BaseDataset batched fetch decodes through the store gather."""
+    class Ints(BaseDataset):
+        pass
+
+    Ints.prepare(tmp_path, Split.TRAIN, [{"v": i} for i in range(16)])
+    ds = Ints(tmp_path, Split.TRAIN)
+    out = ds.__getitems__([0, 15, 7])
+    assert [e["v"] for e in out] == [0, 15, 7]

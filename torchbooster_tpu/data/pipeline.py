@@ -181,6 +181,14 @@ class DataLoader:
 
     def _map_iter(self) -> Iterator[Any]:
         fetch = self.dataset.__getitem__
+        fetch_many = getattr(self.dataset, "__getitems__", None)
+        if fetch_many is not None:
+            # batched-fetch protocol: one storage gather per batch
+            def assemble(chunk):
+                return self.collate_fn(fetch_many([int(i) for i in chunk]))
+        else:
+            def assemble(chunk):
+                return self.collate_fn([fetch(int(i)) for i in chunk])
         if self.num_workers > 0:
             with ThreadPoolExecutor(self.num_workers) as pool:
                 pending: collections.deque = collections.deque()
@@ -188,9 +196,7 @@ class DataLoader:
                 depth = self.prefetch + 1
 
                 def submit(idx_chunk):
-                    pending.append(pool.submit(
-                        lambda c: self.collate_fn([fetch(int(i)) for i in c]),
-                        idx_chunk))
+                    pending.append(pool.submit(assemble, idx_chunk))
 
                 for chunk in batches:
                     submit(chunk)
@@ -200,7 +206,7 @@ class DataLoader:
                     yield pending.popleft().result()
         else:
             for chunk in self._batches_of_indices():
-                yield self.collate_fn([fetch(int(i)) for i in chunk])
+                yield assemble(chunk)
 
     def _iterable_iter(self) -> Iterator[Any]:
         buffer: list[Any] = []
