@@ -53,11 +53,13 @@ class ModelConfig(BaseConfig):
     n_heads: int = 8
     seq_len: int = 256
     remat: bool = True
+    n_experts: int = 0              # > 0: MoE blocks over the ep axis
+    aux_weight: float = 1e-2        # load-balance loss weight
 
     def make(self) -> GPTConfig:
         return GPTConfig(vocab=self.vocab, n_layers=self.n_layers,
                          d_model=self.d_model, n_heads=self.n_heads,
-                         seq_len=self.seq_len)
+                         seq_len=self.seq_len, n_experts=self.n_experts)
 
 
 @dataclass
@@ -101,11 +103,15 @@ def main(conf: Config) -> dict:
     def loss_fn(params, batch, rng):
         del rng
         ids, labels = batch["ids"], batch["labels"]
-        logits = GPT.apply(params, ids, cfg=cfg, mesh=mesh,
-                           compute_dtype=conf.env.compute_dtype(),
-                           remat=conf.model.remat)
+        logits, aux = GPT.apply(params, ids, cfg=cfg, mesh=mesh,
+                                compute_dtype=conf.env.compute_dtype(),
+                                remat=conf.model.remat, return_aux=True)
         loss = cross_entropy(logits, labels)
-        return loss, {"ppl": jax.numpy.exp(loss)}
+        metrics = {"ppl": jax.numpy.exp(loss)}
+        if cfg.n_experts:
+            metrics["aux"] = aux
+            loss = loss + conf.model.aux_weight * aux
+        return loss, metrics
 
     schedule = conf.scheduler.make(conf.optim)
     tx = conf.optim.make(schedule)

@@ -39,6 +39,11 @@ class GPTConfig:
     mlp_ratio: int = 4
     dropout: float = 0.0      # recipe-level; models stay deterministic
     tie_embeddings: bool = True
+    # MoE: n_experts > 0 replaces every block's MLP with a top-k routed
+    # expert layer (models/moe.py) sharded over the ``ep`` mesh axis
+    n_experts: int = 0
+    top_k: int = 2
+    capacity_factor: float = 1.25
 
 
 # path-regex → PartitionSpec (leading None = the stacked layer axis).
@@ -59,6 +64,12 @@ SHARDING_RULES = [
     (r"mlp_fc1/bias", P(None, "tp")),
     (r"mlp_fc2/kernel", P(None, "tp", "fsdp")),
     (r"head/kernel", P("fsdp", "tp")),
+    # MoE blocks: experts over ep, hidden over tp (models/moe.py)
+    (r"moe_gate/kernel", P()),
+    (r"moe_fc1/kernel", P(None, "ep", None, "tp")),
+    (r"moe_fc1/bias", P(None, "ep", "tp")),
+    (r"moe_fc2/kernel", P(None, "ep", "tp", None)),
+    (r"moe_fc2/bias", P(None, "ep", None)),
     (r".*", P()),
 ]
 
@@ -72,14 +83,23 @@ def _block_init(rng: jax.Array, cfg: GPTConfig, dtype: Any) -> dict:
     d, h = cfg.d_model, cfg.mlp_ratio * cfg.d_model
     # GPT-2 init: N(0, 0.02), residual projections scaled by 1/√(2L)
     res_std = 0.02 / (2 * cfg.n_layers) ** 0.5
-    return {
+    block = {
         "ln1": L.norm_init(d, dtype),
         "attn_qkv": L.dense_init(ks[0], d, 3 * d, std=0.02, dtype=dtype),
         "attn_proj": L.dense_init(ks[1], d, d, std=res_std, dtype=dtype),
         "ln2": L.norm_init(d, dtype),
-        "mlp_fc1": L.dense_init(ks[2], d, h, std=0.02, dtype=dtype),
-        "mlp_fc2": L.dense_init(ks[3], h, d, std=res_std, dtype=dtype),
     }
+    if cfg.n_experts > 0:
+        from torchbooster_tpu.models.moe import moe_init
+
+        block.update(moe_init(ks[2], cfg.n_experts, d, h, std=0.02,
+                              out_std=res_std, dtype=dtype))
+    else:
+        block.update({
+            "mlp_fc1": L.dense_init(ks[2], d, h, std=0.02, dtype=dtype),
+            "mlp_fc2": L.dense_init(ks[3], h, d, std=res_std, dtype=dtype),
+        })
+    return block
 
 
 class GPT:
@@ -116,7 +136,8 @@ class GPT:
               mesh: Mesh | None = None,
               compute_dtype: Any = jnp.bfloat16,
               remat: bool = True,
-              attn_impl: str = "auto") -> jax.Array:
+              attn_impl: str = "auto",
+              return_aux: bool = False) -> jax.Array:
         b, s = ids.shape
         if s > cfg.seq_len:
             # jnp.take would silently fill NaN embeddings for positions
@@ -136,7 +157,8 @@ class GPT:
         use_ring = (mesh is not None and "sp" in mesh.axis_names
                     and mesh.shape["sp"] > 1)
 
-        def block(x: jax.Array, bp: dict) -> tuple[jax.Array, None]:
+        def block(carry: tuple, bp: dict) -> tuple[tuple, None]:
+            x, aux = carry
             h = L.layer_norm(bp["ln1"], x)
             qkv = L.dense(bp["attn_qkv"], h)
             qkv = qkv.reshape(b, s, 3, n_heads, head_dim)
@@ -150,9 +172,17 @@ class GPT:
             o = o.reshape(b, s, d)
             x = constrain(x + L.dense(bp["attn_proj"], o))
             h = L.layer_norm(bp["ln2"], x)
-            h = jax.nn.gelu(L.dense(bp["mlp_fc1"], h))
-            x = constrain(x + L.dense(bp["mlp_fc2"], h))
-            return x, None
+            if cfg.n_experts > 0:
+                from torchbooster_tpu.models.moe import moe_apply
+
+                m, layer_aux = moe_apply(bp, h, top_k=cfg.top_k,
+                                         capacity_factor=cfg.capacity_factor)
+                x = constrain(x + m)
+                aux = aux + layer_aux
+            else:
+                h = jax.nn.gelu(L.dense(bp["mlp_fc1"], h))
+                x = constrain(x + L.dense(bp["mlp_fc2"], h))
+            return (x, aux), None
 
         # save matmul outputs, recompute the cheap elementwise ops —
         # measured ≥ plain full remat on v5e with much less recompute
@@ -160,14 +190,18 @@ class GPT:
             block,
             policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
         ) if remat else block
-        x, _ = jax.lax.scan(lambda carry, bp: scan_block(carry, bp),
-                            x, params["blocks"])
+        (x, aux), _ = jax.lax.scan(
+            lambda carry, bp: scan_block(carry, bp),
+            (x, jnp.zeros((), jnp.float32)), params["blocks"])
 
         x = L.layer_norm(params["ln_f"], x)
         if "head" in params:
             logits = L.dense(params["head"], x)
         else:
             logits = x @ params["wte"]["table"].astype(x.dtype).T
+        if return_aux:
+            # mean load-balance loss over layers (0 for dense models)
+            return logits, aux / max(cfg.n_layers, 1)
         return logits
 
 

@@ -1,0 +1,129 @@
+"""Mixture-of-Experts layer with expert parallelism (GShard-style).
+
+No reference counterpart (the reference has no transformer at all,
+SURVEY §5.7); this is the ``ep`` mesh axis made real. The formulation
+is the einsum dispatch/combine of GShard/Mesh-TensorFlow: tokens are
+routed top-k into per-expert capacity buffers by one-hot einsums, the
+expert MLPs run as one batched einsum over the stacked expert weights,
+and results scatter back weighted by the gate. Everything is dense
+linear algebra with static shapes — XLA turns the expert-axis sharding
+(``P("ep", ...)``) into the all-to-all pair around the expert compute;
+there is no host-side routing.
+
+Design notes (TPU-first):
+- capacity is static: ``C = ceil(k·T/E · capacity_factor)`` — overflow
+  tokens drop (standard GShard semantics), keeping shapes compile-time
+  constant.
+- the auxiliary load-balance loss (Switch/GShard ``mean(frac·prob)·E``)
+  is returned alongside the output; recipes add it to the task loss.
+- position-in-expert is computed with a cumsum over tokens — O(T·E)
+  on the VPU, no sort, no scatter.
+"""
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from torchbooster_tpu.models import layers as L
+
+# rules fragment for a stacked-MoE block (leading axis = scan layer);
+# experts shard over ep, hidden over tp — the dispatch einsum's output
+# (E, C, d) picks up P("ep") from the weights, which is the all-to-all
+SHARDING_RULES = [
+    (r"moe_gate/kernel", P(None, None, None)),
+    (r"moe_fc1/kernel", P(None, "ep", None, "tp")),
+    (r"moe_fc1/bias", P(None, "ep", "tp")),
+    (r"moe_fc2/kernel", P(None, "ep", "tp", None)),
+    (r"moe_fc2/bias", P(None, "ep", None)),
+]
+
+
+def moe_init(rng: jax.Array, n_experts: int, d_model: int, hidden: int,
+             std: float = 0.02, out_std: float | None = None,
+             dtype: Any = jnp.float32) -> dict:
+    """Stacked expert MLP + gate: fc1 (E, d, h), fc2 (E, h, d)."""
+    k_gate, k1, k2 = jax.random.split(rng, 3)
+    out_std = std if out_std is None else out_std
+    return {
+        "moe_gate": L.dense_init(k_gate, d_model, n_experts, std=std,
+                                 use_bias=False, dtype=dtype),
+        "moe_fc1": {
+            "kernel": std * jax.random.normal(
+                k1, (n_experts, d_model, hidden), dtype),
+            "bias": jnp.zeros((n_experts, hidden), dtype),
+        },
+        "moe_fc2": {
+            "kernel": out_std * jax.random.normal(
+                k2, (n_experts, hidden, d_model), dtype),
+            "bias": jnp.zeros((n_experts, d_model), dtype),
+        },
+    }
+
+
+def moe_apply(params: dict, x: jax.Array, top_k: int = 2,
+              capacity_factor: float = 1.25,
+              activation=jax.nn.gelu) -> tuple[jax.Array, jax.Array]:
+    """(B, S, d) → ((B, S, d), aux_loss). Top-``top_k`` routing with
+    static per-expert capacity; dropped tokens pass through as zeros
+    (the residual connection around the block carries them)."""
+    b, s, d = x.shape
+    tokens = x.reshape(b * s, d)
+    t = tokens.shape[0]
+    n_experts = params["moe_gate"]["kernel"].shape[-1]
+    capacity = int((top_k * t / n_experts) * capacity_factor + 0.5)
+    capacity = max(capacity, top_k)
+
+    gate_logits = L.dense(params["moe_gate"], tokens)      # (T, E)
+    probs = jax.nn.softmax(gate_logits.astype(jnp.float32), axis=-1)
+
+    # top-k selection, one expert at a time (k is tiny and static)
+    combine = jnp.zeros((t, n_experts, capacity), jnp.float32)
+    dispatch = jnp.zeros((t, n_experts, capacity), jnp.bool_)
+    remaining = probs
+    # position counters per expert accumulate across the k rounds
+    fill = jnp.zeros((n_experts,), jnp.int32)
+    for _ in range(top_k):
+        expert = jnp.argmax(remaining, axis=-1)            # (T,)
+        weight = jnp.take_along_axis(
+            remaining, expert[:, None], axis=-1)[:, 0]     # (T,)
+        onehot = jax.nn.one_hot(expert, n_experts, dtype=jnp.int32)
+        # position of each token within its chosen expert's buffer
+        position = jnp.cumsum(onehot, axis=0) - 1 + fill[None, :]
+        pos = jnp.sum(position * onehot, axis=-1)          # (T,)
+        keep = pos < capacity
+        pos_oh = jax.nn.one_hot(pos, capacity, dtype=jnp.float32)
+        slot = onehot.astype(jnp.float32)[:, :, None] * pos_oh[:, None, :]
+        slot = slot * keep[:, None, None].astype(jnp.float32)
+        combine = combine + weight[:, None, None] * slot
+        dispatch = dispatch | (slot > 0)
+        fill = fill + jnp.sum(onehot, axis=0)
+        remaining = remaining * (1.0 - onehot.astype(jnp.float32))
+
+    # dispatch: (T, E, C) × (T, d) → per-expert batches (E, C, d)
+    expert_in = jnp.einsum(
+        "tec,td->ecd", dispatch.astype(x.dtype), tokens)
+    # expert MLPs over the stacked weights — one batched matmul pair
+    h = jnp.einsum("ecd,edh->ech", expert_in,
+                   params["moe_fc1"]["kernel"].astype(x.dtype))
+    h = activation(h + params["moe_fc1"]["bias"].astype(x.dtype)[:, None, :])
+    expert_out = jnp.einsum("ech,ehd->ecd", h,
+                            params["moe_fc2"]["kernel"].astype(x.dtype))
+    expert_out = expert_out + \
+        params["moe_fc2"]["bias"].astype(x.dtype)[:, None, :]
+    # combine back, gate-weighted
+    out = jnp.einsum("tec,ecd->td", combine.astype(x.dtype), expert_out)
+
+    # Switch-style load-balance loss: E * mean_e(frac_tokens * mean_prob)
+    top1 = jnp.argmax(probs, axis=-1)
+    frac = jnp.mean(jax.nn.one_hot(top1, n_experts, dtype=jnp.float32),
+                    axis=0)
+    mean_prob = jnp.mean(probs, axis=0)
+    aux_loss = n_experts * jnp.sum(frac * mean_prob)
+
+    return out.reshape(b, s, d), aux_loss
+
+
+__all__ = ["SHARDING_RULES", "moe_apply", "moe_init"]
