@@ -1,0 +1,83 @@
+"""Pipeline parallelism: forward matches a sequential layer scan, and
+gradients flow through the schedule (reverse ring)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from torchbooster_tpu.models import layers as L
+from torchbooster_tpu.parallel.pipeline import pipeline_apply
+
+
+def make_mlp_stack(rng, n_layers, d):
+    ks = jax.random.split(rng, n_layers)
+    return jax.vmap(lambda k: L.dense_init(k, d, d))(ks)
+
+
+def layer_fn(layer_params, x):
+    return jax.nn.gelu(L.dense(layer_params, x))
+
+
+def sequential(params, x):
+    def one(carry, lp):
+        return layer_fn(lp, carry), None
+    out, _ = jax.lax.scan(one, x, params)
+    return out
+
+
+@pytest.fixture
+def mesh():
+    return Mesh(np.array(jax.devices()[:4]), ("pp",))
+
+
+def test_pipeline_matches_sequential(mesh):
+    rng = jax.random.PRNGKey(0)
+    params = make_mlp_stack(rng, 8, 16)          # 2 layers / stage
+    x = jax.random.normal(jax.random.PRNGKey(1), (8, 16))
+    want = sequential(params, x)
+    with mesh:
+        got = jax.jit(lambda p, x: pipeline_apply(
+            layer_fn, p, x, mesh))(params, x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=1e-5)
+
+
+def test_pipeline_more_microbatches(mesh):
+    rng = jax.random.PRNGKey(0)
+    params = make_mlp_stack(rng, 4, 8)
+    x = jax.random.normal(jax.random.PRNGKey(1), (16, 8))
+    want = sequential(params, x)
+    with mesh:
+        got = pipeline_apply(layer_fn, params, x, mesh, n_microbatches=8)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=1e-5)
+
+
+def test_pipeline_gradients(mesh):
+    """grad through the pipeline equals grad through the plain scan."""
+    rng = jax.random.PRNGKey(0)
+    params = make_mlp_stack(rng, 4, 8)
+    x = jax.random.normal(jax.random.PRNGKey(1), (8, 8))
+
+    def loss_pp(p):
+        with mesh:
+            return jnp.sum(pipeline_apply(layer_fn, p, x, mesh) ** 2)
+
+    def loss_seq(p):
+        return jnp.sum(sequential(p, x) ** 2)
+
+    g_pp = jax.grad(loss_pp)(params)
+    g_seq = jax.grad(loss_seq)(params)
+    for a, b in zip(jax.tree.leaves(g_pp), jax.tree.leaves(g_seq)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-4)
+
+
+def test_pipeline_validates_divisibility(mesh):
+    params = make_mlp_stack(jax.random.PRNGKey(0), 6, 8)   # 6 % 4 != 0
+    x = jnp.zeros((8, 8))
+    with pytest.raises(ValueError, match="not divisible"):
+        pipeline_apply(layer_fn, params, x, mesh)

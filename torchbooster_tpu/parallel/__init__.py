@@ -9,13 +9,19 @@ inserting the collectives. Axes used throughout the framework:
 - ``fsdp`` — fully-sharded data parallel (batch axis + sharded params)
 - ``tp``   — tensor parallel (weight matrices split; activation collectives)
 - ``sp``   — sequence/context parallel (ring attention, see ring_attention)
-- ``ep``   — expert parallel (MoE expert sharding)
-- ``pp``   — pipeline parallel (stage axis)
+- ``ep``   — expert parallel (MoE dispatch/combine all-to-alls, models/moe.py)
+- ``pp``   — pipeline parallel (GPipe schedule, pipeline.py)
 """
+from torchbooster_tpu.parallel.pipeline import pipeline_apply
+from torchbooster_tpu.parallel.ring import ring_attention
 from torchbooster_tpu.parallel.sharding import (
     make_param_specs,
     make_shardings,
+    make_state_specs,
     shard_params,
+    shard_state,
 )
 
-__all__ = ["make_param_specs", "make_shardings", "shard_params"]
+__all__ = ["make_param_specs", "make_shardings", "make_state_specs",
+           "pipeline_apply", "ring_attention", "shard_params",
+           "shard_state"]

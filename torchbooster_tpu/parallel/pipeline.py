@@ -1,0 +1,116 @@
+"""Pipeline parallelism: GPipe schedule over a ``pp`` mesh axis.
+
+No reference counterpart (the reference is DP-only, SURVEY §2.12); this
+completes the parallelism matrix (dp/fsdp/tp/sp/ep/pp). The design is
+SPMD, not host-orchestrated: layer-stacked parameters shard their
+leading axis over ``pp`` (each device holds ``L/P`` contiguous layers),
+and one ``shard_map`` kernel runs the classic GPipe schedule — at tick
+``t`` stage ``i`` processes microbatch ``t - i``, then rotates its
+activation to stage ``i+1`` with a single ``ppermute`` ring step. The
+bubble is the usual ``P - 1`` ticks; all shapes are static, so the
+whole schedule compiles to one XLA while-loop with a collective-permute
+per tick.
+
+Differentiable end to end: ``jax.grad`` through the kernel yields the
+reverse schedule automatically (ppermute transposes to the reverse
+ring), so ``pipeline_apply`` drops into a jitted train step unchanged.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+try:
+    from jax import shard_map  # jax >= 0.8
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map
+
+
+def pipeline_apply(
+    layer_fn: Callable[[Any, jax.Array], jax.Array],
+    stacked_params: Any,
+    x: jax.Array,
+    mesh: Mesh,
+    axis: str = "pp",
+    n_microbatches: int | None = None,
+) -> jax.Array:
+    """Run ``layer_fn`` over ``L`` stacked layers, pipelined over the
+    mesh's ``axis``.
+
+    ``layer_fn(layer_params, x) -> x`` applies ONE layer (a pytree leaf
+    slice of ``stacked_params``'s leading axis). ``x`` is the full batch
+    ``(B, ...)``; it is split into ``n_microbatches`` (default: the
+    pipeline depth) along axis 0. ``B`` must divide evenly and ``L``
+    must divide the ``axis`` size.
+
+    Returns the full-batch output, identical (up to float reassociation)
+    to sequentially scanning the layers on one device.
+    """
+    n_stages = mesh.shape[axis]
+    n_layers = jax.tree.leaves(stacked_params)[0].shape[0]
+    if n_layers % n_stages:
+        raise ValueError(f"{n_layers} layers not divisible by "
+                         f"{n_stages} pipeline stages")
+    m = n_microbatches or n_stages
+    batch = x.shape[0]
+    if batch % m:
+        raise ValueError(f"batch {batch} not divisible by {m} microbatches")
+    x_mb = x.reshape(m, batch // m, *x.shape[1:])
+
+    # everything except pp is untouched: params shard their layer axis,
+    # the batch is replicated across pp (dp/… sharding, if any, rides on
+    # the unmentioned axes via shard_map's automatic residual rules)
+    param_specs = jax.tree.map(lambda _: P(axis), stacked_params)
+
+    def kernel(stage_params: Any, x_mb: jax.Array) -> jax.Array:
+        stage = jax.lax.axis_index(axis)
+        right = [(j, (j + 1) % n_stages) for j in range(n_stages)]
+
+        def run_stage(carry_x: jax.Array) -> jax.Array:
+            def one(carry, layer_params):
+                return layer_fn(layer_params, carry), None
+
+            out, _ = jax.lax.scan(one, carry_x, stage_params)
+            return out
+
+        def tick(t: int, state: tuple) -> tuple:
+            held, out = state
+            mb_index = t - stage
+            active = (mb_index >= 0) & (mb_index < m)
+            # stage 0 pulls a fresh microbatch; others use the activation
+            # received over the ring on the previous tick
+            fresh = jax.lax.dynamic_index_in_dim(
+                x_mb, jnp.clip(t, 0, m - 1), 0, keepdims=False)
+            x_in = jnp.where(stage == 0, fresh, held)
+            y = run_stage(x_in)
+            y = jnp.where(active, y, x_in)
+            # the final stage banks its finished microbatch
+            write = active & (stage == n_stages - 1)
+            slot = jnp.clip(mb_index, 0, m - 1)
+            banked = jax.lax.dynamic_update_index_in_dim(
+                out, jnp.where(write, y, jax.lax.dynamic_index_in_dim(
+                    out, slot, 0, keepdims=False)), slot, 0)
+            held = jax.lax.ppermute(y, axis, right)
+            return held, banked
+
+        held = jnp.zeros(x_mb.shape[1:], x_mb.dtype)
+        out = jnp.zeros_like(x_mb)
+        _, out = jax.lax.fori_loop(0, m + n_stages - 1, tick, (held, out))
+        # results live on the last stage; mask + psum broadcasts them
+        out = out * jnp.where(stage == n_stages - 1, 1.0, 0.0).astype(out.dtype)
+        return jax.lax.psum(out, axis)
+
+    try:        # jax >= 0.8 spells the replication-check flag check_vma
+        mapped = shard_map(kernel, mesh=mesh, in_specs=(param_specs, P()),
+                           out_specs=P(), check_vma=False)
+    except TypeError:  # pragma: no cover - older jax
+        mapped = shard_map(kernel, mesh=mesh, in_specs=(param_specs, P()),
+                           out_specs=P(), check_rep=False)
+    out_mb = mapped(stacked_params, x_mb)
+    return out_mb.reshape(batch, *x.shape[1:])
+
+
+__all__ = ["pipeline_apply"]
