@@ -157,13 +157,18 @@ def group_norm(params: dict, x: jax.Array, groups: int = 32,
     groups = min(groups, c)
     while c % groups:
         groups -= 1
-    # one pass over x: per-channel first/second moments, fp32 accumulate
-    s1 = jnp.mean(x, axis=(1, 2), dtype=jnp.float32)            # (n, c)
-    s2 = jnp.mean(lax.square(x), axis=(1, 2), dtype=jnp.float32)
+    # one pass over x: per-channel first/second moments. Square in fp32 —
+    # squaring in bf16 then E[x²]−E[x]² cancels catastrophically when
+    # |mean| ≫ std and can push the variance below -eps (NaN from rsqrt).
+    xf = x.astype(jnp.float32)
+    s1 = jnp.mean(xf, axis=(1, 2))                              # (n, c)
+    s2 = jnp.mean(lax.square(xf), axis=(1, 2))
     # group combine on the (n, groups, c/g) stats — tiny
     gs1 = s1.reshape(n, groups, -1).mean(axis=2)                # (n, g)
     gs2 = s2.reshape(n, groups, -1).mean(axis=2)
-    inv = lax.rsqrt(gs2 - lax.square(gs1) + eps)                # (n, g)
+    # clamp: fp32 cancellation can still leave a tiny negative variance
+    var = jnp.maximum(gs2 - lax.square(gs1), 0.0)
+    inv = lax.rsqrt(var + eps)                                  # (n, g)
     per_c = c // groups
     mean_c = jnp.repeat(gs1, per_c, axis=1)                     # (n, c)
     inv_c = jnp.repeat(inv, per_c, axis=1)

@@ -47,7 +47,11 @@ def attention(q: jax.Array, k: jax.Array, v: jax.Array,
     score materialization thrashes HBM); below that XLA's fused
     reference is faster. Off-TPU always reference."""
     if impl == "auto":
-        impl = "flash" if _on_tpu() and q.shape[1] >= 4096 else "reference"
+        from torchbooster_tpu.ops.flash_attention import tileable
+
+        use_flash = (_on_tpu() and q.shape[1] >= 4096
+                     and tileable(q.shape[1]) and tileable(k.shape[1]))
+        impl = "flash" if use_flash else "reference"
     if impl == "reference":
         return mha_reference(q, k, v, causal, sm_scale)
 

@@ -127,11 +127,17 @@ def make_state_specs(state: Any, rules: Sequence[tuple[str, P]],
     specs = jax.tree.map(lambda _: P(), state,
                          is_leaf=lambda x: x is None)
     specs = specs.replace(params=param_specs)
-    return specs.replace(
+    specs = specs.replace(
         opt_state=jax.tree.map(
             lambda leaf: param_specs if _is_param_shaped(leaf, state.params)
             else P(), state.opt_state,
             is_leaf=lambda x: _is_param_shaped(x, state.params)))
+    # grad_acc (set when accumulate_every > 1) is a param-shaped fp32
+    # pytree — it must follow the param layout or every device holds a
+    # full replicated copy, defeating fsdp/ZeRO sharding.
+    if getattr(state, "grad_acc", None) is not None:
+        specs = specs.replace(grad_acc=param_specs)
+    return specs
 
 
 def shard_state(state: Any, rules: Sequence[tuple[str, P]],
