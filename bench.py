@@ -1,4 +1,5 @@
-"""Headline benchmark: ResNet-50 train-step throughput, images/sec/chip.
+"""Headline benchmark: ResNet-50 train-step throughput + GPT-2 LM
+tokens/s, with MFU, on one chip.
 
 BASELINE.json's metric is "img_cls ResNet-50 images/sec/chip". The
 reference publishes no numbers (SURVEY §6), so the baseline is the
@@ -7,10 +8,18 @@ same fwd+bwd+SGD step on the same host — measured live each run, with a
 recorded fallback constant if torch is unavailable. ``vs_baseline`` is
 our-chip-throughput / reference-stack-throughput.
 
-Prints exactly ONE JSON line:
-    {"metric": ..., "value": N, "unit": "images/sec/chip", "vs_baseline": N}
+``mfu`` fields are model FLOPs utilization against this chip's
+*measured sustained* bf16 matmul rate (~133 TF/s on the tunneled v5e —
+see docs/performance.md), not the paper peak: ResNet-50 counted as
+3×4.1 GFLOP/image (fwd ≈ 4.1G, train ≈ 3× fwd), GPT as 6·N·D.
 
-Env knobs: BENCH_BATCH, BENCH_STEPS, BENCH_IMAGE (side), BENCH_SKIP_TORCH.
+Prints exactly ONE JSON line:
+    {"metric": ..., "value": N, "unit": "images/sec/chip",
+     "vs_baseline": N, "mfu": N,
+     "gpt_tokens_per_sec": N, "gpt_mfu": N}
+
+Env knobs: BENCH_BATCH, BENCH_STEPS, BENCH_IMAGE (side),
+BENCH_SKIP_TORCH, BENCH_SKIP_GPT.
 """
 from __future__ import annotations
 
@@ -31,6 +40,8 @@ from torchbooster_tpu.utils import TrainState, make_step
 # torch-CPU ResNet-50 fwd+bwd+SGD, measured on this image's host
 # (fallback when live measurement is disabled or fails)
 FALLBACK_TORCH_CPU_IPS = 8.0
+SUSTAINED_TFLOPS = 133.0  # measured bf16 8k matmul on this chip
+RESNET50_TRAIN_FLOP_PER_IMG = 3 * 4.1e9
 
 
 def bench_tpu(batch: int, image: int, steps: int) -> float:
@@ -64,6 +75,42 @@ def bench_tpu(batch: int, image: int, steps: int) -> float:
     np.asarray(metrics["loss"])
     dt = time.perf_counter() - t0
     return batch * steps / dt
+
+
+def bench_gpt(steps: int) -> tuple[float, float]:
+    """GPT-2 small (12L/768d/12H, vocab 50257, S=1024) train step —
+    driver-captured version of the docs' LM claim. Returns
+    (tokens/s, mfu)."""
+    from torchbooster_tpu.models.gpt import GPT, GPTConfig
+
+    cfg = GPTConfig()
+    batch = int(os.environ.get("BENCH_GPT_BATCH", 16))
+    params = GPT.init(jax.random.PRNGKey(0), cfg)
+    n_params = sum(p.size for p in jax.tree.leaves(params))
+    tx = optax.adamw(1e-4)
+
+    def loss_fn(p, b, rng):
+        del rng
+        logits = GPT.apply(p, b["ids"], cfg)
+        return cross_entropy(logits[:, :-1].reshape(-1, cfg.vocab),
+                             b["ids"][:, 1:].reshape(-1)), {}
+
+    state = TrainState.create(params, tx)
+    step = make_step(loss_fn, tx)
+    ids = jax.random.randint(jax.random.PRNGKey(1), (batch, cfg.seq_len),
+                             0, cfg.vocab)
+    data = {"ids": ids}
+    for _ in range(2):
+        state, metrics = step(state, data)
+    np.asarray(metrics["loss"])
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = step(state, data)
+    np.asarray(metrics["loss"])
+    dt = (time.perf_counter() - t0) / steps
+    tok_s = batch * cfg.seq_len / dt
+    mfu = 6 * n_params * batch * cfg.seq_len / dt / (SUSTAINED_TFLOPS * 1e12)
+    return tok_s, mfu
 
 
 def _torch_resnet50():
@@ -151,6 +198,19 @@ def main() -> None:
     steps = int(os.environ.get("BENCH_STEPS", 20 if on_tpu else 3))
 
     value = bench_tpu(batch, image, steps)
+    # FLOP constant holds at 224²; conv FLOPs scale ~quadratically with
+    # the side, so scale it for non-default BENCH_IMAGE runs. mfu is
+    # only meaningful against the TPU's sustained rate.
+    flop_per_img = RESNET50_TRAIN_FLOP_PER_IMG * (image / 224) ** 2
+    mfu = (round(value * flop_per_img / (SUSTAINED_TFLOPS * 1e12), 4)
+           if on_tpu else None)
+
+    gpt_tok_s = gpt_mfu = None
+    if on_tpu and not os.environ.get("BENCH_SKIP_GPT"):
+        try:
+            gpt_tok_s, gpt_mfu = bench_gpt(max(4, steps // 4))
+        except Exception as exc:  # noqa: BLE001 — secondary metric
+            print(f"gpt bench failed ({exc})", file=sys.stderr)
 
     baseline = FALLBACK_TORCH_CPU_IPS
     if not os.environ.get("BENCH_SKIP_TORCH"):
@@ -161,13 +221,18 @@ def main() -> None:
             print(f"torch baseline failed ({exc}); using fallback",
                   file=sys.stderr)
 
-    print(json.dumps({
+    out = {
         "metric": "ResNet-50 train images/sec/chip "
                   f"(batch {batch}, {image}x{image}, bf16)",
         "value": round(value, 2),
         "unit": "images/sec/chip",
         "vs_baseline": round(value / baseline, 2),
-    }))
+        "mfu": mfu,
+    }
+    if gpt_tok_s is not None:
+        out["gpt_tokens_per_sec"] = round(gpt_tok_s, 1)
+        out["gpt_mfu"] = round(gpt_mfu, 4)
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

@@ -91,10 +91,11 @@ def unpack(batch):
     return batch
 
 
-def make_loss_fn(conf: Config, train: bool):
+def make_loss_fn(conf: Config, train: bool, norm: str = "group"):
     def loss_fn(params, batch, rng):
         images, labels = unpack(batch)
-        logits = ResNet.apply(params, images, train=train, rng=rng)
+        logits = ResNet.apply(params, images, train=train, rng=rng,
+                              norm=norm)
         loss = cross_entropy(logits, labels,
                              label_smoothing=conf.label_smoothing if train
                              else 0.0)
@@ -102,16 +103,35 @@ def make_loss_fn(conf: Config, train: bool):
     return loss_fn
 
 
-def load_pretrained(conf: Config, params: dict, rng: jax.Array) -> dict:
+def load_pretrained(conf: Config, params: dict,
+                    rng: jax.Array) -> tuple[dict, str]:
     """Restore backbone weights + swap the head (ref resnet.py:93,
-    111-112). Download-on-rank-0 becomes restore-from-local-path."""
-    if conf.pretrained and Path(conf.pretrained).exists():
+    111-112). Download-on-rank-0 becomes restore-from-local-path:
+    ``.pt``/``.pth`` files are torch state_dicts imported via
+    :func:`load_torch_state` (BN folded to frozen affines → the model
+    runs with ``norm="affine"``); anything else restores an orbax
+    params pytree. Returns ``(params, norm_mode)``."""
+    path = Path(conf.pretrained) if conf.pretrained else None
+    if path and not path.exists():
+        # fail loudly: silently fine-tuning random weights (with a
+        # possibly frozen backbone) produces plausible-looking garbage
+        raise FileNotFoundError(
+            f"pretrained checkpoint not found: {path}")
+    if path and path.suffix in (".pt", ".pth"):
+        import torch
+
+        from torchbooster_tpu.models.resnet import load_torch_state
+
+        sd = torch.load(path, map_location="cpu", weights_only=True)
+        params = load_torch_state(sd, num_classes=conf.num_classes,
+                                  rng=rng)
+        return params, "affine"
+    if path:
         import orbax.checkpoint as ocp
 
-        restored = ocp.StandardCheckpointer().restore(
-            Path(conf.pretrained).absolute(), params)
-        params = restored
-    return ResNet.swap_head(params, rng, conf.num_classes)
+        params = ocp.StandardCheckpointer().restore(
+            path.absolute(), params)
+    return ResNet.swap_head(params, rng, conf.num_classes), "group"
 
 
 def main(conf: Config) -> dict:
@@ -129,7 +149,10 @@ def main(conf: Config) -> dict:
 
     params = ResNet.init(rng, depth=conf.depth,
                          num_classes=conf.num_classes, stem="cifar")
-    params = conf.env.make(load_pretrained(conf, params, head_rng))
+    params, norm = load_pretrained(conf, params, head_rng)
+    # front door: YAML mesh decides the layout (fsdp shards conv
+    # kernels via ResNet.SHARDING_RULES; plain dp replicates)
+    params = conf.env.make(params, model=ResNet)
 
     schedule = conf.scheduler.make(conf.optim)
     tx = conf.optim.make(schedule)
@@ -138,11 +161,12 @@ def main(conf: Config) -> dict:
         tx = utils.freeze(lambda path: not path.startswith("head"), tx)
     state = utils.TrainState.create(params, tx, rng=rng)
 
-    train_step = utils.make_step(make_loss_fn(conf, train=True), tx,
-                                 clip=conf.clip,
+    train_step = utils.make_step(make_loss_fn(conf, train=True, norm=norm),
+                                 tx, clip=conf.clip,
                                  compute_dtype=conf.env.compute_dtype())
-    eval_step = utils.make_eval_step(make_loss_fn(conf, train=False),
-                                     compute_dtype=conf.env.compute_dtype())
+    eval_step = utils.make_eval_step(
+        make_loss_fn(conf, train=False, norm=norm),
+        compute_dtype=conf.env.compute_dtype())
 
     results = {}
     for epoch in range(conf.epochs):

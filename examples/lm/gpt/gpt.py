@@ -40,7 +40,6 @@ from torchbooster_tpu.metrics import MetricsAccumulator
 from torchbooster_tpu.models import GPT
 from torchbooster_tpu.models.gpt import GPTConfig
 from torchbooster_tpu.ops.losses import cross_entropy
-from torchbooster_tpu.parallel.sharding import shard_state
 
 
 @dataclass
@@ -118,8 +117,9 @@ def main(conf: Config) -> dict:
     state = utils.TrainState.create(
         GPT.init(rng, cfg), tx, rng=rng,
         accumulate=conf.accumulate_every > 1)
-    # rule-table layout instead of DDP replicate-everything
-    state = shard_state(state, GPT.SHARDING_RULES, mesh)
+    # config front door: the YAML mesh line lays out the whole state by
+    # the model's rule table (replaces DDP's replicate-everything)
+    state = conf.env.make(state, model=GPT)
 
     # checkpoint + the resume half the reference lacked (SURVEY §5.4):
     # restoring `like=state` re-applies the mesh layout, so resume works

@@ -72,6 +72,56 @@ def test_resnet(monkeypatch):
     assert results["train_loss"] > 0.0
 
 
+def test_resnet_yaml_mesh_flip_shards_params(monkeypatch):
+    """VERDICT #5's contract: change ONLY the YAML mesh line and params
+    come back non-replicated — the config front door consumes the
+    model's rule table with zero user code."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from torchbooster_tpu.models import ResNet
+
+    resnet = load_example(monkeypatch, "img_cls", "resnet")
+    conf = resnet.Config.load("resnet.yml")
+    conf.env.distributed = True
+    conf.env.mesh = "dp:2,fsdp:4"
+    conf.env.n_devices = 8
+    params = ResNet.init(jax.random.PRNGKey(0), depth=18, num_classes=10)
+    placed = conf.env.make(params, model=ResNet)
+    spec = placed["stage1"]["block0"]["conv1"]["kernel"].sharding.spec
+    assert spec == P(None, None, None, "fsdp")
+    # and the same call on a dp-only mesh replicates (axis filtered)
+    conf2 = resnet.Config.load("resnet.yml")
+    conf2.env.distributed = True
+    conf2.env.mesh = "dp:8"
+    conf2.env.n_devices = 8
+    placed2 = conf2.env.make(params, model=ResNet)
+    assert placed2["stage1"]["block0"]["conv1"]["kernel"].sharding.spec \
+        == P(None, None, None, None) or not any(
+            placed2["stage1"]["block0"]["conv1"]["kernel"].sharding.spec)
+
+
+def test_resnet_pretrained_torch_import(monkeypatch, tmp_path):
+    """The reference recipe's actual capability: fine-tune from
+    pretrained torch weights (ref resnet.py:93,104-112). A plain-torch
+    resnet18 state_dict stands in for the torchvision download."""
+    torch = pytest.importorskip("torch")
+    from tests.test_torch_import import _torch_resnet18
+
+    ckpt = tmp_path / "resnet18.pt"
+    torch.save(_torch_resnet18().state_dict(), ckpt)
+
+    resnet = load_example(monkeypatch, "img_cls", "resnet")
+    conf = resnet.Config.load("resnet.yml")
+    conf.epochs, conf.loader.batch_size = 1, 32
+    conf.pretrained = str(ckpt)
+    conf.freeze_backbone = True
+    tiny_env(conf)
+    conf.dataset.name = "synthetic_cifar10"
+    results = resnet.main(conf)
+    assert results["train_loss"] > 0.0
+
+
 def test_vae(monkeypatch, tmp_path):
     vae = load_example(monkeypatch, "img_gen", "vae")
     conf = vae.Config.load("vae.yml")

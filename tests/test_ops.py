@@ -66,6 +66,38 @@ def test_ring_attention_sp8():
                                rtol=2e-3, atol=2e-3)
 
 
+@pytest.mark.parametrize("c,relu", [(64, True), (256, True), (96, False),
+                                    (32, False)])
+def test_group_norm_pallas_matches_xla(c, relu):
+    """Fused pallas GroupNorm (ops/group_norm.py) vs the XLA
+    formulation — forward and grads, including the lane-folded layouts
+    (c < 128) and non-pow2 channels."""
+    from torchbooster_tpu.models.layers import group_norm
+
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 8, 8, c)) * 3 + 1.5
+    params = {"scale": jax.random.normal(jax.random.PRNGKey(1), (c,)) + 1.0,
+              "bias": jax.random.normal(jax.random.PRNGKey(2), (c,)) * 0.3}
+
+    def make(impl):
+        return lambda p, xx: group_norm(p, xx, 32, relu=relu, impl=impl)
+
+    ref, pal = make("xla"), make("pallas_interpret")
+    np.testing.assert_allclose(np.asarray(pal(params, x)),
+                               np.asarray(ref(params, x)),
+                               rtol=2e-5, atol=2e-5)
+    loss = lambda f: (lambda p, xx: (f(p, xx) ** 2).sum())  # noqa: E731
+    gr = jax.grad(loss(ref), argnums=(0, 1))(params, x)
+    gp = jax.grad(loss(pal), argnums=(0, 1))(params, x)
+    np.testing.assert_allclose(np.asarray(gp[1]), np.asarray(gr[1]),
+                               rtol=1e-3, atol=1e-3)
+    np.testing.assert_allclose(np.asarray(gp[0]["scale"]),
+                               np.asarray(gr[0]["scale"]),
+                               rtol=1e-3, atol=1e-3)
+    np.testing.assert_allclose(np.asarray(gp[0]["bias"]),
+                               np.asarray(gr[0]["bias"]),
+                               rtol=1e-3, atol=1e-3)
+
+
 def test_cross_entropy_matches_manual():
     logits = jnp.array([[2.0, 0.0, -1.0], [0.0, 1.0, 0.0]])
     labels = jnp.array([0, 1])

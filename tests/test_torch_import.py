@@ -1,0 +1,143 @@
+"""Numeric parity of the torch weight importers.
+
+The reference's resnet recipe is transfer learning from pretrained
+torchvision weights (ref examples/img_cls/resnet/resnet.py:104-112).
+torchvision is not in this image, so both tests build the SAME
+architectures in plain torch with random weights — the *mapping*
+(OIHW→HWIO, BN folding, fc transpose, padding conventions) is what is
+under test, and random weights exercise it exactly as well as
+pretrained ones. BN running stats are randomized so the frozen-BN fold
+is really tested (fresh BNs have mean 0 / var 1, which would hide a
+dropped fold).
+"""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+torch = pytest.importorskip("torch")
+import torch.nn as nn  # noqa: E402
+
+from torchbooster_tpu.models.resnet import ResNet, load_torch_state
+from torchbooster_tpu.models.vgg import VGGFeatures, load_torch_features
+
+
+def _torch_resnet18(classes=1000):
+    """torchvision-architecture resnet18 in plain torch (matching
+    state_dict key names: conv1, bn1, layerN.M.convK/bnK/downsample)."""
+
+    class Basic(nn.Module):
+        def __init__(self, cin, cout, stride):
+            super().__init__()
+            self.conv1 = nn.Conv2d(cin, cout, 3, stride, 1, bias=False)
+            self.bn1 = nn.BatchNorm2d(cout)
+            self.conv2 = nn.Conv2d(cout, cout, 3, 1, 1, bias=False)
+            self.bn2 = nn.BatchNorm2d(cout)
+            self.relu = nn.ReLU()
+            self.downsample = None
+            if stride != 1 or cin != cout:
+                self.downsample = nn.Sequential(
+                    nn.Conv2d(cin, cout, 1, stride, bias=False),
+                    nn.BatchNorm2d(cout))
+
+        def forward(self, x):
+            idn = self.downsample(x) if self.downsample else x
+            y = self.relu(self.bn1(self.conv1(x)))
+            y = self.bn2(self.conv2(y))
+            return self.relu(y + idn)
+
+    class R18(nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.conv1 = nn.Conv2d(3, 64, 7, 2, 3, bias=False)
+            self.bn1 = nn.BatchNorm2d(64)
+            self.relu = nn.ReLU()
+            self.maxpool = nn.MaxPool2d(3, 2, 1)
+            widths, cin = (64, 128, 256, 512), 64
+            for si, w in enumerate(widths):
+                blocks = [Basic(cin, w, 2 if si else 1), Basic(w, w, 1)]
+                setattr(self, f"layer{si + 1}", nn.Sequential(*blocks))
+                cin = w
+            self.avgpool = nn.AdaptiveAvgPool2d(1)
+            self.fc = nn.Linear(512, classes)
+
+        def forward(self, x):
+            x = self.maxpool(self.relu(self.bn1(self.conv1(x))))
+            for si in range(4):
+                x = getattr(self, f"layer{si + 1}")(x)
+            return self.fc(self.avgpool(x).flatten(1))
+
+    return R18()
+
+
+def _randomize_bn_stats(model, gen):
+    for m in model.modules():
+        if isinstance(m, nn.BatchNorm2d):
+            m.running_mean.copy_(torch.randn(
+                m.running_mean.shape, generator=gen) * 0.5)
+            m.running_var.copy_(torch.rand(
+                m.running_var.shape, generator=gen) * 2 + 0.5)
+
+
+def test_resnet_torch_import_exact():
+    """load_torch_state + apply(norm="affine") matches torch eval-mode
+    forward on the same input — the BN fold, kernel transposes, and
+    padding conventions are all exact."""
+    gen = torch.Generator().manual_seed(0)
+    with torch.no_grad():
+        model = _torch_resnet18()
+        _randomize_bn_stats(model, gen)
+        model.eval()
+        x = torch.randn(2, 3, 64, 64, generator=gen)
+        want = model(x).numpy()
+
+    params = load_torch_state(model.state_dict())
+    got = ResNet.apply(params, jnp.asarray(
+        x.numpy().transpose(0, 2, 3, 1)), norm="affine")
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-3, atol=1e-3)
+
+
+def test_resnet_torch_import_head_swap():
+    gen = torch.Generator().manual_seed(1)
+    with torch.no_grad():
+        model = _torch_resnet18()
+        _randomize_bn_stats(model, gen)
+    import jax
+
+    params = load_torch_state(model.state_dict(), num_classes=10,
+                              rng=jax.random.PRNGKey(0))
+    assert params["head"]["kernel"].shape == (512, 10)
+    out = ResNet.apply(params, jnp.zeros((1, 64, 64, 3)), norm="affine")
+    assert out.shape == (1, 10)
+
+
+def test_vgg_torch_import_exact():
+    """load_torch_features(features=...) matches the torch Sequential's
+    conv taps on the same input."""
+    layout = [64, 64, "M", 128, 128, "M", 256, 256, 256, "M",
+              512, 512, 512, "M", 512, 512, 512, "M"]  # vgg16 features
+    mods, cin = [], 3
+    for item in layout:
+        if item == "M":
+            mods.append(nn.MaxPool2d(2, 2))
+        else:
+            mods.append(nn.Conv2d(cin, item, 3, 1, 1))
+            mods.append(nn.ReLU())
+            cin = item
+    features = nn.Sequential(*mods)
+
+    import jax
+
+    params = VGGFeatures.init(jax.random.PRNGKey(0), depth=16)
+    params = load_torch_features(params, features=features)
+
+    gen = torch.Generator().manual_seed(2)
+    with torch.no_grad():
+        x = torch.randn(2, 3, 32, 32, generator=gen)
+        want = features(x).numpy()            # final tap, NCHW
+
+    got = VGGFeatures.apply(params, jnp.asarray(
+        x.numpy().transpose(0, 2, 3, 1)))[-1]
+    np.testing.assert_allclose(np.asarray(got),
+                               want.transpose(0, 2, 3, 1),
+                               rtol=1e-3, atol=1e-3)

@@ -321,17 +321,27 @@ class EnvConfig(BaseConfig):
             return jnp.bfloat16
         return jnp.float32
 
-    def make(self, *args: Any) -> Any:
+    def make(self, *args: Any, model: Any = None,
+             rules: Any = None) -> Any:
         """Place objects into the environment (ref ``to_env``,
-        config.py:154-182): array pytrees are device_put replicated over
-        the mesh (params — the DP analogue of DDP's initial broadcast,
-        ref config.py:178); use :meth:`shard_batch` for data. A single
+        config.py:154-182): array pytrees are device_put over the mesh
+        (params — the DP analogue of DDP's initial broadcast, ref
+        config.py:178); use :meth:`shard_batch` for data. A single
         argument returns the object, several return a list
-        (ref config.py:333-334)."""
+        (ref config.py:333-334).
+
+        Pass ``model=`` (anything carrying ``SHARDING_RULES``) or
+        ``rules=`` to lay parameters/TrainStates out by the rule table
+        instead of replicating — the YAML ``mesh:`` line then IS the
+        parallelism config ("that flip is the product", SURVEY §7);
+        axes absent from the mesh are filtered, so the same call works
+        from 1 device through dp×fsdp×tp."""
         from torchbooster_tpu import distributed as dist
 
+        if rules is None and model is not None:
+            rules = getattr(model, "SHARDING_RULES", None)
         mesh = dist.get_mesh(self)
-        placed = [dist.to_env(obj, mesh) for obj in args]
+        placed = [dist.to_env(obj, mesh, rules=rules) for obj in args]
         return placed[0] if len(placed) == 1 else placed
 
     def shard_batch(self, batch: Any) -> Any:

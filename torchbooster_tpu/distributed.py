@@ -212,11 +212,25 @@ def batch_sharding(mesh: Mesh, ndim: int = 1) -> NamedSharding:
     return NamedSharding(mesh, P(*spec))
 
 
-def to_env(obj: Any, mesh: Mesh) -> Any:
-    """Place an array pytree replicated over the mesh — the analogue of
-    DDP's initial parameter broadcast (ref config.py:176-178). Non-array
-    leaves pass through untouched (ref to_env passes unknown types
-    through, config.py:182)."""
+def to_env(obj: Any, mesh: Mesh, rules: Any = None) -> Any:
+    """Place an array pytree over the mesh — the analogue of DDP's
+    initial parameter broadcast (ref config.py:176-178). Without
+    ``rules`` everything replicates (correct for plain dp). With a
+    ``(path_regex, PartitionSpec)`` rule table — a model's
+    ``SHARDING_RULES`` — parameters are laid out by it instead, so a
+    YAML ``mesh: "dp:2,fsdp:2,tp:2"`` shards weights with no user code
+    (the one-switch contract, SURVEY §7). TrainStates shard as a whole
+    (opt_state/grad_acc mirror the param layout); the rules path
+    expects pure array pytrees. On the replicate path, non-array leaves
+    pass through untouched (ref to_env passes unknown types through,
+    config.py:182)."""
+    if rules is not None:
+        from torchbooster_tpu.parallel.sharding import (
+            shard_params, shard_state)
+
+        if hasattr(obj, "params") and hasattr(obj, "opt_state"):
+            return shard_state(obj, rules, mesh)
+        return shard_params(obj, mesh, rules)
     sharding = replicated(mesh)
 
     def place(leaf: Any) -> Any:

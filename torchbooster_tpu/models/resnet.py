@@ -3,9 +3,20 @@
 Capability parity with the reference's ResNet recipe (ref
 examples/img_cls/resnet/resnet.py:104-112: torchvision resnet18 with its
 fc head swapped for the target class count). The reference imports a
-pretrained torch model; here the architecture is implemented natively
-(pretrained torchvision weights can be loaded via
-:func:`load_torch_state` which maps NCHW→NHWC kernels).
+pretrained torch model; here :func:`load_torch_state` imports a
+torchvision-convention ``state_dict`` (NCHW OIHW → NHWC HWIO kernels).
+
+**BatchNorm→GroupNorm policy** (documented, not silent): pretrained
+torch ResNets carry BatchNorm running statistics, which GroupNorm
+cannot reproduce (its stats are data-dependent). The importer therefore
+*folds* each BN's running stats + affine into an exact per-channel
+affine — ``a = γ/√(σ²+ε)``, ``b = β − μ·a`` — and the model runs those
+as frozen-BN affines (``apply(..., norm="affine")``), the standard
+formulation for transfer learning (torchvision's own detection models
+freeze BN the same way). This makes the import numerically EXACT
+against torch's eval-mode forward (tested in
+tests/test_torch_import.py). Training from scratch keeps GroupNorm
+(``norm="group"``, the default); both modes share one param tree shape.
 
 Design: basic block (two 3×3) for 18/34, bottleneck (1-3-1) for 50/101;
 GroupNorm instead of BatchNorm (stateless, no cross-replica sync — see
@@ -14,10 +25,11 @@ for the 3×3/s1 CIFAR stem.
 """
 from __future__ import annotations
 
-from typing import Any
+from typing import Any, Mapping
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from torchbooster_tpu.models import layers as L
 
@@ -48,14 +60,28 @@ def _basic_block_init(rng: jax.Array, cin: int, cout: int, stride: int,
     return block
 
 
-def _basic_block(params: dict, x: jax.Array, stride: int) -> jax.Array:
-    y = L.conv(params["conv1"], x, stride=stride)
-    y = jax.nn.relu(L.group_norm(params["norm1"], y, _GROUPS))
-    y = L.conv(params["conv2"], y)
-    y = L.group_norm(params["norm2"], y, _GROUPS)
+def _norm(params: dict, x: jax.Array, norm: str, relu: bool = False):
+    """``norm="group"``: GroupNorm. ``norm="affine"``: frozen-BN
+    per-channel affine (same {scale, bias} param shapes — see module
+    docstring on the torch-import policy)."""
+    if norm == "affine":
+        y = x * params["scale"].astype(x.dtype) \
+            + params["bias"].astype(x.dtype)
+        return jax.nn.relu(y) if relu else y
+    return L.group_norm(params, x, _GROUPS, relu=relu)
+
+
+def _basic_block(params: dict, x: jax.Array, stride: int,
+                 norm: str) -> jax.Array:
+    # explicit padding=1 (not "SAME"): identical at stride 1, but
+    # torch-symmetric at stride 2 — keeps torch imports exact
+    y = L.conv(params["conv1"], x, stride=stride, padding=1)
+    y = _norm(params["norm1"], y, norm, relu=True)
+    y = L.conv(params["conv2"], y, padding=1)
+    y = _norm(params["norm2"], y, norm)
     if "proj" in params:
-        x = L.group_norm(params["proj_norm"],
-                         L.conv(params["proj"], x, stride=stride), _GROUPS)
+        x = _norm(params["proj_norm"],
+                  L.conv(params["proj"], x, stride=stride), norm)
     return jax.nn.relu(x + y)
 
 
@@ -78,17 +104,28 @@ def _bottleneck_init(rng: jax.Array, cin: int, cmid: int, stride: int,
     return block
 
 
-def _bottleneck(params: dict, x: jax.Array, stride: int) -> jax.Array:
-    y = jax.nn.relu(L.group_norm(params["norm1"],
-                                 L.conv(params["conv1"], x), _GROUPS))
-    y = jax.nn.relu(L.group_norm(params["norm2"],
-                                 L.conv(params["conv2"], y, stride=stride),
-                                 _GROUPS))
-    y = L.group_norm(params["norm3"], L.conv(params["conv3"], y), _GROUPS)
+def _bottleneck(params: dict, x: jax.Array, stride: int,
+                norm: str) -> jax.Array:
+    y = _norm(params["norm1"], L.conv(params["conv1"], x), norm, relu=True)
+    y = _norm(params["norm2"],
+              L.conv(params["conv2"], y, stride=stride, padding=1),
+              norm, relu=True)
+    y = _norm(params["norm3"], L.conv(params["conv3"], y), norm)
     if "proj" in params:
-        x = L.group_norm(params["proj_norm"],
-                         L.conv(params["proj"], x, stride=stride), _GROUPS)
+        x = _norm(params["proj_norm"],
+                  L.conv(params["proj"], x, stride=stride), norm)
     return jax.nn.relu(x + y)
+
+
+# FSDP/ZeRO layout for the config front door (EnvConfig.make consumes
+# this): conv kernels shard their output-channel dim, the head its
+# input dim. dp-only meshes filter these away → plain replication.
+SHARDING_RULES = [
+    (r"(conv[0-9]*|proj)/kernel", jax.sharding.PartitionSpec(
+        None, None, None, "fsdp")),
+    (r"head/kernel", jax.sharding.PartitionSpec("fsdp", None)),
+    (r".*", jax.sharding.PartitionSpec()),
+]
 
 
 class ResNet:
@@ -97,6 +134,8 @@ class ResNet:
     repeats, stem) rides inside params under the ``"_meta"``-free
     convention: apply re-derives structure from the params tree itself,
     so params remain a pure array pytree (jit-donatable)."""
+
+    SHARDING_RULES = SHARDING_RULES
 
     @staticmethod
     def init(rng: jax.Array, depth: int = 18, num_classes: int = 10,
@@ -132,16 +171,18 @@ class ResNet:
     @staticmethod
     def apply(params: dict, x: jax.Array, train: bool = False,
               rng: jax.Array | None = None,
-              pool_stem: bool | None = None) -> jax.Array:
+              pool_stem: bool | None = None,
+              norm: str = "group") -> jax.Array:
         del train, rng
         stem = params["stem"]
         stem_stride = 2 if stem["conv"]["kernel"].shape[0] == 7 else 1
         if pool_stem is None:
             pool_stem = stem_stride == 2
-        x = L.conv(stem["conv"], x, stride=stem_stride)
-        x = jax.nn.relu(L.group_norm(stem["norm"], x, _GROUPS))
+        stem_pad = 3 if stem_stride == 2 else 1
+        x = L.conv(stem["conv"], x, stride=stem_stride, padding=stem_pad)
+        x = _norm(stem["norm"], x, norm, relu=True)
         if pool_stem:
-            x = L.max_pool(x, 3, 2, padding="SAME")
+            x = L.max_pool(x, 3, 2, padding=1)
         si = 0
         while f"stage{si}" in params:
             stage = params[f"stage{si}"]
@@ -150,9 +191,9 @@ class ResNet:
                 block = stage[f"block{bi}"]
                 stride = 2 if (bi == 0 and si > 0) else 1
                 if "conv3" in block:
-                    x = _bottleneck(block, x, stride)
+                    x = _bottleneck(block, x, stride, norm)
                 else:
-                    x = _basic_block(block, x, stride)
+                    x = _basic_block(block, x, stride, norm)
                 bi += 1
             si += 1
         x = L.global_avg_pool(x)
@@ -166,4 +207,79 @@ class ResNet:
         return {**params, "head": L.dense_init(rng, din, num_classes)}
 
 
-__all__ = ["ResNet"]
+def _np(t: Any) -> np.ndarray:
+    """torch tensor / numpy array → numpy (no torch import needed)."""
+    if hasattr(t, "detach"):
+        t = t.detach().cpu().numpy()
+    return np.asarray(t)
+
+
+def _fold_bn(sd: Mapping[str, Any], prefix: str,
+             eps: float = 1e-5) -> dict:
+    """BatchNorm running stats + affine → exact frozen-BN per-channel
+    affine (the BatchNorm→GroupNorm policy — see module docstring)."""
+    gamma = _np(sd[f"{prefix}.weight"]).astype(np.float32)
+    beta = _np(sd[f"{prefix}.bias"]).astype(np.float32)
+    mean = _np(sd[f"{prefix}.running_mean"]).astype(np.float32)
+    var = _np(sd[f"{prefix}.running_var"]).astype(np.float32)
+    a = gamma / np.sqrt(var + eps)
+    return {"scale": jnp.asarray(a), "bias": jnp.asarray(beta - mean * a)}
+
+
+def _conv_kernel(sd: Mapping[str, Any], key: str) -> dict:
+    """torch OIHW conv weight → HWIO kernel."""
+    return {"kernel": jnp.asarray(
+        _np(sd[key]).astype(np.float32).transpose(2, 3, 1, 0))}
+
+
+def load_torch_state(state_dict: Mapping[str, Any],
+                     num_classes: int | None = None,
+                     rng: jax.Array | None = None) -> dict:
+    """Build ResNet params from a torchvision-convention ``state_dict``
+    (the capability behind ref examples/img_cls/resnet/resnet.py:104-112,
+    which fine-tunes a pretrained torchvision resnet18).
+
+    Accepts torch tensors or numpy arrays (a ``torch.load``-ed
+    checkpoint works without torchvision). Depth and block kind are
+    inferred from the keys. BatchNorms are folded to exact frozen-BN
+    affines — run the result with ``ResNet.apply(..., norm="affine")``;
+    parity with torch's eval-mode forward is exact up to float error.
+
+    ``num_classes`` (with ``rng``) swaps the classifier head for
+    transfer learning, mirroring the reference's ``model.fc``
+    replacement; omit it to keep the imported 1000-way head.
+    """
+    sd = state_dict
+    params: dict = {"stem": {"conv": _conv_kernel(sd, "conv1.weight"),
+                             "norm": _fold_bn(sd, "bn1")}}
+    for si in range(4):
+        lp = f"layer{si + 1}"
+        stage: dict = {}
+        bi = 0
+        while f"{lp}.{bi}.conv1.weight" in sd:
+            bp = f"{lp}.{bi}"
+            block = {"conv1": _conv_kernel(sd, f"{bp}.conv1.weight"),
+                     "norm1": _fold_bn(sd, f"{bp}.bn1"),
+                     "conv2": _conv_kernel(sd, f"{bp}.conv2.weight"),
+                     "norm2": _fold_bn(sd, f"{bp}.bn2")}
+            if f"{bp}.conv3.weight" in sd:
+                block["conv3"] = _conv_kernel(sd, f"{bp}.conv3.weight")
+                block["norm3"] = _fold_bn(sd, f"{bp}.bn3")
+            if f"{bp}.downsample.0.weight" in sd:
+                block["proj"] = _conv_kernel(sd, f"{bp}.downsample.0.weight")
+                block["proj_norm"] = _fold_bn(sd, f"{bp}.downsample.1")
+            stage[f"block{bi}"] = block
+            bi += 1
+        params[f"stage{si}"] = stage
+    w = _np(sd["fc.weight"]).astype(np.float32)       # (classes, cin)
+    params["head"] = {"kernel": jnp.asarray(w.T),
+                      "bias": jnp.asarray(
+                          _np(sd["fc.bias"]).astype(np.float32))}
+    if num_classes is not None and num_classes != w.shape[0]:
+        if rng is None:
+            raise ValueError("num_classes swap needs an rng")
+        params = ResNet.swap_head(params, rng, num_classes)
+    return params
+
+
+__all__ = ["ResNet", "load_torch_state"]

@@ -110,18 +110,24 @@ class VGGFeatures:
         return (x - mean) / std
 
 
-def load_torch_features(params: dict) -> dict:
-    """Import torchvision pretrained VGG features into ``params``
-    (NCHW OIHW conv weights → NHWC HWIO); the VGG depth is derived from
-    the param tree so weights cannot be loaded into a mismatched model.
-    Requires network access for the torchvision download; offline
-    environments keep random weights."""
-    from torchvision.models import vgg16, vgg19  # type: ignore
+def load_torch_features(params: dict, features=None) -> dict:
+    """Import torch VGG feature weights into ``params`` (NCHW OIHW conv
+    weights → NHWC HWIO); the VGG depth is derived from the param tree
+    so weights cannot be loaded into a mismatched model.
 
-    depth = VGGFeatures._depth_of(params)
-    model = (vgg19 if depth == 19 else vgg16)(weights="DEFAULT").features
+    ``features``: a torch ``nn.Sequential`` in torchvision VGG layout
+    (Conv2d/ReLU/MaxPool2d by slot). When omitted, downloads the
+    pretrained torchvision model (needs network + torchvision); passing
+    it explicitly keeps the mapping usable — and numerically testable
+    (tests/test_torch_import.py) — offline."""
+    if features is None:
+        from torchvision.models import vgg16, vgg19  # type: ignore
+
+        depth = VGGFeatures._depth_of(params)
+        features = (vgg19 if depth == 19 else vgg16)(
+            weights="DEFAULT").features
     out = dict(params)
-    for slot, module in enumerate(model):
+    for slot, module in enumerate(features):
         if module.__class__.__name__ == "Conv2d":
             w = module.weight.detach().numpy().transpose(2, 3, 1, 0)
             b = module.bias.detach().numpy()
