@@ -1,0 +1,118 @@
+"""Worker for the 2-process multi-host runtime test.
+
+Spawned by ``tests/test_multihost.py`` as
+
+    python tests/_multihost_worker.py <port> <rank> <ckpt_dir>
+
+with ``JAX_PLATFORMS=cpu`` and 2 virtual CPU devices per process, so the
+global runtime is 2 processes x 2 devices = 4 devices. This executes, in a
+real multi-process ``jax.distributed`` runtime, every branch that is dead
+single-process:
+
+- ``dist.launch``'s ``jax.distributed.initialize`` path
+  (distributed.py:280-293) — the analogue of the reference's rendezvous
+  (ref distributed.py:110-205),
+- ``dist.gather``'s ``process_allgather`` path (distributed.py:89),
+- ``dist.synchronize``'s real barrier (distributed.py:79-80),
+- ``_place_global``'s ``make_array_from_process_local_data`` path
+  (data/pipeline.py:233-238) feeding a sharded train step,
+- ``SaveCallback``'s multi-host orbax save + restore (callbacks.py:6-8).
+
+Prints ``MULTIHOST_OK rank=<rank>`` on success; any assertion or crash
+fails the spawning test.
+"""
+from __future__ import annotations
+
+import sys
+
+PORT, RANK, CKPT_DIR = sys.argv[1], int(sys.argv[2]), sys.argv[3]
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from torchbooster_tpu import distributed as dist
+from torchbooster_tpu.callbacks import SaveCallback
+from torchbooster_tpu.data.pipeline import DataLoader, prefetch_to_device
+from torchbooster_tpu.utils import TrainState, make_step
+
+
+def job() -> None:
+    # --- runtime topology: 2 processes x 2 local devices ---
+    assert jax.process_count() == 2, jax.process_count()
+    assert jax.device_count() == 4, jax.device_count()
+    assert dist.get_rank() == RANK
+    assert dist.get_world_size() == 2
+    assert dist.is_primary() == (RANK == 0)
+    dist.synchronize("start")
+
+    # --- gather: the process_allgather branch ---
+    gathered = dist.gather({"rank": np.array([RANK], np.int32),
+                            "twice": np.array([2 * RANK], np.int32)})
+    assert np.asarray(gathered["rank"]).reshape(-1).tolist() == [0, 1]
+    assert np.asarray(gathered["twice"]).reshape(-1).tolist() == [0, 2]
+
+    mesh = dist.make_mesh("dp")  # dp over all 4 global devices
+    assert len(dist.local_devices(mesh)) == 2
+
+    # --- data: distributed loader -> prefetch -> _place_global multi-host ---
+    n, d, global_batch = 32, 4, 8
+    rng0 = np.random.RandomState(0)
+    xs = rng0.randn(n, d).astype(np.float32)
+    w_true = np.arange(1, d + 1, dtype=np.float32).reshape(d, 1)
+    ys = xs @ w_true
+    dataset = [(xs[i], ys[i]) for i in range(n)]
+    loader = DataLoader(dataset, batch_size=global_batch, shuffle=False,
+                        distributed=True, drop_last=True)
+    assert loader.local_batch == global_batch // 2
+
+    def loss_fn(params, batch, rng):
+        x, y = batch
+        pred = x @ params["w"]
+        return jnp.mean((pred - y) ** 2), {}
+
+    tx = optax.sgd(0.05)
+    params = {"w": jnp.zeros((d, 1), jnp.float32)}
+    state = TrainState.create(dist.to_env(params, mesh), tx)
+    step = make_step(loss_fn, tx, mesh=mesh)
+
+    losses = []
+    for _ in range(3):  # epochs
+        for batch in prefetch_to_device(loader, mesh):
+            x = batch[0]
+            # the batch is a *global* array assembled from per-process
+            # local slices, sharded over dp
+            assert x.shape == (global_batch, d), x.shape
+            assert not x.sharding.is_fully_replicated
+            state, metrics = step(state, batch)
+            losses.append(float(metrics["loss"]))
+    assert losses[-1] < losses[0] * 0.2, (losses[0], losses[-1])
+
+    # all processes see identical params (the DDP allreduce contract)
+    w_all = dist.gather(np.asarray(jax.device_get(state.params["w"])))
+    np.testing.assert_allclose(np.asarray(w_all)[0], np.asarray(w_all)[1],
+                               rtol=0, atol=0)
+
+    # --- orbax save + restore, every process participating ---
+    cb = SaveCallback(every=1, n_iter=100, root=CKPT_DIR)
+    cb.save(int(state.step), state=state)
+    cb.wait()
+    dist.synchronize("saved")
+    assert cb.latest_step() == int(state.step)
+    restored = cb.restore(like={"state": state})
+    np.testing.assert_allclose(
+        np.asarray(jax.device_get(restored["state"].params["w"])),
+        np.asarray(jax.device_get(state.params["w"])))
+    assert int(restored["state"].step) == int(state.step)
+
+    dist.synchronize("done")
+    print(f"MULTIHOST_OK rank={RANK}", flush=True)
+
+
+if __name__ == "__main__":
+    dist.launch(job, n_machine=2, machine_rank=RANK,
+                dist_url=f"localhost:{PORT}")
