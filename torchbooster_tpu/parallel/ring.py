@@ -49,29 +49,44 @@ def _ring_local(q: jax.Array, k: jax.Array, v: jax.Array, *, axis: str,
         v_next = lax.ppermute(v_t, axis, perm)
 
         src_chunk = (my_chunk - t) % sp_size
-        scores = jnp.einsum("bqhd,bkhd->bhqk", qf,
-                            k_t.astype(jnp.float32))
-        if causal:
-            # src < mine: fully visible; src == mine: lower triangle;
-            # src > mine (wrapped future): fully masked
-            tri = iq >= ik
-            visible = jnp.where(
-                src_chunk == my_chunk, tri,
-                (src_chunk < my_chunk)[None, None])
-            mask = jnp.broadcast_to(visible, scores.shape)
-        else:
-            mask = jnp.ones_like(scores, bool)
 
-        scores = jnp.where(mask, scores, NEG_INF)
-        m_cur = jnp.maximum(m_prev, scores.max(axis=-1))
-        correction = jnp.exp(m_prev - m_cur)
-        # multiply by mask so fully-masked blocks contribute exactly 0
-        # (avoids exp(-inf − -inf) = 1 poisoning)
-        p = jnp.exp(scores - m_cur[..., None]) * mask
-        l_cur = l_prev * correction + p.sum(axis=-1)
-        pv = jnp.einsum("bhqk,bkhd->bqhd", p, v_t.astype(jnp.float32))
-        acc_cur = (acc_prev * correction.transpose(0, 2, 1)[..., None]
-                   + pv)
+        def attend(kv):
+            k_blk, v_blk = kv
+            scores = jnp.einsum("bqhd,bkhd->bhqk", qf,
+                                k_blk.astype(jnp.float32))
+            if causal:
+                # src < mine: fully visible; src == mine: lower triangle
+                # (src > mine never reaches here — skipped below)
+                tri = iq >= ik
+                visible = jnp.where(src_chunk == my_chunk, tri, True)
+                mask = jnp.broadcast_to(visible, scores.shape)
+            else:
+                mask = jnp.ones_like(scores, bool)
+
+            scores = jnp.where(mask, scores, NEG_INF)
+            m_cur = jnp.maximum(m_prev, scores.max(axis=-1))
+            correction = jnp.exp(m_prev - m_cur)
+            # multiply by mask so masked rows contribute exactly 0
+            # (avoids exp(-inf − -inf) = 1 poisoning)
+            p = jnp.exp(scores - m_cur[..., None]) * mask
+            l_cur = l_prev * correction + p.sum(axis=-1)
+            pv = jnp.einsum("bhqk,bkhd->bqhd", p,
+                            v_blk.astype(jnp.float32))
+            acc_cur = (acc_prev * correction.transpose(0, 2, 1)[..., None]
+                       + pv)
+            return m_cur, l_cur, acc_cur
+
+        if causal:
+            # a wrapped-future block (src > mine) is fully masked: its
+            # masked-out computation is the identity on (m, l, acc), so
+            # skip both MXU matmuls entirely — causal costs ~(sp+1)/2sp
+            # of the full ring instead of all of it
+            m_cur, l_cur, acc_cur = lax.cond(
+                src_chunk > my_chunk,
+                lambda kv: (m_prev, l_prev, acc_prev),
+                attend, (k_t, v_t))
+        else:
+            m_cur, l_cur, acc_cur = attend((k_t, v_t))
         return k_next, v_next, m_cur, l_cur, acc_cur
 
     _, _, m, l, acc = lax.fori_loop(0, sp_size, step, (k, v, m, l, acc))
