@@ -1,14 +1,14 @@
 """Mixture-of-Experts layer with expert parallelism (GShard-style).
 
 No reference counterpart (the reference has no transformer at all,
-SURVEY §5.7); this is the ``ep`` mesh axis made real. The formulation
-is the einsum dispatch/combine of GShard/Mesh-TensorFlow: tokens are
-routed top-k into per-expert capacity buffers by one-hot einsums, the
-expert MLPs run as one batched einsum over the stacked expert weights,
-and results scatter back weighted by the gate. Everything is dense
-linear algebra with static shapes — XLA turns the expert-axis sharding
-(``P("ep", ...)``) into the all-to-all pair around the expert compute;
-there is no host-side routing.
+SURVEY §5.7); this is the ``ep`` mesh axis made real. Tokens are routed
+top-k into per-expert capacity buffers — by flat-index scatter/gather
+(default; O(T·d + E·C·d) peak memory) or by the GShard one-hot
+dispatch/combine einsums (the O(T·E·C) parity oracle) — the expert MLPs
+run as one batched einsum over the stacked expert weights, and results
+combine back weighted by the gate. Everything has static shapes — XLA
+turns the expert-axis sharding (``P("ep", ...)``) into the collective
+pair around the expert compute; there is no host-side routing.
 
 Design notes (TPU-first):
 - capacity is static: ``C = ceil(k·T/E · capacity_factor)`` — overflow
@@ -17,7 +17,9 @@ Design notes (TPU-first):
 - the auxiliary load-balance loss (Switch/GShard ``mean(frac·prob)·E``)
   is returned alongside the output; recipes add it to the task loss.
 - position-in-expert is computed with a cumsum over tokens — O(T·E)
-  on the VPU, no sort, no scatter.
+  on the VPU, no sort; the default dispatch then moves tokens by flat
+  1-D scatter-add / gather (whose transposes are each other, so the
+  path is differentiable for free).
 """
 from __future__ import annotations
 
@@ -30,8 +32,10 @@ from jax.sharding import PartitionSpec as P
 from torchbooster_tpu.models import layers as L
 
 # rules fragment for a stacked-MoE block (leading axis = scan layer);
-# experts shard over ep, hidden over tp — the dispatch einsum's output
-# (E, C, d) picks up P("ep") from the weights, which is the all-to-all
+# experts shard over ep, hidden over tp — the (E, C, d) expert batch
+# (scatter-buffer reshape, or the oracle's dispatch einsum) meets the
+# P("ep", ...) weights in the expert matmuls, where XLA places the
+# resharding collective
 SHARDING_RULES = [
     (r"moe_gate/kernel", P(None, None, None)),
     (r"moe_fc1/kernel", P(None, "ep", None, "tp")),
@@ -65,10 +69,20 @@ def moe_init(rng: jax.Array, n_experts: int, d_model: int, hidden: int,
 
 def moe_apply(params: dict, x: jax.Array, top_k: int = 2,
               capacity_factor: float = 1.25,
-              activation=jax.nn.gelu) -> tuple[jax.Array, jax.Array]:
+              activation=jax.nn.gelu,
+              impl: str = "scatter") -> tuple[jax.Array, jax.Array]:
     """(B, S, d) → ((B, S, d), aux_loss). Top-``top_k`` routing with
     static per-expert capacity; dropped tokens pass through as zeros
-    (the residual connection around the block carries them)."""
+    (the residual connection around the block carries them).
+
+    ``impl``:
+    - ``"scatter"`` (default): tokens scatter into the (E·C, d) expert
+      buffer by flat slot index and gather back out — peak routing
+      memory is O(T·d + E·C·d); no (T, E, C) tensor ever exists, so
+      long sequences (T=16k+) stay cheap.
+    - ``"einsum"``: the GShard one-hot dispatch/combine einsums —
+      O(T·E·C) memory. Kept as the parity oracle for the scatter path.
+    """
     b, s, d = x.shape
     tokens = x.reshape(b * s, d)
     t = tokens.shape[0]
@@ -79,9 +93,10 @@ def moe_apply(params: dict, x: jax.Array, top_k: int = 2,
     gate_logits = L.dense(params["moe_gate"], tokens)      # (T, E)
     probs = jax.nn.softmax(gate_logits.astype(jnp.float32), axis=-1)
 
-    # top-k selection, one expert at a time (k is tiny and static)
-    combine = jnp.zeros((t, n_experts, capacity), jnp.float32)
-    dispatch = jnp.zeros((t, n_experts, capacity), jnp.bool_)
+    # top-k selection, one expert at a time (k is tiny and static):
+    # per round, each token's expert id, gate weight, position within
+    # that expert's capacity buffer, and whether it fit
+    rounds: list[tuple[jax.Array, jax.Array, jax.Array, jax.Array]] = []
     remaining = probs
     # position counters per expert accumulate across the k rounds
     fill = jnp.zeros((n_experts,), jnp.int32)
@@ -94,27 +109,58 @@ def moe_apply(params: dict, x: jax.Array, top_k: int = 2,
         position = jnp.cumsum(onehot, axis=0) - 1 + fill[None, :]
         pos = jnp.sum(position * onehot, axis=-1)          # (T,)
         keep = pos < capacity
-        pos_oh = jax.nn.one_hot(pos, capacity, dtype=jnp.float32)
-        slot = onehot.astype(jnp.float32)[:, :, None] * pos_oh[:, None, :]
-        slot = slot * keep[:, None, None].astype(jnp.float32)
-        combine = combine + weight[:, None, None] * slot
-        dispatch = dispatch | (slot > 0)
+        rounds.append((expert, weight, pos, keep))
         fill = fill + jnp.sum(onehot, axis=0)
         remaining = remaining * (1.0 - onehot.astype(jnp.float32))
 
-    # dispatch: (T, E, C) × (T, d) → per-expert batches (E, C, d)
-    expert_in = jnp.einsum(
-        "tec,td->ecd", dispatch.astype(x.dtype), tokens)
-    # expert MLPs over the stacked weights — one batched matmul pair
-    h = jnp.einsum("ecd,edh->ech", expert_in,
-                   params["moe_fc1"]["kernel"].astype(x.dtype))
-    h = activation(h + params["moe_fc1"]["bias"].astype(x.dtype)[:, None, :])
-    expert_out = jnp.einsum("ech,ehd->ecd", h,
-                            params["moe_fc2"]["kernel"].astype(x.dtype))
-    expert_out = expert_out + \
-        params["moe_fc2"]["bias"].astype(x.dtype)[:, None, :]
-    # combine back, gate-weighted
-    out = jnp.einsum("tec,ecd->td", combine.astype(x.dtype), expert_out)
+    def expert_mlps(expert_in: jax.Array) -> jax.Array:
+        # expert MLPs over the stacked weights — one batched matmul pair
+        h = jnp.einsum("ecd,edh->ech", expert_in,
+                       params["moe_fc1"]["kernel"].astype(x.dtype))
+        h = activation(
+            h + params["moe_fc1"]["bias"].astype(x.dtype)[:, None, :])
+        expert_out = jnp.einsum("ech,ehd->ecd", h,
+                                params["moe_fc2"]["kernel"].astype(x.dtype))
+        return expert_out + \
+            params["moe_fc2"]["bias"].astype(x.dtype)[:, None, :]
+
+    if impl == "scatter":
+        # flat slot id e·C + c; each (token, round) owns at most one
+        # slot and no two tokens share one, so scatter-add never
+        # collides. Dropped tokens get an out-of-range id and vanish
+        # via mode="drop" / gather fill — the transposes (gather /
+        # scatter-add) make the whole path differentiable.
+        flat = jnp.zeros((n_experts * capacity, d), x.dtype)
+        dsts = []
+        for expert, weight, pos, keep in rounds:
+            dst = jnp.where(keep, expert * capacity + pos,
+                            n_experts * capacity)
+            dsts.append(dst)
+            flat = flat.at[dst].add(tokens, mode="drop")
+        expert_out = expert_mlps(flat.reshape(n_experts, capacity, d))
+        flat_out = expert_out.reshape(n_experts * capacity, d)
+        out = jnp.zeros((t, d), x.dtype)
+        for (expert, weight, pos, keep), dst in zip(rounds, dsts):
+            gathered = flat_out.at[dst].get(mode="fill", fill_value=0)
+            out = out + weight.astype(x.dtype)[:, None] * gathered
+    elif impl == "einsum":
+        combine = jnp.zeros((t, n_experts, capacity), jnp.float32)
+        dispatch = jnp.zeros((t, n_experts, capacity), jnp.bool_)
+        for expert, weight, pos, keep in rounds:
+            onehot = jax.nn.one_hot(expert, n_experts, dtype=jnp.float32)
+            pos_oh = jax.nn.one_hot(pos, capacity, dtype=jnp.float32)
+            slot = onehot[:, :, None] * pos_oh[:, None, :]
+            slot = slot * keep[:, None, None].astype(jnp.float32)
+            combine = combine + weight[:, None, None] * slot
+            dispatch = dispatch | (slot > 0)
+        # dispatch: (T, E, C) × (T, d) → per-expert batches (E, C, d)
+        expert_in = jnp.einsum(
+            "tec,td->ecd", dispatch.astype(x.dtype), tokens)
+        expert_out = expert_mlps(expert_in)
+        # combine back, gate-weighted
+        out = jnp.einsum("tec,ecd->td", combine.astype(x.dtype), expert_out)
+    else:
+        raise ValueError(f"unknown moe impl {impl!r}")
 
     # Switch-style load-balance loss: E * mean_e(frac_tokens * mean_prob)
     top1 = jnp.argmax(probs, axis=-1)
