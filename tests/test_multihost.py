@@ -14,6 +14,7 @@ from __future__ import annotations
 import os
 import subprocess
 import sys
+import time
 from pathlib import Path
 
 from torchbooster_tpu.distributed import find_free_port
@@ -49,9 +50,10 @@ def test_two_process_runtime(tmp_path):
         return "\n---\n".join(
             f"rank {rank}:\n{logs[rank].read_text()}" for rank in range(2))
 
+    deadline = time.monotonic() + 300
     try:
         for proc in procs:
-            proc.wait(timeout=300)
+            proc.wait(timeout=max(deadline - time.monotonic(), 1.0))
     except subprocess.TimeoutExpired:
         for proc in procs:
             proc.kill()

@@ -81,3 +81,49 @@ def test_pipeline_validates_divisibility(mesh):
     x = jnp.zeros((8, 8))
     with pytest.raises(ValueError, match="not divisible"):
         pipeline_apply(layer_fn, params, x, mesh)
+
+
+def test_pipeline_composes_with_dp():
+    """dp:2 × pp:4: each dp group runs its own pp ring on its own batch
+    slice — forward and grads match the sequential scan, and the input
+    batch dim is genuinely sharded over dp (not replicated)."""
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(2, 4), ("dp", "pp"))
+    rng = jax.random.PRNGKey(0)
+    params = make_mlp_stack(rng, 8, 16)
+    x = jax.random.normal(jax.random.PRNGKey(1), (16, 16))
+    want = sequential(params, x)
+    with mesh:
+        got = jax.jit(lambda p, x: pipeline_apply(
+            layer_fn, p, x, mesh))(params, x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=1e-5)
+
+    def loss_pp(p):
+        with mesh:
+            return jnp.sum(pipeline_apply(layer_fn, p, x, mesh) ** 2)
+
+    g_pp = jax.grad(loss_pp)(params)
+    g_seq = jax.grad(lambda p: jnp.sum(sequential(p, x) ** 2))(params)
+    for a, b in zip(jax.tree.leaves(g_pp), jax.tree.leaves(g_seq)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-3)
+
+
+def test_pipeline_dp_batch_actually_sharded():
+    """Inside the dp×pp kernel each device must see only its dp slice
+    of the microbatch — the replicated-batch regression ADVICE r1
+    flagged. Probe the per-device shape at trace time."""
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(2, 4), ("dp", "pp"))
+    params = make_mlp_stack(jax.random.PRNGKey(0), 4, 8)
+    x = jax.random.normal(jax.random.PRNGKey(1), (16, 8))
+    seen: set[tuple] = set()
+
+    def probe_layer(lp, xx):
+        seen.add(tuple(xx.shape))
+        return layer_fn(lp, xx)
+
+    with mesh:
+        out = pipeline_apply(probe_layer, params, x, mesh)
+    assert out.shape == (16, 8)
+    # 16 / 4 microbatches = 4 per microbatch, / dp:2 = 2 local rows
+    assert seen == {(2, 8)}, seen

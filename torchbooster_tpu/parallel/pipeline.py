@@ -21,6 +21,7 @@ from typing import Any, Callable
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 try:
@@ -36,6 +37,7 @@ def pipeline_apply(
     mesh: Mesh,
     axis: str = "pp",
     n_microbatches: int | None = None,
+    batch_axes: tuple[str, ...] | None = None,
 ) -> jax.Array:
     """Run ``layer_fn`` over ``L`` stacked layers, pipelined over the
     mesh's ``axis``.
@@ -45,6 +47,13 @@ def pipeline_apply(
     ``(B, ...)``; it is split into ``n_microbatches`` (default: the
     pipeline depth) along axis 0. ``B`` must divide evenly and ``L``
     must divide the ``axis`` size.
+
+    ``batch_axes`` are the mesh axes the per-microbatch batch dimension
+    shards over — default: whichever of ``dp``/``fsdp`` the mesh has.
+    Each data-parallel group then runs its own pp ring on its own batch
+    slice, so dp×pp composes with no replicated compute; pass ``()`` to
+    replicate instead. ``B / n_microbatches`` must divide by the product
+    of the batch axes.
 
     Returns the full-batch output, identical (up to float reassociation)
     to sequentially scanning the layers on one device.
@@ -58,12 +67,22 @@ def pipeline_apply(
     batch = x.shape[0]
     if batch % m:
         raise ValueError(f"batch {batch} not divisible by {m} microbatches")
+    if batch_axes is None:
+        batch_axes = tuple(a for a in ("dp", "fsdp")
+                           if a in mesh.axis_names and a != axis)
+    dp_size = int(np.prod([mesh.shape[a] for a in batch_axes])) \
+        if batch_axes else 1
+    if (batch // m) % dp_size:
+        raise ValueError(
+            f"microbatch size {batch // m} not divisible by data-axes "
+            f"product {dp_size} ({batch_axes})")
     x_mb = x.reshape(m, batch // m, *x.shape[1:])
 
-    # everything except pp is untouched: params shard their layer axis,
-    # the batch is replicated across pp (dp/… sharding, if any, rides on
-    # the unmentioned axes via shard_map's automatic residual rules)
+    # params shard their layer axis over pp (replicating across the data
+    # axes); microbatches shard their batch dim over the data axes, so
+    # each dp group drives an independent pp ring on its own slice
     param_specs = jax.tree.map(lambda _: P(axis), stacked_params)
+    mb_spec = P(None, batch_axes or None)
 
     def kernel(stage_params: Any, x_mb: jax.Array) -> jax.Array:
         stage = jax.lax.axis_index(axis)
@@ -104,11 +123,13 @@ def pipeline_apply(
         return jax.lax.psum(out, axis)
 
     try:        # jax >= 0.8 spells the replication-check flag check_vma
-        mapped = shard_map(kernel, mesh=mesh, in_specs=(param_specs, P()),
-                           out_specs=P(), check_vma=False)
+        mapped = shard_map(kernel, mesh=mesh,
+                           in_specs=(param_specs, mb_spec),
+                           out_specs=mb_spec, check_vma=False)
     except TypeError:  # pragma: no cover - older jax
-        mapped = shard_map(kernel, mesh=mesh, in_specs=(param_specs, P()),
-                           out_specs=P(), check_rep=False)
+        mapped = shard_map(kernel, mesh=mesh,
+                           in_specs=(param_specs, mb_spec),
+                           out_specs=mb_spec, check_rep=False)
     out_mb = mapped(stacked_params, x_mb)
     return out_mb.reshape(batch, *x.shape[1:])
 
