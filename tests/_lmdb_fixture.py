@@ -1,0 +1,154 @@
+"""Minimal LMDB data-file builder for tests (pure python).
+
+Lays out a valid single-tree LMDB file per the published on-disk format
+(lmdb.h / mdb.c): two meta pages, leaf pages, an optional branch root,
+and overflow pages for large values. Only what the pure parser in
+``torchbooster_tpu.lmdb_compat`` consumes — the point is a committed,
+inspectable fixture so the migration path executes in environments
+without the ``lmdb`` package. When ``lmdb`` IS installed, the companion
+test builds the fixture with the real library instead, which keeps this
+builder honest.
+"""
+from __future__ import annotations
+
+import struct
+from pathlib import Path
+
+_MAGIC = 0xBEEFC0DE
+_P_INVALID = 0xFFFFFFFFFFFFFFFF
+_P_BRANCH, _P_LEAF, _P_OVERFLOW, _P_META = 0x01, 0x02, 0x04, 0x08
+_F_BIGDATA = 0x01
+_HDR = 16
+
+
+def _even(n: int) -> int:
+    return n + (n & 1)
+
+
+def _page_header(pgno: int, flags: int, lower: int, upper: int,
+                 psize: int, n_overflow: int = 0) -> bytes:
+    if flags & _P_OVERFLOW:
+        # overflow pages store the page count where lower/upper sit
+        return struct.pack("<QHHI", pgno, 0, flags, n_overflow)
+    return struct.pack("<QHHHH", pgno, 0, flags, lower, upper)
+
+
+def build_lmdb(path: str | Path, items: dict[bytes, bytes],
+               psize: int = 4096) -> Path:
+    """Write ``items`` as an LMDB data file at ``path``; returns it."""
+    entries = sorted(items.items())
+    overflow_threshold = psize // 2
+
+    # plan leaves: pack sorted nodes greedily, large values go to
+    # overflow pages (planned after all tree pages)
+    leaves: list[list[tuple[bytes, bytes, bool]]] = [[]]
+    used = 0
+    for key, value in entries:
+        big = len(value) > overflow_threshold
+        node = _even(8 + len(key) + (8 if big else len(value)))
+        if used + node + 2 > psize - _HDR and leaves[-1]:
+            leaves.append([])
+            used = 0
+        leaves[-1].append((key, value, big))
+        used += node + 2
+
+    n_leaves = len(leaves)
+    leaf_pgno = {i: 2 + i for i in range(n_leaves)}
+    next_pg = 2 + n_leaves
+    branch_pgno = None
+    if n_leaves > 1:
+        branch_pgno = next_pg
+        next_pg += 1
+    # overflow pages after the tree
+    overflow_pgno: dict[bytes, int] = {}
+    overflow_pages: list[tuple[int, bytes]] = []
+    for key, value, big in (n for leaf in leaves for n in leaf):
+        if big:
+            pages = -(-(_HDR + len(value)) // psize)
+            overflow_pgno[key] = next_pg
+            overflow_pages.append((pages, value))
+            next_pg += pages
+
+    def build_leaf(pgno: int, nodes: list[tuple[bytes, bytes, bool]]
+                   ) -> bytes:
+        ptrs, blob_top, chunks = [], psize, []
+        for key, value, big in nodes:
+            if big:
+                dsize = len(value)
+                payload = key + struct.pack("<Q", overflow_pgno[key])
+            else:
+                dsize = len(value)
+                payload = key + value
+            node = struct.pack("<HHHH", dsize & 0xFFFF, dsize >> 16,
+                               _F_BIGDATA if big else 0, len(key)
+                               ) + payload
+            blob_top -= _even(len(node))
+            ptrs.append(blob_top)
+            chunks.append((blob_top, node))
+        lower = _HDR + 2 * len(nodes)
+        page = bytearray(psize)
+        page[:_HDR] = _page_header(pgno, _P_LEAF, lower, min(ptrs), psize)
+        struct.pack_into(f"<{len(ptrs)}H", page, _HDR, *ptrs)
+        for off, node in chunks:
+            page[off:off + len(node)] = node
+        return bytes(page)
+
+    tree_pages: dict[int, bytes] = {}
+    for i, nodes in enumerate(leaves):
+        tree_pages[leaf_pgno[i]] = build_leaf(leaf_pgno[i], nodes)
+
+    if branch_pgno is not None:
+        ptrs, blob_top, chunks = [], psize, []
+        for i, nodes in enumerate(leaves):
+            key = b"" if i == 0 else nodes[0][0]  # first node: empty key
+            child = leaf_pgno[i]
+            node = struct.pack(
+                "<HHHH", child & 0xFFFF, (child >> 16) & 0xFFFF,
+                (child >> 32) & 0xFFFF, len(key)) + key
+            blob_top -= _even(len(node))
+            ptrs.append(blob_top)
+            chunks.append((blob_top, node))
+        lower = _HDR + 2 * len(ptrs)
+        page = bytearray(psize)
+        page[:_HDR] = _page_header(branch_pgno, _P_BRANCH, lower,
+                                   min(ptrs), psize)
+        struct.pack_into(f"<{len(ptrs)}H", page, _HDR, *ptrs)
+        for off, node in chunks:
+            page[off:off + len(node)] = node
+        tree_pages[branch_pgno] = bytes(page)
+
+    root = branch_pgno if branch_pgno is not None else (
+        leaf_pgno[0] if entries else _P_INVALID)
+    depth = 0 if not entries else (2 if branch_pgno is not None else 1)
+
+    def meta(pgno: int, txnid: int) -> bytes:
+        free_db = struct.pack("<IHH5Q", psize, 0, 0, 0, 0, 0, 0,
+                              _P_INVALID)
+        main_db = struct.pack(
+            "<IHH5Q", 0, 0, depth,
+            1 if branch_pgno is not None else 0, n_leaves,
+            sum(p for p, _ in overflow_pages), len(entries), root)
+        body = struct.pack("<IIQQ", _MAGIC, 1, 0, next_pg * psize) \
+            + free_db + main_db + struct.pack("<QQ", next_pg - 1, txnid)
+        page = bytearray(psize)
+        page[:_HDR] = _page_header(pgno, _P_META, 0, 0, psize)
+        page[_HDR:_HDR + len(body)] = body
+        return bytes(page)
+
+    out = bytearray()
+    out += meta(0, txnid=0)      # stale meta
+    out += meta(1, txnid=1)      # current meta
+    for pgno in range(2, 2 + n_leaves + (1 if branch_pgno else 0)):
+        out += tree_pages[pgno]
+    for pages, value in overflow_pages:
+        buf = bytearray(pages * psize)
+        pgno = len(out) // psize
+        buf[:_HDR] = _page_header(pgno, _P_OVERFLOW, 0, 0, psize,
+                                  n_overflow=pages)
+        buf[_HDR:_HDR + len(value)] = value
+        out += buf
+
+    target = Path(path)
+    target.parent.mkdir(parents=True, exist_ok=True)
+    target.write_bytes(out)
+    return target

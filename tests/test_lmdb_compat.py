@@ -1,0 +1,114 @@
+"""LMDB migration path: reference-era corpora → BoosterStore.
+
+The pure-python parser is exercised against a spec-built fixture
+(tests/_lmdb_fixture.py); when the ``lmdb`` package is installed the
+same assertions run against a database written by the real library,
+which keeps builder and parser honest against each other.
+"""
+from __future__ import annotations
+
+import pickle
+import sys
+
+import pytest
+
+from tests._lmdb_fixture import build_lmdb
+from torchbooster_tpu.lmdb_compat import LMDBView
+from torchbooster_tpu.store import RecordReader, RecordWriter
+
+
+@pytest.fixture
+def pure_backend(monkeypatch):
+    """Force the pure-python parser even when the optional ``lmdb``
+    extra is installed: pure-parser coverage must not silently vanish
+    (and the spec-built fixture is for the parser, not the real lib)."""
+    monkeypatch.setitem(sys.modules, "lmdb", None)
+
+
+def _reference_corpus(n: int = 5) -> dict[bytes, bytes]:
+    """The reference's convention: b"length" + str(i) keys
+    (ref lmdb.py:63, dataset.py:58-66)."""
+    items = {str(i).encode(): pickle.dumps({"id": i, "text": f"ex{i}"})
+             for i in range(n)}
+    items[b"length"] = str(n).encode()
+    return items
+
+
+def test_pure_parser_reads_reference_convention(tmp_path, pure_backend):
+    corpus = _reference_corpus(5)
+    db = build_lmdb(tmp_path / "data.mdb", corpus)
+    with LMDBView(db) as view:
+        assert view.length() == 5
+        assert view.get(b"3") == corpus[b"3"]
+        assert view.get(b"missing") is None
+        assert set(view.keys()) == set(corpus)
+
+
+def test_from_lmdb_migrates_in_index_order(tmp_path, pure_backend):
+    corpus = _reference_corpus(7)
+    db = build_lmdb(tmp_path / "data.mdb", corpus)
+    count = RecordWriter.from_lmdb(db, tmp_path / "corpus.bstore")
+    assert count == 7
+    reader = RecordReader(tmp_path / "corpus.bstore")
+    assert len(reader) == 7
+    for i in range(7):
+        assert pickle.loads(reader.get(i)) == {"id": i, "text": f"ex{i}"}
+
+
+def test_from_lmdb_without_length_key_migrates_all(tmp_path, pure_backend):
+    items = {f"k{i:02d}".encode(): f"v{i}".encode() for i in range(4)}
+    db = build_lmdb(tmp_path / "data.mdb", items)
+    count = RecordWriter.from_lmdb(db, tmp_path / "all.bstore")
+    assert count == 4
+    reader = RecordReader(tmp_path / "all.bstore")
+    got = [reader.get(i) for i in range(4)]
+    assert got == [items[k] for k in sorted(items)]
+
+
+def test_from_lmdb_missing_declared_record_raises(tmp_path, pure_backend):
+    corpus = _reference_corpus(3)
+    del corpus[b"1"]
+    db = build_lmdb(tmp_path / "data.mdb", corpus)
+    with pytest.raises(KeyError, match="length=3"):
+        RecordWriter.from_lmdb(db, tmp_path / "broken.bstore")
+    assert not (tmp_path / "broken.bstore").exists()
+
+
+def test_pure_parser_multi_leaf_and_overflow(tmp_path, pure_backend):
+    """Enough records for a branch root + values past the overflow
+    threshold: the branch walk and overflow-page read both execute."""
+    items = {f"key{i:04d}".encode(): (b"x" * 40 + str(i).encode())
+             for i in range(300)}                       # > one leaf page
+    items[b"big"] = b"B" * 10_000                       # overflow pages
+    items[b"length"] = b"0"
+    db = build_lmdb(tmp_path / "data.mdb", items)
+    with LMDBView(db) as view:
+        assert view.get(b"big") == b"B" * 10_000
+        assert view.get(b"key0000") == items[b"key0000"]
+        assert view.get(b"key0299") == items[b"key0299"]
+        assert len(list(view.keys())) == len(items)
+
+
+def test_pure_parser_rejects_non_lmdb(tmp_path, pure_backend):
+    bogus = tmp_path / "bogus.mdb"
+    bogus.write_bytes(b"\x00" * 8192)
+    with pytest.raises(ValueError, match="magic"):
+        LMDBView(bogus)
+
+
+def test_real_lmdb_roundtrip(tmp_path):
+    """When the optional ``lmdb`` extra is installed, run the migration
+    against a database the real library wrote (skips cleanly without)."""
+    lmdb = pytest.importorskip("lmdb")
+    corpus = _reference_corpus(6)
+    env = lmdb.open(str(tmp_path / "real"), map_size=2**24)
+    with env.begin(write=True) as txn:
+        for key, value in corpus.items():
+            txn.put(key, value)
+    env.close()
+    count = RecordWriter.from_lmdb(tmp_path / "real",
+                                   tmp_path / "real.bstore")
+    assert count == 6
+    reader = RecordReader(tmp_path / "real.bstore")
+    for i in range(6):
+        assert pickle.loads(reader.get(i))["id"] == i

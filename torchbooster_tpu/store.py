@@ -314,6 +314,37 @@ class RecordWriter:
         else:
             self.close()
 
+    @classmethod
+    def from_lmdb(cls, src: str | Path, dst: str | Path) -> int:
+        """Migrate a reference-era LMDB corpus into a BoosterStore file.
+
+        When the database follows the reference's size-key convention
+        (``b"length"`` holding the count, records under ``str(i)`` keys
+        — ref lmdb.py:63, dataset.py:58-66), records migrate in index
+        order and ``b"length"`` itself is dropped (BoosterStore carries
+        the count in its header). Otherwise every (key, value) pair
+        migrates in key order. Needs no native dependency: uses the
+        ``lmdb`` package when installed, else the bundled pure-python
+        parser (:mod:`torchbooster_tpu.lmdb_compat`). Returns the
+        record count.
+        """
+        from torchbooster_tpu.lmdb_compat import LMDBView
+
+        with LMDBView(src) as view, cls(dst) as writer:
+            length = view.length()
+            if length is not None:
+                for i in range(length):
+                    value = view.get(str(i).encode())
+                    if value is None:
+                        raise KeyError(
+                            f"{src}: declares length={length} but key "
+                            f"{i!r} is missing")
+                    writer.append(value)
+            else:
+                for _, value in view.items():
+                    writer.append(value)
+            return writer._count
+
 
 # Reference-parity alias (ref lmdb.py class name, [sic] LMBDReader at
 # lmdb.py:13 — the reference's own typo'd spelling is NOT carried over;
