@@ -100,7 +100,7 @@ def main(conf: Config) -> dict:
 
     # params replicated over the mesh (the DDP-broadcast analogue,
     # ref conf.env.make(model) lenet.py:42)
-    params = conf.env.make(LeNet.init(rng))
+    params = conf.env.make(LeNet.init(rng), model=LeNet)
     schedule = conf.scheduler.make(conf.optim)
     tx = conf.optim.make(schedule)
     state = utils.TrainState.create(params, tx, rng=rng)

@@ -135,7 +135,7 @@ def main(conf: Config) -> dict:
                                     distributed=conf.env.distributed,
                                     seed=conf.seed)
 
-    params = conf.env.make(GAN.init(rng, z_dim=conf.z_dim))
+    params = conf.env.make(GAN.init(rng, z_dim=conf.z_dim), model=GAN)
     g_tx = conf.g_optim.make(conf.g_scheduler.make(conf.g_optim))
     d_tx = conf.d_optim.make(conf.d_scheduler.make(conf.d_optim))
     rng_g, rng_d = jax.random.split(rng)

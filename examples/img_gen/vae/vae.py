@@ -97,7 +97,7 @@ def main(conf: Config) -> dict:
                                     distributed=conf.env.distributed,
                                     seed=conf.seed)
 
-    params = conf.env.make(VAE.init(rng, z_dim=conf.z_dim))
+    params = conf.env.make(VAE.init(rng, z_dim=conf.z_dim), model=VAE)
     schedule = conf.scheduler.make(conf.optim)
     tx = conf.optim.make(schedule)
     state = utils.TrainState.create(params, tx, rng=rng)

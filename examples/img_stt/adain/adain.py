@@ -113,7 +113,7 @@ def main(conf: Config) -> dict:
         vgg = load_torch_features(vgg)
     except Exception:
         pass
-    vgg = conf.env.make(vgg)
+    vgg = conf.env.make(vgg, model=VGGFeatures)
     style_taps = [1, 6, 11, RELU4_1]            # relu1_1..4_1 (adain.py:130)
 
     def encode(x, taps):
@@ -136,7 +136,7 @@ def main(conf: Config) -> dict:
         return c_loss + conf.style_weight * s_loss, {
             "content": c_loss, "style": s_loss}
 
-    params = conf.env.make(AdaINDecoder.init(rng))
+    params = conf.env.make(AdaINDecoder.init(rng), model=AdaINDecoder)
     schedule = conf.scheduler.make(conf.optim)
     tx = conf.optim.make(schedule)
     state = utils.TrainState.create(params, tx, rng=rng)

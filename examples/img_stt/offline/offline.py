@@ -90,7 +90,7 @@ def main(conf: Config) -> dict:
         vgg = load_torch_features(vgg)
     except Exception:   # offline: random VGG still defines a valid critic
         pass
-    vgg = conf.env.make(vgg)
+    vgg = conf.env.make(vgg, model=VGGFeatures)
 
     # fixed targets: content activations + style grams (ref offline.py:98-105)
     taps = sorted(set(conf.content_layers) | set(conf.style_layers))

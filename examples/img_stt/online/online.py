@@ -118,7 +118,7 @@ def main(conf: Config) -> dict:
         vgg = load_torch_features(vgg)
     except Exception:
         pass
-    vgg = conf.env.make(vgg)
+    vgg = conf.env.make(vgg, model=VGGFeatures)
 
     style = jnp.asarray(load_style(conf.style_path, conf.dataset.image_size,
                                    conf.seed))[None]
@@ -147,7 +147,7 @@ def main(conf: Config) -> dict:
                 + conf.tv_weight * tv)
         return loss, {"content": c_loss, "style": s_loss}
 
-    params = conf.env.make(StyleNet.init(rng))
+    params = conf.env.make(StyleNet.init(rng), model=StyleNet)
     schedule = conf.scheduler.make(conf.optim)
     tx = conf.optim.make(schedule)
     state = utils.TrainState.create(params, tx, rng=rng)

@@ -341,6 +341,19 @@ class EnvConfig(BaseConfig):
         if rules is None and model is not None:
             rules = getattr(model, "SHARDING_RULES", None)
         mesh = dist.get_mesh(self)
+        if rules is None:
+            # the one-switch contract cuts both ways: a multi-axis mesh
+            # with nothing to lay weights out by silently replicates —
+            # say so loudly instead of letting a "fsdp:8" YAML no-op
+            param_axes = [a for a, s in mesh.shape.items()
+                          if a != "dp" and s > 1]
+            if param_axes:
+                logging.warning(
+                    "mesh %r has parameter-sharding axes %s but no "
+                    "sharding rules were provided — parameters will "
+                    "fully replicate on every device. Pass "
+                    "make(..., model=<class with SHARDING_RULES>) or "
+                    "rules=[...] to shard.", self.mesh, param_axes)
         placed = [dist.to_env(obj, mesh, rules=rules) for obj in args]
         return placed[0] if len(placed) == 1 else placed
 

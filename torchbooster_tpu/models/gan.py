@@ -20,6 +20,13 @@ class GAN:
     """Two independent param trees: ``init(rng, z_dim)`` →
     ``{"G": ..., "D": ...}``; ``generate(G, z)``; ``discriminate(D, x)``."""
 
+    # one-switch fsdp layout: both G and D dense kernels shard their
+    # output dim (D's 1-wide head falls back to replication per leaf)
+    SHARDING_RULES = [
+        (r".*/kernel", jax.sharding.PartitionSpec(None, "fsdp")),
+        (r".*", jax.sharding.PartitionSpec()),
+    ]
+
     @staticmethod
     def init(rng: jax.Array, z_dim: int = 64, image_dim: int = 784,
              hidden: int = 512, dtype: Any = jnp.float32) -> dict:

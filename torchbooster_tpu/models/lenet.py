@@ -20,6 +20,15 @@ class LeNet:
 
     num_classes = 10
 
+    # one-switch fsdp layout: dense kernels shard their output dim
+    # (tiny conv kernels' 6/16-wide channels rarely divide the axis and
+    # fall back to replication per leaf, which is fine at this size)
+    SHARDING_RULES = [
+        (r"fc[0-9]/kernel", jax.sharding.PartitionSpec(None, "fsdp")),
+        (r"head/kernel", jax.sharding.PartitionSpec("fsdp", None)),
+        (r".*", jax.sharding.PartitionSpec()),
+    ]
+
     @staticmethod
     def init(rng: jax.Array, num_classes: int = 10,
              dtype: Any = jnp.float32) -> dict:

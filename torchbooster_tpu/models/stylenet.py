@@ -71,6 +71,15 @@ class StyleNet:
     two stride-2 ConvIN encoders → 5 residual bottlenecks at 128ch →
     two upsample decoders → 9×9 head."""
 
+    # one-switch fsdp layout: conv kernels shard their output-channel
+    # dim (3-wide heads fall back to replication per leaf); instance
+    # norm scale/bias replicate
+    SHARDING_RULES = [
+        (r".*/kernel", jax.sharding.PartitionSpec(
+            None, None, None, "fsdp")),
+        (r".*", jax.sharding.PartitionSpec()),
+    ]
+
     @staticmethod
     def init(rng: jax.Array, dtype: Any = jnp.float32) -> dict:
         ks = iter(jax.random.split(rng, 20))
@@ -109,6 +118,8 @@ class AdaINDecoder:
     """Decoder from VGG relu4_1 features back to RGB (ref Decoder,
     adain.py:41-52): 512→256 → up → 256×2 →128 → up → 128→64 → up →
     64→3 with a 9×9 head."""
+
+    SHARDING_RULES = StyleNet.SHARDING_RULES
 
     @staticmethod
     def init(rng: jax.Array, dtype: Any = jnp.float32) -> dict:

@@ -25,6 +25,14 @@ class VAE:
     """``init(rng, z_dim)`` → params; ``apply(params, x, rng)`` →
     ``(recon_logits, mu, log_var)``. ``decode(params, z)`` → images."""
 
+    # one-switch fsdp layout (EnvConfig.make consumes this): dense
+    # kernels shard their output dim; non-divisible dims fall back to
+    # replication per leaf, dp-only meshes filter the axis away
+    SHARDING_RULES = [
+        (r".*/kernel", jax.sharding.PartitionSpec(None, "fsdp")),
+        (r".*", jax.sharding.PartitionSpec()),
+    ]
+
     @staticmethod
     def init(rng: jax.Array, z_dim: int = 32, image_dim: int = 784,
              hidden: int = 512, dtype: Any = jnp.float32) -> dict:

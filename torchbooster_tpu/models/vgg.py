@@ -56,6 +56,13 @@ class VGGFeatures:
     list of tapped activations (always also returns the final map when
     ``taps`` is None)."""
 
+    # one-switch fsdp layout: conv kernels shard their output-channel dim
+    SHARDING_RULES = [
+        (r"conv[0-9]+/kernel", jax.sharding.PartitionSpec(
+            None, None, None, "fsdp")),
+        (r".*", jax.sharding.PartitionSpec()),
+    ]
+
     @staticmethod
     def init(rng: jax.Array, depth: int = 19,
              dtype: Any = jnp.float32) -> dict:
