@@ -403,3 +403,22 @@ def test_hf_branch_loads_real_hub_dataset(tmp_path, monkeypatch):
     except SystemExit:
         pytest.skip("hub unreachable (offline environment)")
     assert len(train) > 0
+
+
+def test_dataloader_process_workers_roundtrip():
+    """workers="process": spawn-based worker pool decodes batches with
+    identical content/order to the in-process path."""
+    data = [np.full((3,), i, np.float32) for i in range(16)]
+    plain = DataLoader(data, batch_size=4, shuffle=False)
+    procs = DataLoader(data, batch_size=4, shuffle=False,
+                       num_workers=2, workers="process")
+    try:
+        for a, b in zip(plain, procs):
+            np.testing.assert_array_equal(a, b)
+    finally:
+        procs.close()
+
+
+def test_dataloader_rejects_unknown_worker_mode():
+    with pytest.raises(ValueError, match="thread"):
+        DataLoader([1, 2], workers="greenlet")

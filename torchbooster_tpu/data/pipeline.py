@@ -6,9 +6,10 @@ data_sampler + config.py:486-525 iterable modulo-sharding):
 
 - per-process index sharding replaces DistributedSampler (each host
   loads only its slice of the global batch),
-- worker *threads* decode concurrently (numpy decode releases the GIL;
-  the reference needed worker processes because of torch tensors +
-  python-heavy transforms),
+- worker *threads* decode concurrently by default (numpy decode
+  releases the GIL); ``workers="process"`` brings the reference's
+  worker-process model back for python-heavy transforms that hold it
+  (measured crossover in docs/performance.md),
 - ``prefetch_to_device`` overlaps host decode with device compute and
   lands batches already sharded over the mesh's data axes — replacing
   the reference's per-step blocking ``.to("cuda")`` (ref
@@ -25,9 +26,10 @@ convention was per-rank batch size; global is the mesh-world unit.
 from __future__ import annotations
 
 import collections
+import multiprocessing
 import queue
 import threading
-from concurrent.futures import ThreadPoolExecutor
+from concurrent.futures import ProcessPoolExecutor, ThreadPoolExecutor
 from typing import Any, Callable, Iterable, Iterator, Sequence
 
 import jax
@@ -99,6 +101,24 @@ class ShardedIterable(IterableDataset):
                 yield item
 
 
+# worker-process state, set once per process by the pool initializer
+# (shipping the dataset per task would re-pickle it every batch)
+_WORKER: dict = {}
+
+
+def _worker_init(dataset: Any, collate_fn: Callable) -> None:
+    _WORKER["dataset"] = dataset
+    _WORKER["collate"] = collate_fn
+
+
+def _worker_assemble(chunk: list[int]) -> Any:
+    dataset, collate = _WORKER["dataset"], _WORKER["collate"]
+    fetch_many = getattr(dataset, "__getitems__", None)
+    if fetch_many is not None:
+        return collate(fetch_many(chunk))
+    return collate([dataset[i] for i in chunk])
+
+
 class DataLoader:
     """Map/iterable dataset → batches of host numpy pytrees.
 
@@ -106,7 +126,17 @@ class DataLoader:
     :func:`torchbooster_tpu.utils.iter_loader`) for epoch tracking.
     Shuffling reshuffles every epoch with ``seed + epoch`` — the
     sampler-epoch contract of the reference's DistributedSampler
-    (ref distributed.py:78-98)."""
+    (ref distributed.py:78-98).
+
+    ``workers``: "thread" (default — numpy decode releases the GIL) or
+    "process" (the reference's worker-process model, ref
+    config.py:371-379, for python-heavy per-item transforms that hold
+    the GIL and would starve the chip; dataset + collate_fn must
+    pickle). Process workers SNAPSHOT the dataset and collate_fn when
+    the pool first starts and keep that copy across epochs — mutate
+    the dataset between epochs only in thread mode, or call
+    :meth:`close` first so the next epoch re-pickles it. Measured
+    guidance in docs/performance.md."""
 
     def __init__(
         self,
@@ -119,7 +149,10 @@ class DataLoader:
         prefetch: int = 2,
         collate_fn: Callable | None = None,
         seed: int = 0,
+        workers: str = "thread",
     ):
+        if workers not in ("thread", "process"):
+            raise ValueError(f"workers={workers!r}: 'thread' or 'process'")
         self.dataset = dataset
         self.batch_size = batch_size
         self.shuffle = shuffle
@@ -129,7 +162,9 @@ class DataLoader:
         self.prefetch = max(prefetch, 1)
         self.collate_fn = collate_fn or default_collate
         self.seed = seed
+        self.workers = workers
         self.epoch = 0
+        self._pool: ProcessPoolExecutor | None = None
 
         world = dist.get_world_size() if distributed else 1
         if batch_size % world:
@@ -179,6 +214,30 @@ class DataLoader:
                 return
             yield chunk
 
+    def _process_pool(self) -> ProcessPoolExecutor:
+        """Lazily started, reused across epochs (spawn, not fork: a
+        forked copy of a process with a live device runtime can deadlock
+        on inherited locks)."""
+        if self._pool is None:
+            self._pool = ProcessPoolExecutor(
+                self.num_workers,
+                mp_context=multiprocessing.get_context("spawn"),
+                initializer=_worker_init,
+                initargs=(self.dataset, self.collate_fn))
+        return self._pool
+
+    def close(self) -> None:
+        """Retire worker processes (thread mode has nothing to close)."""
+        if self._pool is not None:
+            self._pool.shutdown()
+            self._pool = None
+
+    def __del__(self):  # best-effort; close() is the explicit path
+        try:
+            self.close()
+        except Exception:  # pragma: no cover - interpreter teardown
+            pass
+
     def _map_iter(self) -> Iterator[Any]:
         fetch = self.dataset.__getitem__
         fetch_many = getattr(self.dataset, "__getitems__", None)
@@ -190,20 +249,26 @@ class DataLoader:
             def assemble(chunk):
                 return self.collate_fn([fetch(int(i)) for i in chunk])
         if self.num_workers > 0:
-            with ThreadPoolExecutor(self.num_workers) as pool:
+            if self.workers == "process":
+                pool = self._process_pool()
+                submit_one = lambda chunk: pool.submit(  # noqa: E731
+                    _worker_assemble, [int(i) for i in chunk])
+            else:
+                pool = ThreadPoolExecutor(self.num_workers)
+                submit_one = lambda chunk: pool.submit(  # noqa: E731
+                    assemble, chunk)
+            try:
                 pending: collections.deque = collections.deque()
-                batches = self._batches_of_indices()
                 depth = self.prefetch + 1
-
-                def submit(idx_chunk):
-                    pending.append(pool.submit(assemble, idx_chunk))
-
-                for chunk in batches:
-                    submit(chunk)
+                for chunk in self._batches_of_indices():
+                    pending.append(submit_one(chunk))
                     if len(pending) >= depth:
                         yield pending.popleft().result()
                 while pending:
                     yield pending.popleft().result()
+            finally:
+                if self.workers == "thread":
+                    pool.shutdown()
         else:
             for chunk in self._batches_of_indices():
                 yield assemble(chunk)
