@@ -104,16 +104,47 @@ def _bottleneck_init(rng: jax.Array, cin: int, cmid: int, stride: int,
     return block
 
 
+def _use_fused(fused: str | bool, norm: str, x: jax.Array,
+               cout: int) -> bool:
+    """1×1+GN fusion gate: explicit True/"interpret" engages the pallas
+    kernel when the block fits VMEM (ops/fused_block). "auto" currently
+    resolves to the XLA path: the kernel's measured end-to-end numbers
+    do not yet beat XLA on the ResNet-50 bench (docs/performance.md r3
+    notes) — flip happens when they do, the dispatch stays honest."""
+    if norm != "group" or fused in (False, "auto"):
+        return False
+    from torchbooster_tpu.ops.fused_block import fits
+
+    return fits(x, cout)
+
+
+def _conv1x1_norm(conv_p: dict, norm_p: dict, x: jax.Array, norm: str,
+                  relu: bool, stride: int, fused: str | bool) -> jax.Array:
+    """1×1 conv + norm(+relu), through the fused pallas kernel when the
+    gate passes (one HBM pass instead of three — see ops/fused_block)."""
+    cout = conv_p["kernel"].shape[-1]
+    if _use_fused(fused, norm, x, cout):
+        from torchbooster_tpu.ops.fused_block import conv1x1_gn_relu
+
+        return conv1x1_gn_relu(
+            x, conv_p["kernel"], norm_p["scale"], norm_p["bias"],
+            groups=_GROUPS, relu=relu, stride=stride,
+            interpret=(fused == "interpret"))
+    return _norm(norm_p, L.conv(conv_p, x, stride=stride), norm, relu)
+
+
 def _bottleneck(params: dict, x: jax.Array, stride: int,
-                norm: str) -> jax.Array:
-    y = _norm(params["norm1"], L.conv(params["conv1"], x), norm, relu=True)
+                norm: str, fused: str | bool = "auto") -> jax.Array:
+    y = _conv1x1_norm(params["conv1"], params["norm1"], x, norm,
+                      relu=True, stride=1, fused=fused)
     y = _norm(params["norm2"],
               L.conv(params["conv2"], y, stride=stride, padding=1),
               norm, relu=True)
-    y = _norm(params["norm3"], L.conv(params["conv3"], y), norm)
+    y = _conv1x1_norm(params["conv3"], params["norm3"], y, norm,
+                      relu=False, stride=1, fused=fused)
     if "proj" in params:
-        x = _norm(params["proj_norm"],
-                  L.conv(params["proj"], x, stride=stride), norm)
+        x = _conv1x1_norm(params["proj"], params["proj_norm"], x, norm,
+                          relu=False, stride=stride, fused=fused)
     return jax.nn.relu(x + y)
 
 
@@ -172,7 +203,13 @@ class ResNet:
     def apply(params: dict, x: jax.Array, train: bool = False,
               rng: jax.Array | None = None,
               pool_stem: bool | None = None,
-              norm: str = "group") -> jax.Array:
+              norm: str = "group",
+              fused: str | bool = "auto") -> jax.Array:
+        """``fused``: the 1×1-conv+GN pallas kernel (ops/fused_block).
+        "auto" currently resolves to the plain XLA path — the kernel
+        has not yet beaten XLA end-to-end on the chip bench (see
+        _use_fused and docs/performance.md). True forces it on;
+        "interpret" is the CPU-debuggable variant for tests."""
         del train, rng
         stem = params["stem"]
         stem_stride = 2 if stem["conv"]["kernel"].shape[0] == 7 else 1
@@ -191,7 +228,7 @@ class ResNet:
                 block = stage[f"block{bi}"]
                 stride = 2 if (bi == 0 and si > 0) else 1
                 if "conv3" in block:
-                    x = _bottleneck(block, x, stride, norm)
+                    x = _bottleneck(block, x, stride, norm, fused)
                 else:
                     x = _basic_block(block, x, stride, norm)
                 bi += 1
