@@ -216,6 +216,7 @@ def make_step(
     compute_dtype: Any = None,
     has_aux: bool = True,
     donate: bool = True,
+    rules: Any = None,
 ) -> Callable:
     """Build the jitted train step — the functional replacement for the
     reference's per-call ``utils.step`` (ref utils.py:204-252).
@@ -239,8 +240,31 @@ def make_step(
     No GradScaler: bf16 on TPU needs no loss scaling (SURVEY §7
     precision note); master weights stay fp32, casts happen in
     ``loss_fn`` via ``compute_dtype``.
+
+    Sharding: without ``rules``, layouts propagate from the (already
+    placed) state/batch inputs via jit's inference — correct for the
+    shipped models, which pin their own internal layouts with
+    ``with_sharding_constraint``. Pass ``mesh`` AND ``rules`` (a model's
+    ``SHARDING_RULES``) to additionally constrain gradients and updated
+    params to the rule layout inside the compiled step — this pins the
+    layout for models with no internal constrainers, so fsdp/tp cannot
+    silently degrade to whatever XLA guesses.
     """
     accumulate = accumulate_every > 1
+
+    if rules is not None and mesh is None:
+        raise ValueError("make_step(rules=...) needs mesh= as well")
+
+    def _pin(tree: Any) -> Any:
+        """Constrain a param-shaped pytree to the rule layout."""
+        if rules is None or mesh is None:
+            return tree
+        from torchbooster_tpu.parallel.sharding import (
+            make_param_specs, make_shardings)
+
+        specs = make_param_specs(tree, rules, mesh=mesh)
+        return jax.tree.map(jax.lax.with_sharding_constraint, tree,
+                            make_shardings(specs, mesh))
 
     def _cast(tree: Any) -> Any:
         return jax.tree.map(
@@ -267,6 +291,7 @@ def make_step(
         else:
             loss, grads = grad_fn(state.params, batch_cast, step_rng)
             aux = {}
+        grads = _pin(grads)
 
         if accumulate:
             grad_acc = jax.tree.map(jnp.add, state.grad_acc, grads)
@@ -299,14 +324,14 @@ def make_step(
             grad_acc = state.grad_acc
 
         new_state = state.replace(
-            params=params, opt_state=opt_state, step=state.step + 1,
+            params=_pin(params), opt_state=opt_state, step=state.step + 1,
             rng=rng, grad_acc=grad_acc)
         metrics = {"loss": loss, **aux}
         return new_state, metrics
 
-    # Sharding propagates from the (already placed) state/batch inputs;
-    # the mesh arg is accepted for API clarity and future explicit
-    # in_shardings but jit's inference covers the dp/fsdp/tp layouts.
+    # Without rules, sharding propagates from the (already placed)
+    # state/batch inputs via jit's inference; with rules, _pin holds
+    # grads and updated params to the declared layout inside the step.
     donate_argnums = (0,) if donate else ()
     return jax.jit(step_fn, donate_argnums=donate_argnums)
 
