@@ -105,17 +105,17 @@ def _bottleneck_init(rng: jax.Array, cin: int, cmid: int, stride: int,
 
 
 def _use_fused(fused: str | bool, norm: str, x: jax.Array,
-               cout: int) -> bool:
-    """1×1+GN fusion gate: explicit True/"interpret" engages the pallas
+               cout: int, three: bool = False) -> bool:
+    """Conv+GN fusion gate: explicit True/"interpret" engages the pallas
     kernel when the block fits VMEM (ops/fused_block). "auto" currently
     resolves to the XLA path: the kernel's measured end-to-end numbers
     do not yet beat XLA on the ResNet-50 bench (docs/performance.md r3
     notes) — flip happens when they do, the dispatch stays honest."""
     if norm != "group" or fused in (False, "auto"):
         return False
-    from torchbooster_tpu.ops.fused_block import fits
+    from torchbooster_tpu.ops.fused_block import fits, fits3
 
-    return fits(x, cout)
+    return fits3(x, cout) if three else fits(x, cout)
 
 
 def _conv1x1_norm(conv_p: dict, norm_p: dict, x: jax.Array, norm: str,
@@ -133,13 +133,28 @@ def _conv1x1_norm(conv_p: dict, norm_p: dict, x: jax.Array, norm: str,
     return _norm(norm_p, L.conv(conv_p, x, stride=stride), norm, relu)
 
 
+def _conv3x3_norm(conv_p: dict, norm_p: dict, x: jax.Array, norm: str,
+                  stride: int, fused: str | bool) -> jax.Array:
+    """3×3 conv + GN + relu; fused pallas path for the stride-1 body
+    (13 of ResNet-50's 16 conv2s — stage-entry stride-2 blocks keep
+    XLA)."""
+    cout = conv_p["kernel"].shape[-1]
+    if stride == 1 and _use_fused(fused, norm, x, cout, three=True):
+        from torchbooster_tpu.ops.fused_block import conv3x3_gn_relu
+
+        return conv3x3_gn_relu(
+            x, conv_p["kernel"], norm_p["scale"], norm_p["bias"],
+            groups=_GROUPS, interpret=(fused == "interpret"))
+    return _norm(norm_p, L.conv(conv_p, x, stride=stride, padding=1),
+                 norm, relu=True)
+
+
 def _bottleneck(params: dict, x: jax.Array, stride: int,
                 norm: str, fused: str | bool = "auto") -> jax.Array:
     y = _conv1x1_norm(params["conv1"], params["norm1"], x, norm,
                       relu=True, stride=1, fused=fused)
-    y = _norm(params["norm2"],
-              L.conv(params["conv2"], y, stride=stride, padding=1),
-              norm, relu=True)
+    y = _conv3x3_norm(params["conv2"], params["norm2"], y, norm,
+                      stride=stride, fused=fused)
     y = _conv1x1_norm(params["conv3"], params["norm3"], y, norm,
                       relu=False, stride=1, fused=fused)
     if "proj" in params:

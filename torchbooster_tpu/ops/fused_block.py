@@ -88,26 +88,30 @@ def _fwd_kernel(x_ref, w_ref, scale_ref, bias_ref, avg_ref,
     rstd_ref[:] = rstd[:, None, :]
 
 
-def _cell_bytes(g: int, m: int, cin: int, cout: int, itemsize: int) -> int:
-    """VMEM working set of one grid cell processing ``g`` samples: x +
-    fp32 y + output, plus the resident w and membership matrix."""
-    per_sample = m * cin * itemsize + m * cout * 4 + m * cout * itemsize
-    return cin * cout * itemsize + cout * cout * 4 + g * per_sample
+def _cell_bytes(g: int, m: int, cin: int, cout: int, itemsize: int,
+                taps: int = 1, x_copies: int = 1) -> int:
+    """VMEM working set of one grid cell processing ``g`` samples:
+    ``x_copies`` x blocks (the 3×3 kernel keeps a padded copy) + fp32 y
+    + output, plus the resident weight (``taps``·Cin·Cout — 9 for 3×3)
+    and membership matrix."""
+    per_sample = x_copies * m * cin * itemsize + m * cout * 4 \
+        + m * cout * itemsize
+    return taps * cin * cout * itemsize + cout * cout * 4 + g * per_sample
 
 
-def _samples_per_cell(b: int, m: int, cin: int, cout: int,
-                      itemsize: int) -> int:
+def _samples_per_cell(b: int, m: int, cin: int, cout: int, itemsize: int,
+                      taps: int = 1, x_copies: int = 1) -> int:
     """Largest power-of-two divisor of ``b`` whose working set fits the
     VMEM budget. Bigger cells amortize per-grid-step overhead (a (B,)
     grid of tiny cells measured ~47% SLOWER end-to-end than XLA:
     thousands of cell dispatches per train step dominate the win from
-    fewer HBM passes). Callers gate on :func:`fits` first, so g=1
-    always fits here."""
+    fewer HBM passes). Callers gate on :func:`fits`/:func:`fits3`
+    first (same accounting), so g=1 always fits here."""
     best = 1
     g = 1
     while g <= b:
-        if b % g == 0 and _cell_bytes(g, m, cin, cout,
-                                      itemsize) <= _VMEM_BUDGET_BYTES:
+        if b % g == 0 and _cell_bytes(g, m, cin, cout, itemsize, taps,
+                                      x_copies) <= _VMEM_BUDGET_BYTES:
             best = g
         g *= 2
     return best
@@ -209,6 +213,145 @@ def fits(x: jax.Array, cout: int) -> bool:
         and cin >= 8 and cout >= 8
 
 
+# =========================================================================
+# 3×3 conv + GN (+ReLU): nine shifted-tap matmuls in one VMEM residency
+# =========================================================================
+
+def _fwd3_kernel(x_ref, w_ref, scale_ref, bias_ref, avg_ref,
+                 o_ref, *, relu: bool, eps: float, w_sp: int):
+    """x block (G, M=H·W, Cin) in row-major spatial order; w (3,3,Cin,
+    Cout). Each tap (dy, dx) is a shift of the M axis by dy·W+dx with
+    the column-wrap rows masked — nine (G·M, Cin)@(Cin, Cout) matmuls
+    accumulate in fp32, then the same moments/normalize epilogue as the
+    1×1 kernel. Only ``out`` leaves the chip."""
+    x = x_ref[:]                                    # (G, M, Cin)
+    g, m, cin = x.shape
+    cout = w_ref.shape[-1]
+    pad = jnp.zeros((g, w_sp + 1, cin), x.dtype)
+    xp = jnp.concatenate([pad, x, pad], axis=1)     # (G, M + 2W+2, Cin)
+    col = jax.lax.broadcasted_iota(jnp.int32, (1, m, 1), 1) % w_sp
+
+    acc = jnp.zeros((g, m, cout), jnp.float32)
+    for dy in (-1, 0, 1):
+        for dx in (-1, 0, 1):
+            shift = dy * w_sp + dx
+            src = jax.lax.dynamic_slice_in_dim(
+                xp, w_sp + 1 + shift, m, axis=1)    # rows m+shift
+            if dx:
+                valid = ((col + dx) >= 0) & ((col + dx) < w_sp)
+                src = src * valid.astype(src.dtype)
+            w_tap = w_ref[dy + 1, dx + 1]           # (Cin, Cout)
+            acc = acc + jax.lax.dot_general(
+                src, w_tap, (((2,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+
+    s1 = jnp.sum(acc, axis=1)                       # (G, Cout)
+    s2 = jnp.sum(acc * acc, axis=1)
+    avg = avg_ref[:]
+    mean = s1 @ avg
+    var = s2 @ avg - mean * mean
+    rstd = jax.lax.rsqrt(var + eps)
+    a = rstd * scale_ref[:].astype(jnp.float32)
+    b = bias_ref[:].astype(jnp.float32) - mean * a
+    out = acc * a[:, None, :] + b[:, None, :]
+    if relu:
+        out = jnp.maximum(out, 0.0)
+    o_ref[:] = out.astype(o_ref.dtype)
+
+
+def _ref_conv3x3_gn(x4, w, scale, bias, groups, eps, relu):
+    """XLA formulation — the backward (via jax.vjp) and the test oracle.
+    Spatial-axis moments then group combine, matching layers.group_norm's
+    lane-friendly layout."""
+    y = jax.lax.conv_general_dilated(
+        x4, w.astype(x4.dtype), (1, 1), [(1, 1), (1, 1)],
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    n, h, w_sp, c = y.shape
+    cpg = c // groups
+    y32 = y.astype(jnp.float32)
+    s1 = jnp.sum(y32, axis=(1, 2))                  # (N, C)
+    s2 = jnp.sum(y32 * y32, axis=(1, 2))
+    denom = h * w_sp * cpg
+    gmean = s1.reshape(n, groups, cpg).sum(-1) / denom
+    gm2 = s2.reshape(n, groups, cpg).sum(-1) / denom
+    mean = jnp.repeat(gmean, cpg, axis=-1)[:, None, None, :]
+    var = jnp.repeat(gm2, cpg, axis=-1)[:, None, None, :] - mean * mean
+    rstd = jax.lax.rsqrt(var + eps)
+    out = (y32 - mean) * rstd * scale.astype(jnp.float32) \
+        + bias.astype(jnp.float32)
+    if relu:
+        out = jnp.maximum(out, 0.0)
+    return out.astype(x4.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _conv3x3_gn(x4, w, scale, bias, groups, eps, relu, interpret):
+    b, h, w_sp, cin = x4.shape
+    cout = w.shape[-1]
+    cpg = cout // groups
+    m = h * w_sp
+    avg = jnp.asarray(_membership(cout, groups, float(m * cpg)))
+    g = _samples_per_cell(b, m, cin, cout, x4.dtype.itemsize,
+                          taps=9, x_copies=2)
+    kernel = functools.partial(_fwd3_kernel, relu=relu, eps=eps,
+                               w_sp=w_sp)
+    out = pl.pallas_call(
+        kernel,
+        grid=(b // g,),
+        in_specs=[
+            pl.BlockSpec((g, m, cin), lambda i: (i, 0, 0)),
+            pl.BlockSpec((3, 3, cin, cout), lambda i: (0, 0, 0, 0)),
+            pl.BlockSpec((1, cout), lambda i: (0, 0)),
+            pl.BlockSpec((1, cout), lambda i: (0, 0)),
+            pl.BlockSpec((cout, cout), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((g, m, cout), lambda i: (i, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, m, cout), x4.dtype),
+        interpret=interpret,
+    )(x4.reshape(b, m, cin), w, scale.reshape(1, -1),
+      bias.reshape(1, -1), avg)
+    return out.reshape(b, h, w_sp, cout)
+
+
+def _conv3x3_gn_fwd(x4, w, scale, bias, groups, eps, relu, interpret):
+    out = _conv3x3_gn(x4, w, scale, bias, groups, eps, relu, interpret)
+    return out, (x4, w, scale, bias)
+
+
+def _conv3x3_gn_bwd(groups, eps, relu, interpret, res, dout):
+    """Differentiate the XLA reference formulation (jax.vjp) — exact
+    math, remat-style recompute, no activation residuals saved."""
+    x4, w, scale, bias = res
+    _, vjp = jax.vjp(
+        lambda *a: _ref_conv3x3_gn(*a, groups, eps, relu),
+        x4, w, scale, bias)
+    return vjp(dout)
+
+
+_conv3x3_gn.defvjp(_conv3x3_gn_fwd, _conv3x3_gn_bwd)
+
+
+def conv3x3_gn_relu(x, kernel, scale, bias, groups: int = 32,
+                    eps: float = 1e-5, relu: bool = True,
+                    interpret: bool = False) -> jax.Array:
+    """Fused ``relu(group_norm(conv3x3(x)))`` over NHWC, stride 1,
+    padding 1. ``kernel``: (3, 3, Cin, Cout). Differentiable via
+    ``custom_vjp`` (backward = autodiff of the XLA reference)."""
+    groups = _resolve_groups(groups, kernel.shape[-1])
+    return _conv3x3_gn(x, kernel.astype(x.dtype), scale, bias,
+                       groups, eps, relu, interpret)
+
+
+def fits3(x: jax.Array, cout: int) -> bool:
+    """VMEM gate for the 3×3 kernel: padded input copy doubles the x
+    share and the resident weight is 9·Cin·Cout."""
+    _, h, w_, cin = x.shape
+    m = h * w_
+    return _cell_bytes(1, m, cin, cout, x.dtype.itemsize, taps=9,
+                       x_copies=2) <= _VMEM_BUDGET_BYTES \
+        and cin >= 8 and cout >= 8
+
+
 def conv1x1_gn_relu(x, kernel, scale, bias, groups: int = 32,
                     eps: float = 1e-5, relu: bool = True,
                     stride: int = 1, interpret: bool = False) -> jax.Array:
@@ -232,4 +375,4 @@ def conv1x1_gn_relu(x, kernel, scale, bias, groups: int = 32,
     return out.reshape(b, h, w_, cout)
 
 
-__all__ = ["conv1x1_gn_relu", "fits"]
+__all__ = ["conv1x1_gn_relu", "conv3x3_gn_relu", "fits", "fits3"]
