@@ -144,9 +144,6 @@ class GPT:
             # beyond the wpe table; shapes are static, so fail loudly
             raise ValueError(
                 f"sequence length {s} exceeds cfg.seq_len={cfg.seq_len}")
-        n_heads, d = cfg.n_heads, cfg.d_model
-        head_dim = d // n_heads
-
         constrain = _make_constrainer(mesh)
 
         x = L.embedding(params["wte"], ids, dtype=compute_dtype)
@@ -157,32 +154,17 @@ class GPT:
         use_ring = (mesh is not None and "sp" in mesh.axis_names
                     and mesh.shape["sp"] > 1)
 
-        def block(carry: tuple, bp: dict) -> tuple[tuple, None]:
-            x, aux = carry
-            h = L.layer_norm(bp["ln1"], x)
-            qkv = L.dense(bp["attn_qkv"], h)
-            qkv = qkv.reshape(b, s, 3, n_heads, head_dim)
-            q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        def attend(q, k, v):
             if use_ring:
                 from torchbooster_tpu.parallel.ring import ring_attention
 
-                o = ring_attention(q, k, v, mesh=mesh, causal=True)
-            else:
-                o = attention(q, k, v, causal=True, impl=attn_impl)
-            o = o.reshape(b, s, d)
-            x = constrain(x + L.dense(bp["attn_proj"], o))
-            h = L.layer_norm(bp["ln2"], x)
-            if cfg.n_experts > 0:
-                from torchbooster_tpu.models.moe import moe_apply
+                return ring_attention(q, k, v, mesh=mesh, causal=True), None
+            return attention(q, k, v, causal=True, impl=attn_impl), None
 
-                m, layer_aux = moe_apply(bp, h, top_k=cfg.top_k,
-                                         capacity_factor=cfg.capacity_factor)
-                x = constrain(x + m)
-                aux = aux + layer_aux
-            else:
-                h = jax.nn.gelu(L.dense(bp["mlp_fc1"], h))
-                x = constrain(x + L.dense(bp["mlp_fc2"], h))
-            return (x, aux), None
+        def block(carry: tuple, bp: dict) -> tuple[tuple, None]:
+            x, aux = carry
+            x, layer_aux, _ = _block_core(bp, x, cfg, attend, constrain)
+            return (x, aux + layer_aux), None
 
         # save matmul outputs, recompute the cheap elementwise ops —
         # measured ≥ plain full remat on v5e with much less recompute
@@ -194,15 +176,177 @@ class GPT:
             lambda carry, bp: scan_block(carry, bp),
             (x, jnp.zeros((), jnp.float32)), params["blocks"])
 
-        x = L.layer_norm(params["ln_f"], x)
-        if "head" in params:
-            logits = L.dense(params["head"], x)
-        else:
-            logits = x @ params["wte"]["table"].astype(x.dtype).T
+        logits = _lm_head(params, x)
         if return_aux:
             # mean load-balance loss over layers (0 for dense models)
             return logits, aux / max(cfg.n_layers, 1)
         return logits
+
+
+def _block_core(bp: dict, x: jax.Array, cfg: GPTConfig, attend,
+                constrain=lambda x: x,
+                capacity_factor: float | None = None
+                ) -> tuple[jax.Array, jax.Array, Any]:
+    """The transformer block math, shared by every path (training
+    forward, prefill, cached decode) so they cannot drift apart.
+    ``attend(q, k, v) -> (o, extras)`` supplies the attention flavor;
+    ``extras`` passes through (K/V for prefill, updated caches for
+    decode). Returns (x, aux_loss, extras)."""
+    b, s, d = x.shape
+    n_heads = cfg.n_heads
+    head_dim = d // n_heads
+    aux = jnp.zeros((), jnp.float32)
+
+    h = L.layer_norm(bp["ln1"], x)
+    qkv = L.dense(bp["attn_qkv"], h).reshape(b, s, 3, n_heads, head_dim)
+    o, extras = attend(qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2])
+    x = constrain(x + L.dense(bp["attn_proj"], o.reshape(b, s, d)))
+    h = L.layer_norm(bp["ln2"], x)
+    if cfg.n_experts > 0:
+        from torchbooster_tpu.models.moe import moe_apply
+
+        m, aux = moe_apply(
+            bp, h, top_k=cfg.top_k,
+            capacity_factor=cfg.capacity_factor
+            if capacity_factor is None else capacity_factor)
+        x = constrain(x + m)
+    else:
+        h = jax.nn.gelu(L.dense(bp["mlp_fc1"], h))
+        x = constrain(x + L.dense(bp["mlp_fc2"], h))
+    return x, aux, extras
+
+
+def _cached_block(bp: dict, x: jax.Array, cache_k: jax.Array,
+                  cache_v: jax.Array, pos: jax.Array, cfg: GPTConfig
+                  ) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """One decode step through one block: x is (B, 1, d) at position
+    ``pos``; K/V caches are (B, S_cache, H, Dh) with entries valid for
+    positions < pos. Returns (x, cache_k, cache_v) with this token's
+    K/V written at ``pos``. MoE capacity floors at n_experts so a
+    decode micro-batch never drops tokens (full-sequence drop behavior
+    cannot be replicated incrementally anyway)."""
+    head_dim = cfg.d_model // cfg.n_heads
+    s_cache = cache_k.shape[1]
+
+    def attend(q, k, v):
+        ck = jax.lax.dynamic_update_slice(
+            cache_k, k.astype(cache_k.dtype), (0, pos, 0, 0))
+        cv = jax.lax.dynamic_update_slice(
+            cache_v, v.astype(cache_v.dtype), (0, pos, 0, 0))
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                            ck.astype(jnp.float32)) / (head_dim ** 0.5)
+        visible = jnp.arange(s_cache)[None, None, None, :] <= pos
+        scores = jnp.where(visible, scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1)
+        o = jnp.einsum("bhqk,bkhd->bqhd", probs,
+                       cv.astype(jnp.float32)).astype(q.dtype)
+        return o, (ck, cv)
+
+    x, _, (cache_k, cache_v) = _block_core(
+        bp, x, cfg, attend,
+        capacity_factor=max(cfg.capacity_factor, float(cfg.n_experts)))
+    return x, cache_k, cache_v
+
+
+def _lm_head(params: dict, x: jax.Array) -> jax.Array:
+    x = L.layer_norm(params["ln_f"], x)
+    if "head" in params:
+        return L.dense(params["head"], x)
+    return x @ params["wte"]["table"].astype(x.dtype).T
+
+
+def generate(params: dict, ids: jax.Array,
+             cfg: GPTConfig = GPTConfig(),
+             n_new: int = 32,
+             rng: jax.Array | None = None,
+             temperature: float = 1.0,
+             top_k: int | None = None,
+             compute_dtype: Any = jnp.bfloat16) -> jax.Array:
+    """Autoregressive decoding with a static-shape KV cache.
+
+    Prefill runs the full prompt once (collecting per-layer K/V as scan
+    outputs), then ``n_new`` tokens decode one at a time — each step is
+    O(S_cache) attention against the cache instead of a full O(S²)
+    re-forward, and the whole loop is one ``lax.scan`` (compiles once,
+    static shapes throughout; SURVEY §7 dynamic-shapes note).
+
+    ``temperature=0`` decodes greedily (no rng needed); otherwise
+    ``jax.random.categorical`` samples, with optional ``top_k``
+    filtering. Returns (B, S_prompt + n_new) token ids.
+    """
+    b, s0 = ids.shape
+    s_total = s0 + n_new
+    if s_total > cfg.seq_len:
+        raise ValueError(
+            f"prompt {s0} + n_new {n_new} exceeds cfg.seq_len="
+            f"{cfg.seq_len}")
+    if temperature < 0:
+        raise ValueError(f"temperature must be >= 0, got {temperature}")
+    if temperature > 0 and rng is None:
+        raise ValueError("sampling (temperature > 0) needs rng=")
+    if n_new == 0:
+        return ids
+
+    # --- prefill: full prompt forward, K/V collected per layer ---
+    x = L.embedding(params["wte"], ids, dtype=compute_dtype)
+    x = x + L.embedding(params["wpe"], jnp.arange(s0), dtype=compute_dtype)
+
+    def prefill_block(x, bp):
+        def attend(q, k, v):
+            return attention(q, k, v, causal=True), (k, v)
+
+        x, _, kv = _block_core(bp, x, cfg, attend)
+        return x, kv
+
+    x, (ks, vs) = jax.lax.scan(prefill_block, x, params["blocks"])
+    pad = ((0, 0), (0, 0), (0, n_new), (0, 0), (0, 0))
+    cache_k = jnp.pad(ks.astype(compute_dtype), pad)  # (L,B,S_total,H,Dh)
+    cache_v = jnp.pad(vs.astype(compute_dtype), pad)
+
+    first_logits = _lm_head(params, x[:, -1:, :])[:, 0]    # (B, vocab)
+
+    def pick(rng_step: jax.Array, logits: jax.Array) -> jax.Array:
+        if temperature == 0:
+            return jnp.argmax(logits, axis=-1).astype(ids.dtype)
+        logits = logits.astype(jnp.float32) / temperature
+        if top_k is not None:
+            kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
+            logits = jnp.where(logits < kth, -jnp.inf, logits)
+        return jax.random.categorical(rng_step, logits).astype(ids.dtype)
+
+    rng = jax.random.PRNGKey(0) if rng is None else rng
+
+    def step(carry, _):
+        cache_k, cache_v, last_id, pos, rng = carry
+        rng, sub = jax.random.split(rng)
+        x = L.embedding(params["wte"], last_id[:, None],
+                        dtype=compute_dtype)
+        x = x + L.embedding(params["wpe"], pos[None],
+                            dtype=compute_dtype)
+
+        def layer(x, inputs):
+            bp, ck, cv = inputs
+            x, ck, cv = _cached_block(bp, x, ck, cv, pos, cfg)
+            return x, (ck, cv)
+
+        x, (cache_k, cache_v) = jax.lax.scan(
+            layer, x, (params["blocks"], cache_k, cache_v))
+        logits = _lm_head(params, x)[:, 0]
+        next_id = pick(sub, logits)
+        return (cache_k, cache_v, next_id, pos + 1, rng), next_id
+
+    rng, sub = jax.random.split(rng)
+    first_id = pick(sub, first_logits)
+    carry = (cache_k, cache_v, first_id, jnp.asarray(s0, jnp.int32), rng)
+    if n_new > 1:
+        _, rest = jax.lax.scan(step, carry, None, length=n_new - 1)
+        new_ids = jnp.concatenate([first_id[None], rest], axis=0)
+    else:
+        new_ids = first_id[None]
+    return jnp.concatenate([ids, new_ids.T.astype(ids.dtype)], axis=1)
+
+
+GPT.generate = staticmethod(generate)
 
 
 def _make_constrainer(mesh: Mesh | None):
