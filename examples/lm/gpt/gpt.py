@@ -78,6 +78,9 @@ class Config(BaseConfig):
     scheduler: SchedulerConfig
     dataset: DatasetConfig
 
+    sample_tokens: int = 0          # > 0: KV-cache sample after training
+    sample_temperature: float = 0.8
+
 
 def batch_sharding(mesh) -> NamedSharding:
     """Batch over the data axes, sequence over sp (GPT.batch_spec,
@@ -175,6 +178,17 @@ def main(conf: Config) -> dict:
                 save_cb.save(it + 1, state=state)
     if save_cb is not None:
         save_cb.wait()
+    if conf.sample_tokens > 0:
+        # KV-cache decoding (models/gpt.py generate): prompt with the
+        # first tokens of a training example, continue the sequence
+        _, tokens = next(batches)
+        prompt = np.asarray(tokens)[:1, :8].astype(np.int32)
+        sampled = GPT.generate(
+            state.params, prompt, cfg, n_new=conf.sample_tokens,
+            rng=state.rng, temperature=conf.sample_temperature, top_k=50)
+        results["sample"] = np.asarray(sampled)[0].tolist()
+        if dist.is_primary():
+            print("sample:", results["sample"])
     if dist.is_primary():
         print({k: round(v, 4) if isinstance(v, float) else v
                for k, v in results.items()})

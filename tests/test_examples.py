@@ -182,8 +182,11 @@ def test_gpt_single_vs_4d_mesh(monkeypatch):
     conf.model.seq_len, conf.model.vocab, conf.model.n_heads = 64, 256, 4
     conf.loader.batch_size = 8
     conf.dataset.n_examples = 64
+    conf.sample_tokens = 4          # post-training KV-cache sampling
     tiny_env(conf)
     single = gpt.main(conf)
+    assert len(single["sample"]) == 8 + 4
+    assert all(0 <= t < conf.model.vocab for t in single["sample"])
 
     conf.env.distributed = True
     conf.env.mesh = "dp:1,fsdp:2,tp:2,sp:2"

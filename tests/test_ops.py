@@ -303,3 +303,42 @@ def test_fused_conv3x3_gn_matches_xla(cin, cout, groups, relu, hw):
             np.asarray(g), rr, rtol=2e-3,
             atol=2e-3 * max(1.0, float(np.abs(rr).max())),
             err_msg=f"d{name} ({cin},{cout},g{groups})")
+
+
+def test_on_tpu_recognizes_plugin_platforms(monkeypatch):
+    """The auto-dispatch predicate must not be fooled by TPU plugin
+    platforms whose backend name is not the literal 'tpu' (r3: the
+    tunneled 'axon' platform silently got the reference path)."""
+    import importlib
+
+    # note: `import torchbooster_tpu.ops.attention as m` would bind the
+    # FUNCTION (the package attribute shadows the submodule) — the very
+    # trap that hid the dispatch bug; importlib gets the module
+    attn_mod = importlib.import_module("torchbooster_tpu.ops.attention")
+
+    monkeypatch.setattr(attn_mod.jax, "default_backend", lambda: "tpu")
+    assert attn_mod._on_tpu()
+    monkeypatch.setattr(attn_mod.jax, "default_backend", lambda: "cpu")
+    assert not attn_mod._on_tpu()
+    monkeypatch.setattr(attn_mod.jax, "default_backend", lambda: "axon")
+    assert attn_mod._on_tpu()
+
+
+def test_bench_decode_dataset_pickles_for_process_workers():
+    """bench.py's loader dataset must survive the spawn pickling that
+    workers='process' requires (r3: a stored module attribute made it
+    unpicklable, silently killing the process-mode measurement)."""
+    import pickle
+    import sys
+    from pathlib import Path
+
+    sys.path.insert(0, str(Path(__file__).parent.parent))
+    try:
+        from bench import _DecodeHeavyDataset
+    finally:
+        sys.path.pop(0)
+    ds = _DecodeHeavyDataset(4, 16)
+    clone = pickle.loads(pickle.dumps(ds))
+    img, label = clone[1]
+    np.testing.assert_array_equal(img, ds[1][0])
+    assert img.shape == (16, 16, 3)
