@@ -342,3 +342,17 @@ def test_bench_decode_dataset_pickles_for_process_workers():
     img, label = clone[1]
     np.testing.assert_array_equal(img, ds[1][0])
     assert img.shape == (16, 16, 3)
+
+
+def test_resnet18_fused_blocks_match_unfused():
+    """Basic blocks (ResNet-18) through the fused 3x3+GN path equal the
+    plain XLA path."""
+    from torchbooster_tpu.models.resnet import ResNet
+
+    params = ResNet.init(jax.random.PRNGKey(0), depth=18, num_classes=10,
+                         stem="cifar")
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 16, 16, 3))
+    plain = ResNet.apply(params, x, fused=False)
+    fused = ResNet.apply(params, x, fused="interpret")
+    np.testing.assert_allclose(np.asarray(fused), np.asarray(plain),
+                               rtol=5e-4, atol=5e-4)

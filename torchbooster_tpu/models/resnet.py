@@ -71,17 +71,17 @@ def _norm(params: dict, x: jax.Array, norm: str, relu: bool = False):
     return L.group_norm(params, x, _GROUPS, relu=relu)
 
 
-def _basic_block(params: dict, x: jax.Array, stride: int,
-                 norm: str) -> jax.Array:
+def _basic_block(params: dict, x: jax.Array, stride: int, norm: str,
+                 fused: str | bool = "auto") -> jax.Array:
     # explicit padding=1 (not "SAME"): identical at stride 1, but
     # torch-symmetric at stride 2 — keeps torch imports exact
-    y = L.conv(params["conv1"], x, stride=stride, padding=1)
-    y = _norm(params["norm1"], y, norm, relu=True)
-    y = L.conv(params["conv2"], y, padding=1)
-    y = _norm(params["norm2"], y, norm)
+    y = _conv3x3_norm(params["conv1"], params["norm1"], x, norm,
+                      stride=stride, fused=fused, relu=True)
+    y = _conv3x3_norm(params["conv2"], params["norm2"], y, norm,
+                      stride=1, fused=fused, relu=False)
     if "proj" in params:
-        x = _norm(params["proj_norm"],
-                  L.conv(params["proj"], x, stride=stride), norm)
+        x = _conv1x1_norm(params["proj"], params["proj_norm"], x, norm,
+                          relu=False, stride=stride, fused=fused)
     return jax.nn.relu(x + y)
 
 
@@ -134,19 +134,20 @@ def _conv1x1_norm(conv_p: dict, norm_p: dict, x: jax.Array, norm: str,
 
 
 def _conv3x3_norm(conv_p: dict, norm_p: dict, x: jax.Array, norm: str,
-                  stride: int, fused: str | bool) -> jax.Array:
-    """3×3 conv + GN + relu; fused pallas path for the stride-1 body
-    (13 of ResNet-50's 16 conv2s — stage-entry stride-2 blocks keep
-    XLA)."""
+                  stride: int, fused: str | bool,
+                  relu: bool = True) -> jax.Array:
+    """3×3 conv + GN (+relu); fused pallas path for the stride-1 body
+    (13 of ResNet-50's 16 conv2s and both convs of interior basic
+    blocks — stage-entry stride-2 blocks keep XLA)."""
     cout = conv_p["kernel"].shape[-1]
     if stride == 1 and _use_fused(fused, norm, x, cout, three=True):
         from torchbooster_tpu.ops.fused_block import conv3x3_gn_relu
 
         return conv3x3_gn_relu(
             x, conv_p["kernel"], norm_p["scale"], norm_p["bias"],
-            groups=_GROUPS, interpret=(fused == "interpret"))
+            groups=_GROUPS, relu=relu, interpret=(fused == "interpret"))
     return _norm(norm_p, L.conv(conv_p, x, stride=stride, padding=1),
-                 norm, relu=True)
+                 norm, relu=relu)
 
 
 def _bottleneck(params: dict, x: jax.Array, stride: int,
@@ -245,7 +246,7 @@ class ResNet:
                 if "conv3" in block:
                     x = _bottleneck(block, x, stride, norm, fused)
                 else:
-                    x = _basic_block(block, x, stride, norm)
+                    x = _basic_block(block, x, stride, norm, fused)
                 bi += 1
             si += 1
         x = L.global_avg_pool(x)
