@@ -58,30 +58,19 @@ class Config(BaseConfig):
 
 
 def augment(seed: int):
-    """Host-side train augmentation: pad-crop + horizontal flip (the
-    TPU-world placement of ref resnet.py:96-103's transform stack —
-    augmentation runs on host CPU, never inside the compiled step).
-    One generator per loader worker thread (numpy Generators are not
-    thread-safe) — the analogue of torch DataLoader per-worker seeds."""
-    import threading
+    """Host-side train augmentation (the TPU-world placement of ref
+    resnet.py:96-103's transform stack — on host CPU, never inside the
+    compiled step): pad-crop, flip, rotation, cutout via
+    :mod:`torchbooster_tpu.data.transforms`."""
+    from torchbooster_tpu.data.transforms import (
+        Augment, horizontal_flip, pad_crop, random_erasing, rotation)
 
-    local = threading.local()
-
-    def transform(example):
-        rng = getattr(local, "rng", None)
-        if rng is None:
-            rng = local.rng = np.random.default_rng(
-                [seed, threading.get_ident() % (2 ** 31)])
-        image, label = example
-        image = np.asarray(image, np.float32)
-        pad = np.pad(image, ((4, 4), (4, 4), (0, 0)), mode="reflect")
-        y, x = rng.integers(0, 9, size=2)
-        image = pad[y:y + 32, x:x + 32]
-        if rng.random() < 0.5:
-            image = image[:, ::-1]
-        return image.copy(), label
-
-    return transform
+    return Augment(seed, [
+        pad_crop(32, 4),
+        horizontal_flip(),
+        rotation(15.0),
+        random_erasing(p=0.25),
+    ])
 
 
 def unpack(batch):

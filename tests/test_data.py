@@ -422,3 +422,62 @@ def test_dataloader_process_workers_roundtrip():
 def test_dataloader_rejects_unknown_worker_mode():
     with pytest.raises(ValueError, match="thread"):
         DataLoader([1, 2], workers="greenlet")
+
+
+# ---------------------------------------------------------------- transforms
+
+def test_transforms_shapes_and_determinism():
+    """Each factory preserves HWC shape (or crops to target) and the
+    composer is deterministic per (seed, thread)."""
+    from torchbooster_tpu.data import transforms as T
+
+    img = np.random.RandomState(0).rand(32, 32, 3).astype(np.float32)
+    aug = T.Augment(0, [T.pad_crop(32, 4), T.horizontal_flip(),
+                        T.rotation(15.0), T.color_jitter(0.2, 0.2),
+                        T.random_erasing(p=1.0)])
+    out = aug(img)
+    assert out.shape == (32, 32, 3) and out.dtype == np.float32
+    # fresh composer with the same seed replays the same stream
+    aug2 = T.Augment(0, aug.transforms)
+    np.testing.assert_array_equal(aug2(img), T.Augment(0, aug.transforms)(img))
+
+    crop = T.Augment(0, [T.center_crop(16)])(img)
+    assert crop.shape == (16, 16, 3)
+
+    norm = T.Augment(0, [T.normalize((0.5, 0.5, 0.5), (0.25, 0.25, 0.25))])
+    np.testing.assert_allclose(norm(np.full((4, 4, 3), 0.75, np.float32)),
+                               np.full((4, 4, 3), 1.0), rtol=1e-6)
+
+
+def test_transforms_example_structures():
+    """Augment handles (image, label) tuples and dicts, leaving labels
+    untouched."""
+    from torchbooster_tpu.data import transforms as T
+
+    img = np.ones((8, 8, 3), np.float32)
+    aug = T.Augment(0, [T.horizontal_flip(p=0.0)])
+    out_img, label = aug((img, 7))
+    assert label == 7 and out_img.shape == img.shape
+    out = aug({"image": img, "label": 3})
+    assert out["label"] == 3 and out["image"].shape == img.shape
+
+
+def test_augment_survives_process_workers():
+    """Augment pickles (thread-local rng rebuilt in the worker), so the
+    same pipeline runs under workers='process'."""
+    from torchbooster_tpu.data import transforms as T
+    from torchbooster_tpu.dataset import ArrayDataset, TransformDataset
+
+    base = ArrayDataset(
+        np.random.RandomState(0).rand(16, 8, 8, 3).astype(np.float32),
+        np.arange(16))
+    ds = TransformDataset(base, T.Augment(
+        3, [T.pad_crop(8, 2), T.horizontal_flip()]))
+    loader = DataLoader(ds, batch_size=4, shuffle=False, num_workers=2,
+                        workers="process")
+    try:
+        images, labels = next(iter(loader))
+    finally:
+        loader.close()
+    assert images.shape == (4, 8, 8, 3)
+    np.testing.assert_array_equal(labels, np.arange(4))

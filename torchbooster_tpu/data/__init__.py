@@ -1,4 +1,5 @@
-"""Host data path: loaders, sharding, device prefetch, dataset sources."""
+"""Host data path: loaders, sharding, device prefetch, dataset sources,
+augmentation."""
 from torchbooster_tpu.data.pipeline import (
     DataLoader,
     ShardedIterable,
@@ -7,8 +8,10 @@ from torchbooster_tpu.data.pipeline import (
     prefetch_to_device,
 )
 from torchbooster_tpu.data.sources import register_dataset, resolve_dataset
+from torchbooster_tpu.data.transforms import Augment
 
 __all__ = [
-    "DataLoader", "ShardedIterable", "SizedIterable", "default_collate",
-    "prefetch_to_device", "register_dataset", "resolve_dataset",
+    "Augment", "DataLoader", "ShardedIterable", "SizedIterable",
+    "default_collate", "prefetch_to_device", "register_dataset",
+    "resolve_dataset",
 ]
