@@ -38,6 +38,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 # per-sample VMEM working set must fit comfortably; beyond this the
 # XLA path takes over (stem-sized spatial maps)
@@ -148,6 +149,9 @@ def _fwd(x3, w, scale, bias, groups: int, eps: float, relu: bool,
             jax.ShapeDtypeStruct((b, 1, cout), jnp.float32),
             jax.ShapeDtypeStruct((b, 1, cout), jnp.float32),
         ],
+        # cells are independent: let Mosaic pipeline DMA across them
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel",)),
         interpret=interpret,
     )(x3, w, scale.reshape(1, -1), bias.reshape(1, -1), avg)
 
@@ -307,6 +311,8 @@ def _conv3x3_gn(x4, w, scale, bias, groups, eps, relu, interpret):
         ],
         out_specs=pl.BlockSpec((g, m, cout), lambda i: (i, 0, 0)),
         out_shape=jax.ShapeDtypeStruct((b, m, cout), x4.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel",)),
         interpret=interpret,
     )(x4.reshape(b, m, cin), w, scale.reshape(1, -1),
       bias.reshape(1, -1), avg)
