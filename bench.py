@@ -48,13 +48,16 @@ RESNET50_TRAIN_FLOP_PER_IMG = 3 * 4.1e9
 def bench_tpu(batch: int, image: int, steps: int) -> float:
     rng = jax.random.PRNGKey(0)
     params = ResNet.init(rng, depth=50, num_classes=1000, stem="imagenet")
-    # BENCH_FUSED=1 forces the pallas conv+GN kernels (ops/fused_block)
-    # for A/B measurement; default follows the model's honest auto gate
+    # BENCH_FUSED=1 forces the pallas conv+GN kernels (ops/fused_block),
+    # BENCH_S2D=1 the space-to-depth stem — A/B knobs for measurement;
+    # defaults follow the model's honest auto gates
     fused = True if os.environ.get("BENCH_FUSED") else "auto"
+    s2d = bool(os.environ.get("BENCH_S2D"))
 
     def loss_fn(params, batch_data, rng):
         del rng
-        logits = ResNet.apply(params, batch_data["images"], fused=fused)
+        logits = ResNet.apply(params, batch_data["images"], fused=fused,
+                              stem_s2d=s2d)
         return cross_entropy(logits, batch_data["labels"]), {}
 
     tx = optax.sgd(1e-3, momentum=0.9)

@@ -237,3 +237,38 @@ def test_gpt_generate_moe_smoke():
                        compute_dtype=jnp.float32)
     assert out.shape == (3, 8)
     assert int(jnp.max(out)) < cfg.vocab
+
+
+def test_stem_s2d_matches_plain_conv():
+    """Space-to-depth stem repack == the 7x7/s2 pad-3 conv, exactly
+    (forward and grads) — and the whole model agrees end to end."""
+    from torchbooster_tpu.models.resnet import ResNet, _stem_s2d
+
+    k = jax.random.normal(jax.random.PRNGKey(0), (7, 7, 3, 8)) * 0.1
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 32, 32, 3))
+    want = jax.lax.conv_general_dilated(
+        x, k, (2, 2), [(3, 3), (3, 3)],
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    got = _stem_s2d(k, x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+    def loss(fn):
+        return lambda k, x: (fn(k, x) ** 2).sum()
+
+    gr = jax.grad(loss(lambda k, x: jax.lax.conv_general_dilated(
+        x, k, (2, 2), [(3, 3), (3, 3)],
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))),
+        argnums=(0, 1))(k, x)
+    gs = jax.grad(loss(_stem_s2d), argnums=(0, 1))(k, x)
+    for r, g in zip(gr, gs):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(r),
+                                   rtol=1e-4, atol=1e-4)
+
+    params = ResNet.init(jax.random.PRNGKey(2), depth=18, num_classes=10,
+                         stem="imagenet")
+    xs = jax.random.normal(jax.random.PRNGKey(3), (2, 64, 64, 3))
+    plain = ResNet.apply(params, xs)
+    s2d = ResNet.apply(params, xs, stem_s2d=True)
+    np.testing.assert_allclose(np.asarray(s2d), np.asarray(plain),
+                               rtol=2e-4, atol=2e-4)

@@ -150,6 +150,38 @@ def _conv3x3_norm(conv_p: dict, norm_p: dict, x: jax.Array, norm: str,
                  norm, relu=relu)
 
 
+def _stem_s2d(kernel: jax.Array, x: jax.Array) -> jax.Array:
+    """The 7×7/s2 ImageNet stem conv as a space-to-depth conv: input
+    (B, H, W, 3) repacks to (B, H/2, W/2, 12) and the kernel to
+    (4, 4, 12, Cout), turning a 3-input-channel conv (≈2% MXU lane
+    fill) into a 12-channel stride-1 conv — the MLPerf-style stem
+    repack the r2 ablation prescribed for the 56²/C=64 underfill.
+    Exactly conv(x, kernel, stride 2, pad 3) by construction (tested);
+    pure jnp re-indexing, so it trains through unchanged."""
+    b, h, w, c = x.shape
+    kh, kw, _, cout = kernel.shape
+    # space-to-depth: S[u, v, (sy, sx, c)] = x[2u+sy, 2v+sx, c]
+    s = x.reshape(b, h // 2, 2, w // 2, 2, c)
+    s = s.transpose(0, 1, 3, 2, 4, 5).reshape(b, h // 2, w // 2, 4 * c)
+    # kernel repack: out[y,x] = Σ_{ky,kx} in[2y+ky-3, 2x+kx-3]·K[ky,kx]
+    # with 2y+ky-3 = 2(y+dy)+sy, sy=(ky-3) mod 2, dy=(ky-3-sy)//2 ∈
+    # [-2, 1] → 4×4 taps over the s2d grid, padding (2, 1) per side
+    kp = jnp.zeros((4, 4, 4 * c, cout), kernel.dtype)
+    for ky in range(kh):
+        sy = (ky - 3) % 2
+        dy = (ky - 3 - sy) // 2
+        for kx in range(kw):
+            sx = (kx - 3) % 2
+            dx = (kx - 3 - sx) // 2
+            # s2d channel block (sy, sx): channels [(sy*2+sx)*c : +c]
+            kp = kp.at[dy + 2, dx + 2,
+                       (sy * 2 + sx) * c:(sy * 2 + sx + 1) * c,
+                       :].set(kernel[ky, kx])
+    return jax.lax.conv_general_dilated(
+        s, kp.astype(s.dtype), (1, 1), [(2, 1), (2, 1)],
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+
 def _bottleneck(params: dict, x: jax.Array, stride: int,
                 norm: str, fused: str | bool = "auto") -> jax.Array:
     y = _conv1x1_norm(params["conv1"], params["norm1"], x, norm,
@@ -220,19 +252,30 @@ class ResNet:
               rng: jax.Array | None = None,
               pool_stem: bool | None = None,
               norm: str = "group",
-              fused: str | bool = "auto") -> jax.Array:
+              fused: str | bool = "auto",
+              stem_s2d: bool = False) -> jax.Array:
         """``fused``: the 1×1-conv+GN pallas kernel (ops/fused_block).
         "auto" currently resolves to the plain XLA path — the kernel
         has not yet beaten XLA end-to-end on the chip bench (see
         _use_fused and docs/performance.md). True forces it on;
-        "interpret" is the CPU-debuggable variant for tests."""
+        "interpret" is the CPU-debuggable variant for tests.
+        ``stem_s2d``: run the 7×7/s2 stem as a space-to-depth conv
+        (:func:`_stem_s2d`; opt-in pending chip measurement)."""
         del train, rng
         stem = params["stem"]
         stem_stride = 2 if stem["conv"]["kernel"].shape[0] == 7 else 1
         if pool_stem is None:
             pool_stem = stem_stride == 2
         stem_pad = 3 if stem_stride == 2 else 1
-        x = L.conv(stem["conv"], x, stride=stem_stride, padding=stem_pad)
+        if stem_s2d and stem_stride == 2 \
+                and x.shape[1] % 2 == 0 and x.shape[2] % 2 == 0:
+            y = _stem_s2d(stem["conv"]["kernel"], x)
+            if "bias" in stem["conv"]:
+                y = y + stem["conv"]["bias"].astype(y.dtype)
+            x = y
+        else:
+            x = L.conv(stem["conv"], x, stride=stem_stride,
+                       padding=stem_pad)
         x = _norm(stem["norm"], x, norm, relu=True)
         if pool_stem:
             x = L.max_pool(x, 3, 2, padding=1)
