@@ -95,12 +95,7 @@ def bench_gpt(steps: int) -> tuple[float, float]:
     params = GPT.init(jax.random.PRNGKey(0), cfg)
     n_params = sum(p.size for p in jax.tree.leaves(params))
     tx = optax.adamw(1e-4)
-
-    def loss_fn(p, b, rng):
-        del rng
-        logits = GPT.apply(p, b["ids"], cfg)
-        return cross_entropy(logits[:, :-1].reshape(-1, cfg.vocab),
-                             b["ids"][:, 1:].reshape(-1)), {}
+    loss_fn = _gpt_loss_fn(cfg)
 
     state = TrainState.create(params, tx)
     step = make_step(loss_fn, tx)
@@ -118,6 +113,29 @@ def bench_gpt(steps: int) -> tuple[float, float]:
     tok_s = batch * cfg.seq_len / dt
     mfu = 6 * n_params * batch * cfg.seq_len / dt / (SUSTAINED_TFLOPS * 1e12)
     return tok_s, mfu
+
+
+def _gpt_loss_fn(cfg):
+    """BENCH_GPT_CHUNKED=1: stream tokens through the LM head in chunks
+    (losses.lm_head_cross_entropy) so the (T, vocab) logits are never a
+    live activation — the A/B knob for the head-memory experiment."""
+    from torchbooster_tpu.models.gpt import GPT
+    from torchbooster_tpu.ops.losses import lm_head_cross_entropy
+
+    if os.environ.get("BENCH_GPT_CHUNKED"):
+        def loss_fn(p, b, rng):
+            del rng
+            hidden = GPT.apply(p, b["ids"], cfg, return_hidden=True)
+            return lm_head_cross_entropy(
+                hidden[:, :-1], GPT.head_table(p), b["ids"][:, 1:]), {}
+        return loss_fn
+
+    def loss_fn(p, b, rng):
+        del rng
+        logits = GPT.apply(p, b["ids"], cfg)
+        return cross_entropy(logits[:, :-1].reshape(-1, cfg.vocab),
+                             b["ids"][:, 1:].reshape(-1)), {}
+    return loss_fn
 
 
 def bench_gpt_long(steps: int) -> tuple[float, float]:
@@ -143,12 +161,7 @@ def bench_gpt_long(steps: int) -> tuple[float, float]:
     params = GPT.init(jax.random.PRNGKey(0), cfg)
     n_params = sum(p.size for p in jax.tree.leaves(params))
     tx = optax.adamw(1e-4)
-
-    def loss_fn(p, b, rng):
-        del rng
-        logits = GPT.apply(p, b["ids"], cfg, remat=True)
-        return cross_entropy(logits[:, :-1].reshape(-1, cfg.vocab),
-                             b["ids"][:, 1:].reshape(-1)), {}
+    loss_fn = _gpt_loss_fn(cfg)
 
     state = TrainState.create(params, tx)
     step = make_step(loss_fn, tx)

@@ -356,3 +356,59 @@ def test_resnet18_fused_blocks_match_unfused():
     fused = ResNet.apply(params, x, fused="interpret")
     np.testing.assert_allclose(np.asarray(fused), np.asarray(plain),
                                rtol=5e-4, atol=5e-4)
+
+
+@pytest.mark.parametrize("smoothing,t", [(0.0, 64), (0.1, 50)])
+def test_lm_head_cross_entropy_matches_full_logits(smoothing, t):
+    """Chunked LM-head loss (logits never fully materialized) == plain
+    cross_entropy on the full logits — value and grads (dhidden,
+    dtable), including non-divisible chunking and label smoothing."""
+    from torchbooster_tpu.ops.losses import lm_head_cross_entropy
+
+    d, vocab = 16, 37
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    hidden = jax.random.normal(ks[0], (t, d))
+    table = jax.random.normal(ks[1], (vocab, d)) * 0.2
+    labels = jax.random.randint(ks[2], (t,), 0, vocab)
+
+    def full(h, tab):
+        return cross_entropy(h @ tab.T, labels,
+                             label_smoothing=smoothing)
+
+    def chunked(h, tab):
+        return lm_head_cross_entropy(h, tab, labels,
+                                     label_smoothing=smoothing,
+                                     chunk_size=16)
+
+    np.testing.assert_allclose(float(chunked(hidden, table)),
+                               float(full(hidden, table)), rtol=1e-5)
+    gf = jax.grad(full, argnums=(0, 1))(hidden, table)
+    gc = jax.grad(chunked, argnums=(0, 1))(hidden, table)
+    for name, a, b in zip(("dhidden", "dtable"), gf, gc):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   rtol=1e-4, atol=1e-5, err_msg=name)
+
+
+def test_gpt_hidden_path_matches_logits_path():
+    """GPT loss via return_hidden + chunked head == loss via full
+    logits (tied and untied heads)."""
+    from torchbooster_tpu.models.gpt import GPT, GPTConfig
+    from torchbooster_tpu.ops.losses import lm_head_cross_entropy
+
+    for tie in (True, False):
+        cfg = GPTConfig(vocab=61, n_layers=2, d_model=32, n_heads=4,
+                        seq_len=16, tie_embeddings=tie)
+        params = GPT.init(jax.random.PRNGKey(0), cfg)
+        ids = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0,
+                                 cfg.vocab)
+        labels = jnp.roll(ids, -1, axis=1)
+        logits = GPT.apply(params, ids, cfg, compute_dtype=jnp.float32,
+                           remat=False)
+        want = float(cross_entropy(logits.reshape(-1, cfg.vocab),
+                                   labels.reshape(-1)))
+        hidden = GPT.apply(params, ids, cfg, compute_dtype=jnp.float32,
+                           remat=False, return_hidden=True)
+        got = float(lm_head_cross_entropy(hidden, GPT.head_table(params),
+                                          labels, chunk_size=8))
+        np.testing.assert_allclose(got, want, rtol=1e-5,
+                                   err_msg=f"tie={tie}")

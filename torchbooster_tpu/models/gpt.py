@@ -137,7 +137,8 @@ class GPT:
               compute_dtype: Any = jnp.bfloat16,
               remat: bool = True,
               attn_impl: str = "auto",
-              return_aux: bool = False) -> jax.Array:
+              return_aux: bool = False,
+              return_hidden: bool = False) -> jax.Array:
         b, s = ids.shape
         if s > cfg.seq_len:
             # jnp.take would silently fill NaN embeddings for positions
@@ -176,11 +177,26 @@ class GPT:
             lambda carry, bp: scan_block(carry, bp),
             (x, jnp.zeros((), jnp.float32)), params["blocks"])
 
-        logits = _lm_head(params, x)
+        if return_hidden:
+            # final-norm hidden states, for the chunked LM-head loss
+            # (ops.losses.lm_head_cross_entropy + GPT.head_table) that
+            # never materializes the (T, vocab) logits
+            out = L.layer_norm(params["ln_f"], x)
+        else:
+            out = _lm_head(params, x)
         if return_aux:
             # mean load-balance loss over layers (0 for dense models)
-            return logits, aux / max(cfg.n_layers, 1)
-        return logits
+            return out, aux / max(cfg.n_layers, 1)
+        return out
+
+    @staticmethod
+    def head_table(params: dict) -> jax.Array:
+        """(vocab, d) output-projection table — the ``table`` argument
+        of :func:`~torchbooster_tpu.ops.losses.lm_head_cross_entropy`
+        (tied: the wte table; untied: the head kernel transposed)."""
+        if "head" in params:
+            return params["head"]["kernel"].T
+        return params["wte"]["table"]
 
 
 def _block_core(bp: dict, x: jax.Array, cfg: GPTConfig, attend,
