@@ -45,14 +45,20 @@ SUSTAINED_TFLOPS = 133.0  # measured bf16 8k matmul on this chip
 RESNET50_TRAIN_FLOP_PER_IMG = 3 * 4.1e9
 
 
+def env_flag(name: str) -> bool:
+    """A/B knobs must read honestly: '0'/'false'/'' are OFF."""
+    return os.environ.get(name, "").strip().lower() not in (
+        "", "0", "false", "no")
+
+
 def bench_tpu(batch: int, image: int, steps: int) -> float:
     rng = jax.random.PRNGKey(0)
     params = ResNet.init(rng, depth=50, num_classes=1000, stem="imagenet")
     # BENCH_FUSED=1 forces the pallas conv+GN kernels (ops/fused_block),
     # BENCH_S2D=1 the space-to-depth stem — A/B knobs for measurement;
     # defaults follow the model's honest auto gates
-    fused = True if os.environ.get("BENCH_FUSED") else "auto"
-    s2d = bool(os.environ.get("BENCH_S2D"))
+    fused = True if env_flag("BENCH_FUSED") else "auto"
+    s2d = env_flag("BENCH_S2D")
 
     def loss_fn(params, batch_data, rng):
         del rng
@@ -122,7 +128,7 @@ def _gpt_loss_fn(cfg):
     from torchbooster_tpu.models.gpt import GPT
     from torchbooster_tpu.ops.losses import lm_head_cross_entropy
 
-    if os.environ.get("BENCH_GPT_CHUNKED"):
+    if env_flag("BENCH_GPT_CHUNKED"):
         def loss_fn(p, b, rng):
             del rng
             hidden = GPT.apply(p, b["ids"], cfg, return_hidden=True)
@@ -338,21 +344,21 @@ def main() -> None:
            if on_tpu else None)
 
     gpt_tok_s = gpt_mfu = None
-    if on_tpu and not os.environ.get("BENCH_SKIP_GPT"):
+    if on_tpu and not env_flag("BENCH_SKIP_GPT"):
         try:
             gpt_tok_s, gpt_mfu = bench_gpt(max(4, steps // 4))
         except Exception as exc:  # noqa: BLE001 — secondary metric
             print(f"gpt bench failed ({exc})", file=sys.stderr)
 
     gpt_long_tok_s = gpt_long_mfu = None
-    if on_tpu and not os.environ.get("BENCH_SKIP_GPT_LONG"):
+    if on_tpu and not env_flag("BENCH_SKIP_GPT_LONG"):
         try:
             gpt_long_tok_s, gpt_long_mfu = bench_gpt_long(max(4, steps // 4))
         except Exception as exc:  # noqa: BLE001 — secondary metric
             print(f"gpt long bench failed ({exc})", file=sys.stderr)
 
     loader_ips = loader_mode = None
-    if on_tpu and not os.environ.get("BENCH_SKIP_LOADER"):
+    if on_tpu and not env_flag("BENCH_SKIP_LOADER"):
         try:
             workers = int(os.environ.get("BENCH_LOADER_WORKERS",
                                          min(16, (os.cpu_count() or 8))))
@@ -364,7 +370,7 @@ def main() -> None:
             print(f"loader bench failed ({exc})", file=sys.stderr)
 
     baseline = FALLBACK_TORCH_CPU_IPS
-    if not os.environ.get("BENCH_SKIP_TORCH"):
+    if not env_flag("BENCH_SKIP_TORCH"):
         try:
             tb = min(batch, 16)
             baseline = bench_torch_cpu(tb, image, max(2, steps // 8))

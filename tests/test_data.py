@@ -481,3 +481,18 @@ def test_augment_survives_process_workers():
         loader.close()
     assert images.shape == (4, 8, 8, 3)
     np.testing.assert_array_equal(labels, np.arange(4))
+
+
+def test_augment_streams_distinct_across_processes(monkeypatch):
+    """Worker rng keys include the pid: two processes with identical
+    thread idents must NOT replay the same augmentation stream."""
+    from torchbooster_tpu.data import transforms as T
+
+    img = np.random.RandomState(0).rand(16, 16, 3).astype(np.float32)
+    a = T.Augment(5, [T.pad_crop(16, 4)])
+    out_a = a(img)
+    monkeypatch.setattr("torchbooster_tpu.data.transforms.os.getpid",
+                        lambda: 99999)
+    b = T.Augment(5, [T.pad_crop(16, 4)])
+    out_b = b(img)
+    assert not np.array_equal(out_a, out_b)

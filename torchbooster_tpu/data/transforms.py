@@ -19,6 +19,7 @@ Design:
 """
 from __future__ import annotations
 
+import os
 import threading
 from typing import Any, Sequence
 
@@ -36,7 +37,9 @@ class PadCrop:
         pad = self.pad
         padded = np.pad(img, ((pad, pad), (pad, pad), (0, 0)),
                         mode=self.mode)
-        y, x = rng.integers(0, 2 * pad + 1, size=2)
+        # full torchvision range: any offset where the crop fits
+        y = int(rng.integers(0, padded.shape[0] - self.size + 1))
+        x = int(rng.integers(0, padded.shape[1] - self.size + 1))
         return padded[y:y + self.size, x:x + self.size]
 
 
@@ -171,8 +174,12 @@ class Augment:
     def _rng(self) -> np.random.Generator:
         rng = getattr(self._local, "rng", None)
         if rng is None:
+            # key by (seed, pid, thread id): thread idents are only
+            # unique within a process, so process workers need the pid
+            # too or they could replay identical augmentation streams
             rng = self._local.rng = np.random.default_rng(
-                [self.seed, threading.get_ident() % (2 ** 31)])
+                [self.seed, os.getpid(),
+                 threading.get_ident() % (2 ** 31)])
         return rng
 
     def _apply(self, img: Any) -> np.ndarray:
