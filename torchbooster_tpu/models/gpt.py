@@ -35,6 +35,11 @@ class GPTConfig:
     n_layers: int = 12
     d_model: int = 768
     n_heads: int = 12
+    # grouped-query attention: 0 → = n_heads (standard MHA). Fewer KV
+    # heads shrink the decode KV cache (and its HBM traffic) by
+    # n_heads / n_kv_heads; training K/V are repeated to full heads
+    # before the attention kernel, so flash/ring paths are unchanged
+    n_kv_heads: int = 0
     seq_len: int = 1024
     mlp_ratio: int = 4
     dropout: float = 0.0      # recipe-level; models stay deterministic
@@ -44,6 +49,10 @@ class GPTConfig:
     n_experts: int = 0
     top_k: int = 2
     capacity_factor: float = 1.25
+
+    @property
+    def kv_heads(self) -> int:
+        return self.n_kv_heads or self.n_heads
 
 
 # path-regex → PartitionSpec (leading None = the stacked layer axis).
@@ -83,9 +92,11 @@ def _block_init(rng: jax.Array, cfg: GPTConfig, dtype: Any) -> dict:
     d, h = cfg.d_model, cfg.mlp_ratio * cfg.d_model
     # GPT-2 init: N(0, 0.02), residual projections scaled by 1/√(2L)
     res_std = 0.02 / (2 * cfg.n_layers) ** 0.5
+    head_dim = d // cfg.n_heads
+    qkv_out = d + 2 * cfg.kv_heads * head_dim
     block = {
         "ln1": L.norm_init(d, dtype),
-        "attn_qkv": L.dense_init(ks[0], d, 3 * d, std=0.02, dtype=dtype),
+        "attn_qkv": L.dense_init(ks[0], d, qkv_out, std=0.02, dtype=dtype),
         "attn_proj": L.dense_init(ks[1], d, d, std=res_std, dtype=dtype),
         "ln2": L.norm_init(d, dtype),
     }
@@ -112,6 +123,10 @@ class GPT:
     @staticmethod
     def init(rng: jax.Array, cfg: GPTConfig = GPTConfig(),
              dtype: Any = jnp.float32) -> dict:
+        if cfg.n_heads % cfg.kv_heads:
+            raise ValueError(
+                f"n_heads={cfg.n_heads} not divisible by "
+                f"n_kv_heads={cfg.kv_heads}")
         k_wte, k_wpe, k_blocks, k_head = jax.random.split(rng, 4)
         blocks = jax.vmap(
             lambda k: _block_init(k, cfg, dtype)
@@ -156,6 +171,7 @@ class GPT:
                     and mesh.shape["sp"] > 1)
 
         def attend(q, k, v):
+            k, v = _expand_kv(k, cfg), _expand_kv(v, cfg)
             if use_ring:
                 from torchbooster_tpu.parallel.ring import ring_attention
 
@@ -199,6 +215,13 @@ class GPT:
         return params["wte"]["table"]
 
 
+def _expand_kv(kv: jax.Array, cfg: GPTConfig) -> jax.Array:
+    """Repeat grouped K/V heads up to the full query-head count (GQA):
+    (B, S, kv_heads, Dh) → (B, S, n_heads, Dh)."""
+    rep = cfg.n_heads // cfg.kv_heads
+    return kv if rep == 1 else jnp.repeat(kv, rep, axis=2)
+
+
 def _block_core(bp: dict, x: jax.Array, cfg: GPTConfig, attend,
                 constrain=lambda x: x,
                 capacity_factor: float | None = None
@@ -209,13 +232,17 @@ def _block_core(bp: dict, x: jax.Array, cfg: GPTConfig, attend,
     ``extras`` passes through (K/V for prefill, updated caches for
     decode). Returns (x, aux_loss, extras)."""
     b, s, d = x.shape
-    n_heads = cfg.n_heads
+    n_heads, kv_heads = cfg.n_heads, cfg.kv_heads
     head_dim = d // n_heads
     aux = jnp.zeros((), jnp.float32)
 
     h = L.layer_norm(bp["ln1"], x)
-    qkv = L.dense(bp["attn_qkv"], h).reshape(b, s, 3, n_heads, head_dim)
-    o, extras = attend(qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2])
+    qkv = L.dense(bp["attn_qkv"], h)
+    q = qkv[..., :d].reshape(b, s, n_heads, head_dim)
+    kv_dim = kv_heads * head_dim
+    k = qkv[..., d:d + kv_dim].reshape(b, s, kv_heads, head_dim)
+    v = qkv[..., d + kv_dim:].reshape(b, s, kv_heads, head_dim)
+    o, extras = attend(q, k, v)
     x = constrain(x + L.dense(bp["attn_proj"], o.reshape(b, s, d)))
     h = L.layer_norm(bp["ln2"], x)
     if cfg.n_experts > 0:
@@ -245,17 +272,21 @@ def _cached_block(bp: dict, x: jax.Array, cache_k: jax.Array,
     s_cache = cache_k.shape[1]
 
     def attend(q, k, v):
+        # the cache stores only kv_heads (the GQA memory win); heads
+        # expand to the query count at attention time
         ck = jax.lax.dynamic_update_slice(
             cache_k, k.astype(cache_k.dtype), (0, pos, 0, 0))
         cv = jax.lax.dynamic_update_slice(
             cache_v, v.astype(cache_v.dtype), (0, pos, 0, 0))
+        ck_full = _expand_kv(ck, cfg)
+        cv_full = _expand_kv(cv, cfg)
         scores = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
-                            ck.astype(jnp.float32)) / (head_dim ** 0.5)
+                            ck_full.astype(jnp.float32)) / (head_dim ** 0.5)
         visible = jnp.arange(s_cache)[None, None, None, :] <= pos
         scores = jnp.where(visible, scores, -1e30)
         probs = jax.nn.softmax(scores, axis=-1)
         o = jnp.einsum("bhqk,bkhd->bqhd", probs,
-                       cv.astype(jnp.float32)).astype(q.dtype)
+                       cv_full.astype(jnp.float32)).astype(q.dtype)
         return o, (ck, cv)
 
     x, _, (cache_k, cache_v) = _block_core(
@@ -309,7 +340,9 @@ def generate(params: dict, ids: jax.Array,
 
     def prefill_block(x, bp):
         def attend(q, k, v):
-            return attention(q, k, v, causal=True), (k, v)
+            # cache keeps the grouped kv_heads; expand only for attend
+            return attention(q, _expand_kv(k, cfg), _expand_kv(v, cfg),
+                             causal=True), (k, v)
 
         x, _, kv = _block_core(bp, x, cfg, attend)
         return x, kv
