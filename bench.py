@@ -51,6 +51,21 @@ def env_flag(name: str) -> bool:
         "", "0", "false", "no")
 
 
+def timed_steps(step, state, data, steps: int) -> float:
+    """Warmup (compile + steady state), then time ``steps`` steps;
+    returns seconds/step. Sync via host read of the loss — on the
+    tunneled device runtime block_until_ready returns before execution
+    finishes; a D2H of the result cannot."""
+    for _ in range(2):
+        state, metrics = step(state, data)
+    np.asarray(metrics["loss"])
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = step(state, data)
+    np.asarray(metrics["loss"])
+    return (time.perf_counter() - t0) / steps
+
+
 def bench_tpu(batch: int, image: int, steps: int) -> float:
     rng = jax.random.PRNGKey(0)
     params = ResNet.init(rng, depth=50, num_classes=1000, stem="imagenet")
@@ -75,19 +90,7 @@ def bench_tpu(batch: int, image: int, steps: int) -> float:
     y = jax.device_put(jnp.zeros((batch,), jnp.int32))
     data = {"images": x, "labels": y}
 
-    # warmup: compile + one steady-state step. Sync via host read of the
-    # loss — on the tunneled device runtime block_until_ready returns
-    # before execution finishes; a D2H of the result cannot.
-    for _ in range(2):
-        state, metrics = step(state, data)
-    np.asarray(metrics["loss"])
-
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        state, metrics = step(state, data)
-    np.asarray(metrics["loss"])
-    dt = time.perf_counter() - t0
-    return batch * steps / dt
+    return batch / timed_steps(step, state, data, steps)
 
 
 def bench_gpt(steps: int) -> tuple[float, float]:
@@ -108,14 +111,7 @@ def bench_gpt(steps: int) -> tuple[float, float]:
     ids = jax.random.randint(jax.random.PRNGKey(1), (batch, cfg.seq_len),
                              0, cfg.vocab)
     data = {"ids": ids}
-    for _ in range(2):
-        state, metrics = step(state, data)
-    np.asarray(metrics["loss"])
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        state, metrics = step(state, data)
-    np.asarray(metrics["loss"])
-    dt = (time.perf_counter() - t0) / steps
+    dt = timed_steps(step, state, data, steps)
     tok_s = batch * cfg.seq_len / dt
     mfu = 6 * n_params * batch * cfg.seq_len / dt / (SUSTAINED_TFLOPS * 1e12)
     return tok_s, mfu
@@ -174,14 +170,7 @@ def bench_gpt_long(steps: int) -> tuple[float, float]:
     ids = jax.random.randint(jax.random.PRNGKey(1), (batch, cfg.seq_len),
                              0, cfg.vocab)
     data = {"ids": ids}
-    for _ in range(2):
-        state, metrics = step(state, data)
-    np.asarray(metrics["loss"])
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        state, metrics = step(state, data)
-    np.asarray(metrics["loss"])
-    dt = (time.perf_counter() - t0) / steps
+    dt = timed_steps(step, state, data, steps)
     tok_s = batch * cfg.seq_len / dt
     mfu = 6 * n_params * batch * cfg.seq_len / dt / (SUSTAINED_TFLOPS * 1e12)
     return tok_s, mfu

@@ -56,11 +56,13 @@ class Rotation:
     """Uniform random rotation in ±``degrees`` (ref RandomRotation)."""
 
     def __init__(self, degrees: float, mode: str = "reflect"):
+        from scipy import ndimage  # noqa: F401 — fail fast if absent
+
         self.degrees, self.mode = degrees, mode
 
     def __call__(self, rng: np.random.Generator,
                  img: np.ndarray) -> np.ndarray:
-        from scipy import ndimage
+        from scipy import ndimage  # cached module lookup
 
         angle = float(rng.uniform(-self.degrees, self.degrees))
         return ndimage.rotate(img, angle, reshape=False, order=1,
