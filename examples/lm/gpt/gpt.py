@@ -50,6 +50,7 @@ class ModelConfig(BaseConfig):
     n_layers: int = 4
     d_model: int = 256
     n_heads: int = 8
+    n_kv_heads: int = 0             # grouped-query attention (0 = MHA)
     seq_len: int = 256
     remat: bool = True
     n_experts: int = 0              # > 0: MoE blocks over the ep axis
@@ -58,6 +59,7 @@ class ModelConfig(BaseConfig):
     def make(self) -> GPTConfig:
         return GPTConfig(vocab=self.vocab, n_layers=self.n_layers,
                          d_model=self.d_model, n_heads=self.n_heads,
+                         n_kv_heads=self.n_kv_heads,
                          seq_len=self.seq_len, n_experts=self.n_experts)
 
 
