@@ -1,0 +1,129 @@
+"""Chip-watcher + A/B experiment queue.
+
+The tunneled chip comes and goes (two multi-hour outages this round).
+This script polls for a healthy backend and, whenever the chip is up,
+drains a queue of bench configurations — so a returning chip is
+exploited immediately instead of waiting on a human (or an agent turn).
+
+Each configuration shells out to ``python bench.py --sub <name>`` (the
+single-metric child mode) with the matching env knobs under a hard
+deadline, so a mid-run tunnel drop (or a pathological kernel) costs one
+config, not the queue. Driving sub-benches directly keeps one deadline
+per measurement — no nesting against bench.py's own orchestrator
+budgets — and avoids re-measuring the resnet headline for configs that
+only vary gpt/loader knobs. Results append to ``logs/ab_results.jsonl``
+as one JSON object per attempt:
+    {"config": ..., "status": "ok"|"timeout"|"error", "result": {...}}
+
+Usage:  nohup python scripts/run_ab.py >logs/ab_watch.log 2>&1 &
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OUT = os.path.join(REPO, "logs", "ab_results.jsonl")
+
+# name -> (sub-bench, env overrides, deadline seconds). Deadlines are
+# generous: first-compile on the tunnel is slow, and the pallas paths
+# (BENCH_FUSED, gpt_long's flash) are the very thing under test.
+QUEUE: list[tuple[str, str, dict, int]] = [
+    ("baseline", "resnet", {}, 900),
+    ("fused", "resnet", {"BENCH_FUSED": "1"}, 1800),
+    ("s2d", "resnet", {"BENCH_S2D": "1"}, 1200),
+    ("fused_s2d", "resnet", {"BENCH_FUSED": "1", "BENCH_S2D": "1"}, 1800),
+    ("gpt", "gpt", {}, 1200),
+    ("gpt_chunked", "gpt", {"BENCH_GPT_CHUNKED": "1"}, 1200),
+    ("gpt_long_flash", "gpt_long", {}, 1800),
+    ("loader_thread", "loader", {}, 1200),
+    ("loader_process", "loader", {"BENCH_LOADER_MODE": "process"}, 1200),
+]
+
+PROBE = (
+    "import jax, jax.numpy as jnp, numpy as np;"
+    "x = jnp.ones((512, 512), jnp.bfloat16);"
+    "assert jax.default_backend() != 'cpu', 'cpu backend';"
+    "np.asarray(x @ x)"
+)
+
+
+def log(msg: str) -> None:
+    print(f"[{time.strftime('%H:%M:%S')}] {msg}", flush=True)
+
+
+def chip_up(timeout: int = 150) -> bool:
+    """A healthy chip answers init + matmul + D2H well inside this."""
+    try:
+        r = subprocess.run([sys.executable, "-c", PROBE], timeout=timeout,
+                           capture_output=True, cwd=REPO)
+        return r.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
+def record(entry: dict) -> None:
+    os.makedirs(os.path.dirname(OUT), exist_ok=True)
+    with open(OUT, "a") as f:
+        f.write(json.dumps(entry) + "\n")
+
+
+def run_config(name: str, sub: str, env_over: dict, deadline: int) -> str:
+    env = {**os.environ, **env_over,
+           # steps trimmed: enough for a stable mean, small enough that
+           # a flaky tunnel window still fits a full config
+           "BENCH_STEPS": os.environ.get("AB_STEPS", "12")}
+    t0 = time.time()
+    try:
+        r = subprocess.run([sys.executable, "bench.py", "--sub", sub],
+                           timeout=deadline, capture_output=True,
+                           text=True, cwd=REPO, env=env)
+    except subprocess.TimeoutExpired:
+        record({"config": name, "status": "timeout", "seconds": deadline})
+        return "timeout"
+    line = next((ln for ln in r.stdout.splitlines()
+                 if ln.startswith("{")), None)
+    if r.returncode == 0 and line:
+        record({"config": name, "status": "ok",
+                "seconds": round(time.time() - t0, 1),
+                "result": json.loads(line)})
+        return "ok"
+    record({"config": name, "status": "error", "rc": r.returncode,
+            "stderr": r.stderr[-2000:]})
+    return "error"
+
+
+def main() -> None:
+    done: set[str] = set()
+    if os.path.exists(OUT):
+        with open(OUT) as f:
+            for ln in f:
+                e = json.loads(ln)
+                if e.get("status") == "ok":
+                    done.add(e["config"])
+    pending = [c for c in QUEUE if c[0] not in done]
+    log(f"pending configs: {[c[0] for c in pending]}")
+    while pending:
+        if not chip_up():
+            log("chip down; sleeping 300s")
+            time.sleep(300)
+            continue
+        name, sub, env_over, deadline = pending.pop(0)
+        log(f"chip up; running {name} (deadline {deadline}s)")
+        status = run_config(name, sub, env_over, deadline)
+        log(f"{name}: {status}")
+        # keep a timed-out/errored config for ONE retry at the back of
+        # the queue (tunnel may have dropped mid-config), then drop it
+        if status != "ok" and not any(c[0] == name for c in pending):
+            attempts = sum(1 for ln in open(OUT)
+                           if json.loads(ln)["config"] == name)
+            if attempts < 2:
+                pending.append((name, sub, env_over, deadline))
+    log("queue drained")
+
+
+if __name__ == "__main__":
+    main()

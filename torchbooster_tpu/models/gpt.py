@@ -49,6 +49,11 @@ class GPTConfig:
     n_experts: int = 0
     top_k: int = 2
     capacity_factor: float = 1.25
+    # sequence-parallel attention strategy when the mesh has a real
+    # ``sp`` axis: "ring" (ppermute online-softmax, any head count),
+    # "ulysses" (all-to-all head resharding, flash-capable), or "auto"
+    # (ulysses when heads divide, else ring — parallel/ulysses.py)
+    sp_strategy: str = "auto"
 
     @property
     def kv_heads(self) -> int:
@@ -167,15 +172,17 @@ class GPT:
                             dtype=compute_dtype)
         x = constrain(x)
 
-        use_ring = (mesh is not None and "sp" in mesh.axis_names
-                    and mesh.shape["sp"] > 1)
+        use_sp = (mesh is not None and "sp" in mesh.axis_names
+                  and mesh.shape["sp"] > 1)
 
         def attend(q, k, v):
             k, v = _expand_kv(k, cfg), _expand_kv(v, cfg)
-            if use_ring:
-                from torchbooster_tpu.parallel.ring import ring_attention
+            if use_sp:
+                from torchbooster_tpu.parallel.ulysses import (
+                    sequence_attention)
 
-                return ring_attention(q, k, v, mesh=mesh, causal=True), None
+                return sequence_attention(q, k, v, mesh=mesh, causal=True,
+                                          strategy=cfg.sp_strategy), None
             return attention(q, k, v, causal=True, impl=attn_impl), None
 
         def block(carry: tuple, bp: dict) -> tuple[tuple, None]:

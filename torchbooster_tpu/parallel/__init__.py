@@ -21,7 +21,11 @@ from torchbooster_tpu.parallel.sharding import (
     shard_params,
     shard_state,
 )
+from torchbooster_tpu.parallel.ulysses import (
+    sequence_attention,
+    ulysses_attention,
+)
 
 __all__ = ["make_param_specs", "make_shardings", "make_state_specs",
-           "pipeline_apply", "ring_attention", "shard_params",
-           "shard_state"]
+           "pipeline_apply", "ring_attention", "sequence_attention",
+           "shard_params", "shard_state", "ulysses_attention"]

@@ -1,0 +1,116 @@
+"""All-to-all (Ulysses-style) sequence parallelism: the second SP
+strategy next to ring attention (parallel/ring.py).
+
+Layout dance: q/k/v arrive sequence-sharded (each device holds an
+S/sp slice of every head). One ``all_to_all`` per tensor re-shards
+them head-wise — afterwards each device holds the FULL sequence for
+H/sp heads — so attention is one dense local call with ordinary causal
+masking (and, on TPU, the pallas flash kernel: the all-to-all form is
+the only SP strategy that can use it, because the kernel needs the
+whole key sequence on-device). A final all-to-all restores sequence
+sharding for the rest of the network.
+
+Trade-offs vs the ring (when a mesh has a real ``sp`` axis):
+
+- ring: O(S/sp) activation memory per device, K/V circulate in ``sp``
+  ppermute hops overlapped with compute; works for any head count;
+  attention math stays in the online-softmax form (no flash kernel).
+- all-to-all: 4 collectives total (3 in, 1 out) moving O(S/sp·H·D)
+  each, attention runs on full S locally (flash-friendly, exact tril
+  mask), but needs H % (sp·tp) == 0 and the full-S attention working
+  set must fit one device.
+
+Heuristic (``sequence_attention(strategy="auto")``): all-to-all when
+the head count divides, ring otherwise — matching the published
+guidance (Ulysses for H ≥ sp, ring for extreme S or few heads).
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+from jax import shard_map
+
+
+def _local_heads(mesh: Mesh, n_heads: int) -> int:
+    """Per-device head count after the spec's tp sharding — the number
+    the all-to-all must further divide by sp."""
+    return n_heads // mesh.shape.get("tp", 1)
+
+
+def _ulysses_local(q: jax.Array, k: jax.Array, v: jax.Array, *, axis: str,
+                   causal: bool, sm_scale: float, impl: str) -> jax.Array:
+    """Per-device body under shard_map: q/k/v are (B, S_loc, H_loc, D)
+    sequence shards; returns the same-sharded attention output."""
+    from torchbooster_tpu.ops.attention import attention
+
+    # seq-sharded → head-sharded: split heads (2), gather seq (1)
+    qh = lax.all_to_all(q, axis, split_axis=2, concat_axis=1, tiled=True)
+    kh = lax.all_to_all(k, axis, split_axis=2, concat_axis=1, tiled=True)
+    vh = lax.all_to_all(v, axis, split_axis=2, concat_axis=1, tiled=True)
+    out = attention(qh, kh, vh, causal=causal, sm_scale=sm_scale, impl=impl)
+    # head-sharded → seq-sharded: split seq (1), gather heads (2)
+    return lax.all_to_all(out, axis, split_axis=1, concat_axis=2, tiled=True)
+
+
+def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
+                      causal: bool = True, sm_scale: float | None = None,
+                      axis: str = "sp", impl: str = "auto") -> jax.Array:
+    """Exact attention over (B, S, H, D) with S sharded on ``axis``.
+
+    Same contract as :func:`parallel.ring.ring_attention` (drop-in);
+    requires the per-device head count to divide by the ``sp`` size.
+    ``impl`` feeds the local attention dispatch ("auto" engages the
+    flash kernel on TPU from S≥4096).
+    """
+    *_, n_heads, head_dim = q.shape
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(head_dim)
+    sp_size = mesh.shape[axis]
+    local_heads = _local_heads(mesh, n_heads)
+    if local_heads % sp_size:
+        raise ValueError(
+            f"ulysses_attention needs heads/tp ({local_heads}) divisible "
+            f"by sp ({sp_size}); use ring_attention for this shape")
+
+    data = tuple(a for a in ("dp", "fsdp") if a in mesh.axis_names) or None
+    tp = "tp" if "tp" in mesh.axis_names else None
+    spec = P(data, axis, tp, None)
+
+    body = functools.partial(_ulysses_local, axis=axis, causal=causal,
+                             sm_scale=sm_scale, impl=impl)
+    fn = shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
+                   out_specs=spec, check_vma=False)
+    return fn(q, k, v)
+
+
+def sequence_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
+                       causal: bool = True, sm_scale: float | None = None,
+                       axis: str = "sp",
+                       strategy: str = "auto") -> jax.Array:
+    """One front door for sequence-parallel attention.
+
+    ``strategy``: "ring", "ulysses", or "auto" (all-to-all whenever the
+    head count divides — it is never slower on TPU meshes where both
+    apply, and unlocks the flash kernel; ring is the fallback that
+    always works).
+    """
+    from torchbooster_tpu.parallel.ring import ring_attention
+
+    if strategy == "auto":
+        *_, n_heads, _ = q.shape
+        divides = _local_heads(mesh, n_heads) % mesh.shape[axis] == 0
+        strategy = "ulysses" if divides else "ring"
+    if strategy == "ulysses":
+        return ulysses_attention(q, k, v, mesh, causal=causal,
+                                 sm_scale=sm_scale, axis=axis)
+    if strategy == "ring":
+        return ring_attention(q, k, v, mesh, causal=causal,
+                              sm_scale=sm_scale, axis=axis)
+    raise ValueError(f"unknown sequence-parallel strategy {strategy!r}")
+
+
+__all__ = ["sequence_attention", "ulysses_attention"]
