@@ -74,11 +74,14 @@ def bench_tpu(batch: int, image: int, steps: int) -> float:
     # defaults follow the model's honest auto gates
     fused = True if env_flag("BENCH_FUSED") else "auto"
     s2d = env_flag("BENCH_S2D")
+    # BENCH_NF=1: the norm-free (weight-standardized) variant — same
+    # param tree, zero activation-norm HBM traffic (models/resnet.py)
+    norm = "ws" if env_flag("BENCH_NF") else "group"
 
     def loss_fn(params, batch_data, rng):
         del rng
         logits = ResNet.apply(params, batch_data["images"], fused=fused,
-                              stem_s2d=s2d)
+                              stem_s2d=s2d, norm=norm)
         return cross_entropy(logits, batch_data["labels"]), {}
 
     tx = optax.sgd(1e-3, momentum=0.9)
@@ -325,6 +328,12 @@ def _shapes(on_tpu: bool) -> tuple[int, int, int]:
     return batch, image, steps
 
 
+def _first_json_line(text: str) -> str | None:
+    """The child protocol: exactly one line starting with '{'."""
+    return next((ln for ln in text.splitlines() if ln.startswith("{")),
+                None)
+
+
 def _run_sub(name: str, deadline: int) -> dict | None:
     """Run ONE sub-bench in a child interpreter under a hard deadline.
 
@@ -344,8 +353,7 @@ def _run_sub(name: str, deadline: int) -> dict | None:
               "drop or kernel hang); skipped", file=sys.stderr)
         return None
     sys.stderr.write(r.stderr)
-    line = next((ln for ln in r.stdout.splitlines()
-                 if ln.startswith("{")), None)
+    line = _first_json_line(r.stdout)
     if r.returncode != 0 or line is None:
         print(f"sub-bench {name}: failed (rc={r.returncode})",
               file=sys.stderr)
@@ -485,9 +493,9 @@ def main() -> None:
         if frag is not None:
             out.update(frag)
 
-    baseline = _torch_baseline(batch, image, steps)
     if out["value"] is not None:
-        out["vs_baseline"] = round(out["value"] / baseline, 2)
+        out["vs_baseline"] = round(
+            out["value"] / _torch_baseline(batch, image, steps), 2)
     print(json.dumps(out))
 
 

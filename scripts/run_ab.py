@@ -28,6 +28,9 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 OUT = os.path.join(REPO, "logs", "ab_results.jsonl")
 
+sys.path.insert(0, REPO)
+from bench import _first_json_line, _probe_tpu  # noqa: E402
+
 # name -> (sub-bench, env overrides, deadline seconds). Deadlines are
 # generous: first-compile on the tunnel is slow, and the pallas paths
 # (BENCH_FUSED, gpt_long's flash) are the very thing under test.
@@ -36,6 +39,8 @@ QUEUE: list[tuple[str, str, dict, int]] = [
     ("fused", "resnet", {"BENCH_FUSED": "1"}, 1800),
     ("s2d", "resnet", {"BENCH_S2D": "1"}, 1200),
     ("fused_s2d", "resnet", {"BENCH_FUSED": "1", "BENCH_S2D": "1"}, 1800),
+    ("nf", "resnet", {"BENCH_NF": "1"}, 1200),
+    ("nf_s2d", "resnet", {"BENCH_NF": "1", "BENCH_S2D": "1"}, 1200),
     ("gpt", "gpt", {}, 1200),
     ("gpt_chunked", "gpt", {"BENCH_GPT_CHUNKED": "1"}, 1200),
     ("gpt_long_flash", "gpt_long", {}, 1800),
@@ -43,32 +48,28 @@ QUEUE: list[tuple[str, str, dict, int]] = [
     ("loader_process", "loader", {"BENCH_LOADER_MODE": "process"}, 1200),
 ]
 
-PROBE = (
-    "import jax, jax.numpy as jnp, numpy as np;"
-    "x = jnp.ones((512, 512), jnp.bfloat16);"
-    "assert jax.default_backend() != 'cpu', 'cpu backend';"
-    "np.asarray(x @ x)"
-)
-
-
 def log(msg: str) -> None:
     print(f"[{time.strftime('%H:%M:%S')}] {msg}", flush=True)
-
-
-def chip_up(timeout: int = 150) -> bool:
-    """A healthy chip answers init + matmul + D2H well inside this."""
-    try:
-        r = subprocess.run([sys.executable, "-c", PROBE], timeout=timeout,
-                           capture_output=True, cwd=REPO)
-        return r.returncode == 0
-    except subprocess.TimeoutExpired:
-        return False
 
 
 def record(entry: dict) -> None:
     os.makedirs(os.path.dirname(OUT), exist_ok=True)
     with open(OUT, "a") as f:
         f.write(json.dumps(entry) + "\n")
+
+
+def load_entries() -> list[dict]:
+    """Parsed result log, skipping any truncated trailing line (the
+    watcher may have been killed mid-append)."""
+    entries = []
+    if os.path.exists(OUT):
+        with open(OUT) as f:
+            for ln in f:
+                try:
+                    entries.append(json.loads(ln))
+                except json.JSONDecodeError:
+                    pass
+    return entries
 
 
 def run_config(name: str, sub: str, env_over: dict, deadline: int) -> str:
@@ -84,8 +85,7 @@ def run_config(name: str, sub: str, env_over: dict, deadline: int) -> str:
     except subprocess.TimeoutExpired:
         record({"config": name, "status": "timeout", "seconds": deadline})
         return "timeout"
-    line = next((ln for ln in r.stdout.splitlines()
-                 if ln.startswith("{")), None)
+    line = _first_json_line(r.stdout)
     if r.returncode == 0 and line:
         record({"config": name, "status": "ok",
                 "seconds": round(time.time() - t0, 1),
@@ -97,17 +97,11 @@ def run_config(name: str, sub: str, env_over: dict, deadline: int) -> str:
 
 
 def main() -> None:
-    done: set[str] = set()
-    if os.path.exists(OUT):
-        with open(OUT) as f:
-            for ln in f:
-                e = json.loads(ln)
-                if e.get("status") == "ok":
-                    done.add(e["config"])
+    done = {e["config"] for e in load_entries() if e.get("status") == "ok"}
     pending = [c for c in QUEUE if c[0] not in done]
     log(f"pending configs: {[c[0] for c in pending]}")
     while pending:
-        if not chip_up():
+        if _probe_tpu(150) != "tpu":
             log("chip down; sleeping 300s")
             time.sleep(300)
             continue
@@ -117,9 +111,9 @@ def main() -> None:
         log(f"{name}: {status}")
         # keep a timed-out/errored config for ONE retry at the back of
         # the queue (tunnel may have dropped mid-config), then drop it
-        if status != "ok" and not any(c[0] == name for c in pending):
-            attempts = sum(1 for ln in open(OUT)
-                           if json.loads(ln)["config"] == name)
+        if status != "ok":
+            attempts = sum(1 for e in load_entries()
+                           if e.get("config") == name)
             if attempts < 2:
                 pending.append((name, sub, env_over, deadline))
     log("queue drained")

@@ -166,3 +166,26 @@ def test_optimizer_sgd_runs():
     updates, _ = tx.update(grads, state, params)
     new_params = optax.apply_updates(params, updates)
     assert float(new_params["w"][0]) < 1.0
+
+
+def test_optimizer_agc_clips():
+    """agc: λ>0 wraps the optimizer in adaptive gradient clipping —
+    a huge gradient on a small weight must produce a bounded update
+    (the norm-free model companion; models/resnet.py norm="ws")."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from torchbooster_tpu.config import OptimizerConfig
+
+    params = {"w": jnp.full((4, 4), 0.1)}
+    grads = {"w": jnp.full((4, 4), 1e3)}
+
+    def upd(agc):
+        tx = OptimizerConfig(name="sgd", lr=1.0, agc=agc).make()
+        state = tx.init(params)
+        updates, _ = tx.update(grads, state, params)
+        return float(jnp.abs(updates["w"]).max())
+
+    clipped, unclipped = upd(0.01), upd(0.0)
+    assert unclipped == 1e3
+    assert clipped < 1.0, clipped

@@ -314,3 +314,85 @@ def test_gpt_gqa_trains_and_generates():
         GPT.init(jax.random.PRNGKey(0),
                  GPTConfig(vocab=8, n_layers=1, d_model=12, n_heads=3,
                            n_kv_heads=2, seq_len=8))
+
+
+def test_ws_kernel_standardization():
+    """Scaled WS: per-output-channel zero mean, 1/fan-in variance,
+    linear in the gain (models/resnet.py NF variant)."""
+    from torchbooster_tpu.models.resnet import _ws_kernel
+
+    k = jax.random.normal(jax.random.PRNGKey(0), (3, 3, 16, 32)) * 3 + 0.7
+    gain = jnp.ones((32,))
+    w = np.asarray(_ws_kernel(k, gain)).astype(np.float64)
+    flat = w.reshape(-1, 32)
+    np.testing.assert_allclose(flat.mean(0), 0.0, atol=1e-6)
+    np.testing.assert_allclose(flat.var(0) * flat.shape[0], 1.0,
+                               rtol=1e-3)
+    w2 = np.asarray(_ws_kernel(k, 2.5 * gain))
+    np.testing.assert_allclose(w2, 2.5 * w.astype(np.float32),
+                               rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("depth", [18, 50])
+def test_nf_resnet_forward_and_signal_propagation(depth):
+    """The norm-free variant runs on the unchanged param tree, and its
+    analytic variance tracking actually holds: with init params the
+    pre-head feature scale stays O(1) through all 4 stages (the whole
+    point of scaled WS + beta downscaling — no norm layers to rescue a
+    drifting signal)."""
+    params = ResNet.init(jax.random.PRNGKey(0), depth=depth,
+                         num_classes=10, stem="imagenet")
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 64, 64, 3))
+    logits = ResNet.apply(params, x, norm="ws")
+    assert logits.shape == (2, 10)
+    assert jnp.isfinite(logits).all()
+
+    # feature std just before pooling, via the head-input gradient
+    # trick: instead probe the pooled features directly
+    feats = ResNet.apply({k: v for k, v in params.items()
+                          if k != "head"} | {"head": {
+                              "kernel": jnp.eye(
+                                  params["head"]["kernel"].shape[0]),
+                              "bias": jnp.zeros(
+                                  params["head"]["kernel"].shape[0])}},
+                         x, norm="ws")
+    std = float(feats.std())
+    assert 0.1 < std < 10.0, f"signal scale drifted: std={std}"
+
+
+def test_nf_resnet_trains():
+    """A few SGD steps reduce the loss — the variant is trainable
+    without any activation norm."""
+    import optax
+
+    from torchbooster_tpu.ops.losses import cross_entropy
+    from torchbooster_tpu.utils import TrainState, make_step
+
+    params = ResNet.init(jax.random.PRNGKey(0), depth=18, num_classes=4,
+                         stem="cifar")
+    x = jax.random.normal(jax.random.PRNGKey(1), (16, 16, 16, 3))
+    y = jnp.arange(16) % 4
+
+    def loss_fn(p, batch, rng):
+        del rng
+        return cross_entropy(ResNet.apply(p, batch["x"], norm="ws"),
+                             batch["y"]), {}
+
+    tx = optax.sgd(0.05, momentum=0.9)
+    state = TrainState.create(params, tx)
+    step = make_step(loss_fn, tx)
+    losses = []
+    for _ in range(8):
+        state, m = step(state, {"x": x, "y": y})
+        losses.append(float(m["loss"]))
+    assert losses[-1] < losses[0] - 0.1, losses
+
+
+def test_nf_resnet_s2d_stem_matches_plain():
+    params = ResNet.init(jax.random.PRNGKey(2), depth=18, num_classes=10,
+                         stem="imagenet")
+    x = jax.random.normal(jax.random.PRNGKey(3), (2, 64, 64, 3))
+    plain = ResNet.apply(params, x, norm="ws")
+    s2d = ResNet.apply(params, x, norm="ws", stem_s2d=True)
+    np.testing.assert_allclose(np.asarray(s2d), np.asarray(plain),
+                               rtol=2e-4, atol=2e-4)

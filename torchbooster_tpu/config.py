@@ -426,6 +426,11 @@ class OptimizerConfig(BaseConfig):
     weight_decay: float = 0.0
     nesterov: bool = False
     amsgrad: bool = False              # parity field; optax adam has no amsgrad
+    # adaptive gradient clipping λ (0 = off): clips each unit's grad to
+    # λ·‖W‖ before the update — the published companion to norm-free
+    # models (models/resnet.py norm="ws"), whose sharper loss surface
+    # diverges under large adaptive LRs without it
+    agc: float = 0.0
 
     def make(self, schedule: Callable[[Any], Any] | None = None):
         """Return an ``optax.GradientTransformation``. When ``schedule``
@@ -466,6 +471,11 @@ class OptimizerConfig(BaseConfig):
         else:
             # ref config.py:438 raises NameError on unknown optimizer names
             raise NameError(f"unknown optimizer {self.name!r}")
+        if self.agc:
+            inner_factory = factory
+            factory = lambda learning_rate: optax.chain(
+                optax.adaptive_grad_clip(self.agc),
+                inner_factory(learning_rate))
         return optax.inject_hyperparams(factory)(learning_rate=lr)
 
 

@@ -182,7 +182,8 @@ class GPT:
                     sequence_attention)
 
                 return sequence_attention(q, k, v, mesh=mesh, causal=True,
-                                          strategy=cfg.sp_strategy), None
+                                          strategy=cfg.sp_strategy,
+                                          impl=attn_impl), None
             return attention(q, k, v, causal=True, impl=attn_impl), None
 
         def block(carry: tuple, bp: dict) -> tuple[tuple, None]:

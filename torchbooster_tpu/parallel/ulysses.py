@@ -89,14 +89,16 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
 
 def sequence_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
                        causal: bool = True, sm_scale: float | None = None,
-                       axis: str = "sp",
-                       strategy: str = "auto") -> jax.Array:
+                       axis: str = "sp", strategy: str = "auto",
+                       impl: str = "auto") -> jax.Array:
     """One front door for sequence-parallel attention.
 
     ``strategy``: "ring", "ulysses", or "auto" (all-to-all whenever the
     head count divides — it is never slower on TPU meshes where both
     apply, and unlocks the flash kernel; ring is the fallback that
-    always works).
+    always works). ``impl`` feeds the all-to-all path's local attention
+    dispatch; the ring is online-softmax by construction and has no
+    kernel choice to make.
     """
     from torchbooster_tpu.parallel.ring import ring_attention
 
@@ -106,7 +108,7 @@ def sequence_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
         strategy = "ulysses" if divides else "ring"
     if strategy == "ulysses":
         return ulysses_attention(q, k, v, mesh, causal=causal,
-                                 sm_scale=sm_scale, axis=axis)
+                                 sm_scale=sm_scale, axis=axis, impl=impl)
     if strategy == "ring":
         return ring_attention(q, k, v, mesh, causal=causal,
                               sm_scale=sm_scale, axis=axis)
