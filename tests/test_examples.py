@@ -190,8 +190,12 @@ def test_gpt_single_vs_4d_mesh(monkeypatch):
 
     conf.env.distributed = True
     conf.env.mesh = "dp:1,fsdp:2,tp:2,sp:2"
-    sharded = gpt.main(conf)
+    sharded = gpt.main(conf)     # sp_strategy "auto" → ulysses (4/2 % 2 == 0)
     assert abs(single["loss"] - sharded["loss"]) < 1e-2
+
+    conf.model.sp_strategy = "ring"   # the other SP strategy, same YAML knob
+    ringed = gpt.main(conf)
+    assert abs(single["loss"] - ringed["loss"]) < 1e-2
 
 
 def test_gpt_moe_expert_parallel(monkeypatch):
