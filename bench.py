@@ -123,21 +123,28 @@ def bench_gpt(steps: int) -> tuple[float, float]:
 def _gpt_loss_fn(cfg):
     """BENCH_GPT_CHUNKED=1: stream tokens through the LM head in chunks
     (losses.lm_head_cross_entropy) so the (T, vocab) logits are never a
-    live activation — the A/B knob for the head-memory experiment."""
+    live activation — the A/B knob for the head-memory experiment.
+    BENCH_GPT_REMAT=0: disable activation rematerialization — at short
+    S the saved recompute may beat the saved HBM (the r2 ResNet
+    full-remat ablation measured −23%; untested for GPT)."""
     from torchbooster_tpu.models.gpt import GPT
     from torchbooster_tpu.ops.losses import lm_head_cross_entropy
+
+    remat = os.environ.get("BENCH_GPT_REMAT", "1").strip() not in (
+        "0", "false", "no")
 
     if env_flag("BENCH_GPT_CHUNKED"):
         def loss_fn(p, b, rng):
             del rng
-            hidden = GPT.apply(p, b["ids"], cfg, return_hidden=True)
+            hidden = GPT.apply(p, b["ids"], cfg, remat=remat,
+                               return_hidden=True)
             return lm_head_cross_entropy(
                 hidden[:, :-1], GPT.head_table(p), b["ids"][:, 1:]), {}
         return loss_fn
 
     def loss_fn(p, b, rng):
         del rng
-        logits = GPT.apply(p, b["ids"], cfg)
+        logits = GPT.apply(p, b["ids"], cfg, remat=remat)
         return cross_entropy(logits[:, :-1].reshape(-1, cfg.vocab),
                              b["ids"][:, 1:].reshape(-1)), {}
     return loss_fn
