@@ -194,6 +194,13 @@ def main(conf: Config) -> dict:
         results["sample"] = np.asarray(sampled)[0].tolist()
         if dist.is_primary():
             print("sample:", results["sample"])
+            if cfg.vocab == 256:
+                # byte-level corpus (dataset name: text_file) — the ids
+                # ARE utf-8 bytes, show the text
+                from torchbooster_tpu.data import ByteTokenizer
+
+                print("sample text:", repr(
+                    ByteTokenizer().decode(results["sample"])))
     if dist.is_primary():
         print({k: round(v, 4) if isinstance(v, float) else v
                for k, v in results.items()})

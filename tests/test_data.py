@@ -496,3 +496,52 @@ def test_augment_streams_distinct_across_processes(monkeypatch):
     b = T.Augment(5, [T.pad_crop(16, 4)])
     out_b = b(img)
     assert not np.array_equal(out_a, out_b)
+
+
+def test_byte_tokenizer_roundtrip():
+    from torchbooster_tpu.data import ByteTokenizer
+
+    tok = ByteTokenizer()
+    text = "héllo wörld — test 日本語"
+    ids = tok.encode(text)
+    assert ids.dtype == np.int32 and ids.min() >= 0 and ids.max() < 256
+    assert tok.decode(ids) == text
+    # a cut INSIDE 語's 3-byte utf-8 sequence must not raise (model
+    # samples split codepoints freely)
+    assert tok.decode(ids[:-1]).endswith("�")
+
+
+def test_text_file_dataset(tmp_path):
+    """text_file source: byte windows, positional 90/10 split, loud
+    failures on bad vocab / short corpora (data/sources.py)."""
+    from torchbooster_tpu.dataset import Split
+
+    corpus = "abcdefghij" * 200                    # 2000 bytes
+    path = tmp_path / "corpus.txt"
+    path.write_text(corpus)
+    conf = DatasetConfig(name="text_file", root=str(path))
+
+    train = conf.make(Split.TRAIN, seq_len=50)
+    val = conf.make(Split.VALIDATION, seq_len=50)
+    test = conf.make(Split.TEST, seq_len=50)
+    assert len(train) == 1800 // 50
+    assert len(val) == 2 and len(test) == 2
+    row = np.asarray(train[0])
+    assert row.shape == (50,)
+    assert bytes(row.astype(np.uint8)).decode() == corpus[:50]
+    # validation and test are DISJOINT held-out slices
+    assert bytes(np.asarray(val[0]).astype(np.uint8)) \
+        == corpus.encode()[1800:1850]
+    assert bytes(np.asarray(test[0]).astype(np.uint8)) \
+        == corpus.encode()[1900:1950]
+    # overlapping windows via stride
+    dense = conf.make(Split.TRAIN, seq_len=50, stride=10)
+    assert len(dense) == (1800 - 50) // 10 + 1
+
+    with pytest.raises(ValueError, match="vocab"):
+        conf.make(Split.TRAIN, seq_len=50, vocab=128)
+    with pytest.raises(ValueError, match="seq_len"):
+        conf.make(Split.TEST, seq_len=512)
+    with pytest.raises(FileNotFoundError):
+        DatasetConfig(name="text_file", root=str(tmp_path / "nope.txt")) \
+            .make(Split.TRAIN, seq_len=10)

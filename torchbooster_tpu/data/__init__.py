@@ -8,10 +8,11 @@ from torchbooster_tpu.data.pipeline import (
     prefetch_to_device,
 )
 from torchbooster_tpu.data.sources import register_dataset, resolve_dataset
+from torchbooster_tpu.data.tokenizer import ByteTokenizer
 from torchbooster_tpu.data.transforms import Augment
 
 __all__ = [
-    "Augment", "DataLoader", "ShardedIterable", "SizedIterable",
-    "default_collate", "prefetch_to_device", "register_dataset",
-    "resolve_dataset",
+    "Augment", "ByteTokenizer", "DataLoader", "ShardedIterable",
+    "SizedIterable", "default_collate", "prefetch_to_device",
+    "register_dataset", "resolve_dataset",
 ]

@@ -126,6 +126,42 @@ def _synthetic_images(conf: Any, split: Split, size: int = 256,
     return ProceduralImages(n, size, seed=seed, palette=palette)
 
 
+@register_dataset("text_file")
+def _text_file(conf: Any, split: Split, seq_len: int = 256,
+               stride: int = 0, **kw):
+    """Byte-level LM corpus from a local text file: ``root:`` points at
+    the file; UTF-8 bytes are the tokens (vocab 256 —
+    data/tokenizer.ByteTokenizer decodes samples back to text). The
+    zero-egress answer to the reference's torchtext/HF text resolution
+    for local corpora. Positional 90/5/5 train/validation/test split
+    (disjoint held-out sets); windows of ``seq_len`` every ``stride``
+    (default: non-overlapping)."""
+    from torchbooster_tpu.data.tokenizer import ByteTokenizer
+
+    vocab = kw.get("vocab", 0)
+    if vocab and vocab < 256:
+        raise ValueError(
+            f"text_file dataset emits byte tokens 0..255; model vocab "
+            f"{vocab} < 256 would index out of range")
+    path = Path(conf.root)
+    if not path.is_file():
+        raise FileNotFoundError(
+            f"text_file dataset: root={conf.root!r} is not a file")
+    raw = ByteTokenizer().encode(path.read_bytes())
+    cut1, cut2 = int(len(raw) * 0.90), int(len(raw) * 0.95)
+    data = {Split.TRAIN: raw[:cut1],
+            Split.VALIDATION: raw[cut1:cut2],
+            Split.TEST: raw[cut2:]}[split]
+    stride = stride or seq_len
+    if len(data) < seq_len:
+        raise ValueError(
+            f"text_file dataset: split {split.value!r} has {len(data)} "
+            f"tokens < seq_len={seq_len}")
+    windows = np.lib.stride_tricks.sliding_window_view(
+        data, seq_len)[::stride].copy()
+    return ArrayDataset(windows)
+
+
 @register_dataset("synthetic_lm")
 def _synthetic_lm(conf: Any, split: Split, seq_len: int = 256,
                   vocab: int = 1_024, **kw):

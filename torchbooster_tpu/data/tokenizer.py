@@ -1,0 +1,36 @@
+"""Tokenizers for the LM path.
+
+The reference resolves text corpora through torchtext/HuggingFace (ref
+config.py:541-617); in a zero-egress environment the always-available
+equivalent is byte-level modeling: UTF-8 bytes ARE the token stream
+(vocab 256, no files to download, lossless round-trip). This is the
+tokenizer behind the ``text_file`` dataset source (data/sources.py) and
+the human-readable decode of ``GPT.generate`` samples.
+
+For subword vocabularies, any HuggingFace ``transformers`` tokenizer
+already produces the ``(T,)`` int arrays the pipeline consumes — pass
+its output straight to ``ArrayDataset``; no adapter is needed (that
+path needs network/cache access this image does not have, so it is
+deliberately not wrapped here).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+class ByteTokenizer:
+    """UTF-8 byte-level tokenizer: 256-way vocab, exact round-trip."""
+
+    vocab_size = 256
+
+    def encode(self, text: str | bytes) -> np.ndarray:
+        data = text.encode("utf-8") if isinstance(text, str) else bytes(text)
+        return np.frombuffer(data, np.uint8).astype(np.int32)
+
+    def decode(self, ids) -> str:
+        arr = np.asarray(ids).astype(np.uint8)
+        # model samples may split multi-byte codepoints; never raise
+        return arr.tobytes().decode("utf-8", errors="replace")
+
+
+__all__ = ["ByteTokenizer"]
