@@ -341,29 +341,53 @@ def _first_json_line(text: str) -> str | None:
                 None)
 
 
+def _run_group(cmd: list, deadline: int, env: dict | None = None):
+    """Run ``cmd`` in its OWN SESSION under a hard deadline and, on
+    expiry, SIGKILL the whole process group. ``subprocess.run(timeout=)``
+    is not enough here: a wedged-tunnel child forks helpers that
+    survive the direct kill and hold the output pipes open — observed
+    wedging the watcher for 25 min past its 150 s probe deadline.
+    Returns (stdout, stderr, returncode); rc is None on timeout."""
+    import signal
+    import subprocess
+
+    proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE, text=True, env=env,
+                            start_new_session=True)
+    try:
+        out, err = proc.communicate(timeout=deadline)
+        return out, err, proc.returncode
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except ProcessLookupError:  # pragma: no cover - already gone
+            pass
+        try:
+            out, err = proc.communicate(timeout=10)
+        except Exception:  # noqa: BLE001 - pipes may never close
+            out = err = ""
+        return out, err, None
+
+
 def _run_sub(name: str, deadline: int) -> dict | None:
     """Run ONE sub-bench in a child interpreter under a hard deadline.
 
     The tunneled chip drops mid-round (twice this round, hours each);
     an in-process hang at any device call would wedge the driver's
-    end-of-round bench with NOTHING recorded. A child process bounds
-    the blast radius of a drop (or a pathological kernel) to one
-    metric: on deadline we kill it and carry on."""
-    import subprocess
-
-    try:
-        r = subprocess.run(
-            [sys.executable, os.path.abspath(__file__), "--sub", name],
-            timeout=deadline, capture_output=True, text=True)
-    except subprocess.TimeoutExpired:
+    end-of-round bench with NOTHING recorded. A child process GROUP
+    bounds the blast radius of a drop (or a pathological kernel) to
+    one metric: on deadline the whole group dies and we carry on."""
+    out, err, rc = _run_group(
+        [sys.executable, os.path.abspath(__file__), "--sub", name],
+        deadline)
+    if rc is None:
         print(f"sub-bench {name}: no result within {deadline}s (tunnel "
               "drop or kernel hang); skipped", file=sys.stderr)
         return None
-    sys.stderr.write(r.stderr)
-    line = _first_json_line(r.stdout)
-    if r.returncode != 0 or line is None:
-        print(f"sub-bench {name}: failed (rc={r.returncode})",
-              file=sys.stderr)
+    sys.stderr.write(err)
+    line = _first_json_line(out)
+    if rc != 0 or line is None:
+        print(f"sub-bench {name}: failed (rc={rc})", file=sys.stderr)
         return None
     return json.loads(line)
 
@@ -407,20 +431,14 @@ def _probe_tpu(timeout: int = 180) -> str:
     matmul + D2H succeeded on an accelerator), "cpu" (jax resolved to
     the host platform — a box without the TPU plugin), or "down"
     (anything else: a wedged tunnel hangs inside backend init and only
-    a kill gets an answer)."""
-    import subprocess
-
+    a process-group kill gets an answer)."""
     probe = ("import jax, jax.numpy as jnp, numpy as np;"
              "print('BACKEND', jax.default_backend());"
              "x = jnp.ones((512, 512), jnp.bfloat16); np.asarray(x @ x)")
-    try:
-        r = subprocess.run([sys.executable, "-c", probe], timeout=timeout,
-                           capture_output=True, text=True)
-    except subprocess.TimeoutExpired:
+    out, _, rc = _run_group([sys.executable, "-c", probe], timeout)
+    if rc != 0:   # None (timeout) or error
         return "down"
-    if r.returncode != 0:
-        return "down"
-    return "cpu" if "BACKEND cpu" in r.stdout else "tpu"
+    return "cpu" if "BACKEND cpu" in out else "tpu"
 
 
 def _deadline(name: str, default: int) -> int:

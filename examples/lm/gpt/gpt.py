@@ -57,13 +57,14 @@ class ModelConfig(BaseConfig):
     aux_weight: float = 1e-2        # load-balance loss weight
     # sequence-parallel attention on sp>1 meshes: auto | ring | ulysses
     sp_strategy: str = "auto"
+    pos: str = "learned"            # position encoding: learned | rope
 
     def make(self) -> GPTConfig:
         return GPTConfig(vocab=self.vocab, n_layers=self.n_layers,
                          d_model=self.d_model, n_heads=self.n_heads,
                          n_kv_heads=self.n_kv_heads,
                          seq_len=self.seq_len, n_experts=self.n_experts,
-                         sp_strategy=self.sp_strategy)
+                         sp_strategy=self.sp_strategy, pos=self.pos)
 
 
 @dataclass

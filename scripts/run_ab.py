@@ -21,7 +21,6 @@ from __future__ import annotations
 
 import json
 import os
-import subprocess
 import sys
 import time
 
@@ -29,7 +28,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 OUT = os.path.join(REPO, "logs", "ab_results.jsonl")
 
 sys.path.insert(0, REPO)
-from bench import _first_json_line, _probe_tpu  # noqa: E402
+from bench import _first_json_line, _probe_tpu, _run_group  # noqa: E402
 
 # name -> (sub-bench, env overrides, deadline seconds). Deadlines are
 # generous: first-compile on the tunnel is slow, and the pallas paths
@@ -82,21 +81,20 @@ def run_config(name: str, sub: str, env_over: dict, deadline: int) -> str:
            # a flaky tunnel window still fits a full config
            "BENCH_STEPS": os.environ.get("AB_STEPS", "12")}
     t0 = time.time()
-    try:
-        r = subprocess.run([sys.executable, "bench.py", "--sub", sub],
-                           timeout=deadline, capture_output=True,
-                           text=True, cwd=REPO, env=env)
-    except subprocess.TimeoutExpired:
+    out, err, rc = _run_group(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--sub", sub],
+        deadline, env=env)
+    if rc is None:
         record({"config": name, "status": "timeout", "seconds": deadline})
         return "timeout"
-    line = _first_json_line(r.stdout)
-    if r.returncode == 0 and line:
+    line = _first_json_line(out)
+    if rc == 0 and line:
         record({"config": name, "status": "ok",
                 "seconds": round(time.time() - t0, 1),
                 "result": json.loads(line)})
         return "ok"
-    record({"config": name, "status": "error", "rc": r.returncode,
-            "stderr": r.stderr[-2000:]})
+    record({"config": name, "status": "error", "rc": rc,
+            "stderr": err[-2000:]})
     return "error"
 
 

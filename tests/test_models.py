@@ -396,3 +396,97 @@ def test_nf_resnet_s2d_stem_matches_plain():
     s2d = ResNet.apply(params, x, norm="ws", stem_s2d=True)
     np.testing.assert_allclose(np.asarray(s2d), np.asarray(plain),
                                rtol=2e-4, atol=2e-4)
+
+
+def test_rope_shift_invariance():
+    """Rotary scores depend only on RELATIVE distance: shifting every
+    position by a constant leaves q·k unchanged (models/gpt._rope)."""
+    from torchbooster_tpu.models.gpt import _rope
+
+    q = jax.random.normal(jax.random.PRNGKey(0), (1, 6, 2, 16))
+    k = jax.random.normal(jax.random.PRNGKey(1), (1, 6, 2, 16))
+    pos = jnp.arange(6)
+
+    def scores(shift):
+        qr = _rope(q, pos + shift)
+        kr = _rope(k, pos + shift)
+        return jnp.einsum("bqhd,bkhd->bhqk", qr, kr)
+
+    np.testing.assert_allclose(np.asarray(scores(0)),
+                               np.asarray(scores(37)),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_gpt_rope_trains_and_decodes():
+    """pos="rope": no wpe table, training works, and KV-cache greedy
+    decode still matches the full forward — pins the rotate-before-
+    cache convention across prefill/decode/apply."""
+    import optax
+
+    from torchbooster_tpu.models.gpt import GPT, GPTConfig
+    from torchbooster_tpu.ops.losses import cross_entropy
+    from torchbooster_tpu.utils import TrainState, make_step
+
+    cfg = GPTConfig(vocab=97, n_layers=2, d_model=32, n_heads=4,
+                    seq_len=24, pos="rope")
+    params = GPT.init(jax.random.PRNGKey(0), cfg)
+    assert "wpe" not in params
+
+    ids = jax.random.randint(jax.random.PRNGKey(1), (4, cfg.seq_len),
+                             0, cfg.vocab)
+
+    def loss_fn(p, b, rng):
+        del rng
+        logits = GPT.apply(p, b["ids"], cfg, compute_dtype=jnp.float32)
+        return cross_entropy(logits[:, :-1].reshape(-1, cfg.vocab),
+                             b["ids"][:, 1:].reshape(-1)), {}
+
+    tx = optax.adamw(1e-2)
+    state = TrainState.create(params, tx)
+    step = make_step(loss_fn, tx)
+    losses = []
+    for _ in range(6):
+        state, m = step(state, {"ids": ids})
+        losses.append(float(m["loss"]))
+    assert losses[-1] < losses[0]
+
+    prompt = ids[:2, :5]
+    got = GPT.generate(state.params, prompt, cfg, n_new=5,
+                       temperature=0.0, compute_dtype=jnp.float32)
+    cur = prompt
+    for _ in range(5):
+        logits = GPT.apply(state.params, cur, cfg,
+                           compute_dtype=jnp.float32, remat=False)
+        nxt = jnp.argmax(logits[:, -1], axis=-1).astype(cur.dtype)
+        cur = jnp.concatenate([cur, nxt[:, None]], axis=1)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(cur))
+
+
+def test_gpt_rope_sequence_parallel_matches_single():
+    """rope rotation happens on the global (sharded) q/k BEFORE the
+    sp attention, so a dp:2,sp:4 mesh forward must equal the
+    single-device forward."""
+    from torchbooster_tpu.distributed import make_mesh
+    from torchbooster_tpu.models.gpt import GPT, GPTConfig
+
+    cfg = GPTConfig(vocab=64, n_layers=2, d_model=32, n_heads=4,
+                    seq_len=32, pos="rope")
+    params = GPT.init(jax.random.PRNGKey(0), cfg)
+    ids = jax.random.randint(jax.random.PRNGKey(1), (2, cfg.seq_len),
+                             0, cfg.vocab)
+    single = GPT.apply(params, ids, cfg, compute_dtype=jnp.float32)
+    mesh = make_mesh("dp:2,sp:4")
+    with mesh:
+        sharded = GPT.apply(params, ids, cfg, mesh=mesh,
+                            compute_dtype=jnp.float32)
+    np.testing.assert_allclose(np.asarray(sharded), np.asarray(single),
+                               rtol=2e-3, atol=2e-3)
+
+
+def test_gpt_pos_validated():
+    from torchbooster_tpu.models.gpt import GPT, GPTConfig
+
+    with pytest.raises(ValueError, match="pos"):
+        GPT.init(jax.random.PRNGKey(0),
+                 GPTConfig(vocab=16, n_layers=1, d_model=16, n_heads=2,
+                           seq_len=8, pos="rotary"))

@@ -54,6 +54,12 @@ class GPTConfig:
     # "ulysses" (all-to-all head resharding, flash-capable), or "auto"
     # (ulysses when heads divide, else ring — parallel/ulysses.py)
     sp_strategy: str = "auto"
+    # position encoding: "learned" (GPT-2 wpe table) or "rope" (rotary
+    # — relative attention, no table; q/k rotate by absolute position
+    # before every attention flavor, so flash/ring/ulysses/KV-cache
+    # paths are unchanged)
+    pos: str = "learned"
+    rope_base: float = 10_000.0
 
     @property
     def kv_heads(self) -> int:
@@ -132,6 +138,10 @@ class GPT:
             raise ValueError(
                 f"n_heads={cfg.n_heads} not divisible by "
                 f"n_kv_heads={cfg.kv_heads}")
+        if cfg.pos not in ("learned", "rope"):
+            # a typo'd "rotary" must not silently train learned positions
+            raise ValueError(f"unknown pos {cfg.pos!r}; use 'learned' "
+                             f"or 'rope'")
         k_wte, k_wpe, k_blocks, k_head = jax.random.split(rng, 4)
         blocks = jax.vmap(
             lambda k: _block_init(k, cfg, dtype)
@@ -139,11 +149,13 @@ class GPT:
         params = {
             "wte": L.embedding_init(k_wte, cfg.vocab, cfg.d_model,
                                     dtype=dtype),
-            "wpe": L.embedding_init(k_wpe, cfg.seq_len, cfg.d_model,
-                                    std=0.01, dtype=dtype),
             "blocks": blocks,
             "ln_f": L.norm_init(cfg.d_model, dtype),
         }
+        if cfg.pos != "rope":   # rope has no position table
+            params["wpe"] = L.embedding_init(k_wpe, cfg.seq_len,
+                                             cfg.d_model, std=0.01,
+                                             dtype=dtype)
         if not cfg.tie_embeddings:
             params["head"] = L.dense_init(k_head, cfg.d_model, cfg.vocab,
                                           use_bias=False, std=0.02,
@@ -168,8 +180,9 @@ class GPT:
         constrain = _make_constrainer(mesh)
 
         x = L.embedding(params["wte"], ids, dtype=compute_dtype)
-        x = x + L.embedding(params["wpe"], jnp.arange(s),
-                            dtype=compute_dtype)
+        if "wpe" in params:
+            x = x + L.embedding(params["wpe"], jnp.arange(s),
+                                dtype=compute_dtype)
         x = constrain(x)
 
         use_sp = (mesh is not None and "sp" in mesh.axis_names
@@ -230,15 +243,35 @@ def _expand_kv(kv: jax.Array, cfg: GPTConfig) -> jax.Array:
     return kv if rep == 1 else jnp.repeat(kv, rep, axis=2)
 
 
+def _rope(x: jax.Array, positions: jax.Array,
+          base: float = 10_000.0) -> jax.Array:
+    """Rotary position embedding (rotate-half form) over (B, S, H, D);
+    ``positions`` is (S,) absolute indices. Angles in fp32 — bf16
+    position·frequency products alias at long context."""
+    half = x.shape[-1] // 2
+    freqs = base ** (-jnp.arange(half, dtype=jnp.float32) / half)
+    angles = positions.astype(jnp.float32)[:, None] * freqs   # (S, half)
+    cos = jnp.cos(angles)[None, :, None, :]
+    sin = jnp.sin(angles)[None, :, None, :]
+    x1 = x[..., :half].astype(jnp.float32)
+    x2 = x[..., half:].astype(jnp.float32)
+    return jnp.concatenate(
+        [x1 * cos - x2 * sin, x1 * sin + x2 * cos], -1).astype(x.dtype)
+
+
 def _block_core(bp: dict, x: jax.Array, cfg: GPTConfig, attend,
                 constrain=lambda x: x,
-                capacity_factor: float | None = None
+                capacity_factor: float | None = None,
+                positions: jax.Array | None = None
                 ) -> tuple[jax.Array, jax.Array, Any]:
     """The transformer block math, shared by every path (training
     forward, prefill, cached decode) so they cannot drift apart.
     ``attend(q, k, v) -> (o, extras)`` supplies the attention flavor;
     ``extras`` passes through (K/V for prefill, updated caches for
-    decode). Returns (x, aux_loss, extras)."""
+    decode). ``positions``: absolute token indices (default
+    ``arange(s)``) — consumed only by rope, BEFORE ``attend``, so
+    rotated K flows into caches/rings/all-to-alls uniformly.
+    Returns (x, aux_loss, extras)."""
     b, s, d = x.shape
     n_heads, kv_heads = cfg.n_heads, cfg.kv_heads
     head_dim = d // n_heads
@@ -250,6 +283,11 @@ def _block_core(bp: dict, x: jax.Array, cfg: GPTConfig, attend,
     kv_dim = kv_heads * head_dim
     k = qkv[..., d:d + kv_dim].reshape(b, s, kv_heads, head_dim)
     v = qkv[..., d + kv_dim:].reshape(b, s, kv_heads, head_dim)
+    if cfg.pos == "rope":
+        if positions is None:
+            positions = jnp.arange(s)
+        q = _rope(q, positions, cfg.rope_base)
+        k = _rope(k, positions, cfg.rope_base)
     o, extras = attend(q, k, v)
     x = constrain(x + L.dense(bp["attn_proj"], o.reshape(b, s, d)))
     h = L.layer_norm(bp["ln2"], x)
@@ -299,8 +337,9 @@ def _cached_block(bp: dict, x: jax.Array, cache_k: jax.Array,
 
     x, _, (cache_k, cache_v) = _block_core(
         bp, x, cfg, attend,
-        capacity_factor=max(cfg.capacity_factor, float(cfg.n_experts)))
-    return x, cache_k, cache_v
+        capacity_factor=max(cfg.capacity_factor, float(cfg.n_experts)),
+        positions=jnp.asarray(pos)[None])   # rope rotates this token's
+    return x, cache_k, cache_v              # q/k at its absolute index
 
 
 def _lm_head(params: dict, x: jax.Array) -> jax.Array:
@@ -344,7 +383,9 @@ def generate(params: dict, ids: jax.Array,
 
     # --- prefill: full prompt forward, K/V collected per layer ---
     x = L.embedding(params["wte"], ids, dtype=compute_dtype)
-    x = x + L.embedding(params["wpe"], jnp.arange(s0), dtype=compute_dtype)
+    if "wpe" in params:
+        x = x + L.embedding(params["wpe"], jnp.arange(s0),
+                            dtype=compute_dtype)
 
     def prefill_block(x, bp):
         def attend(q, k, v):
@@ -378,8 +419,9 @@ def generate(params: dict, ids: jax.Array,
         rng, sub = jax.random.split(rng)
         x = L.embedding(params["wte"], last_id[:, None],
                         dtype=compute_dtype)
-        x = x + L.embedding(params["wpe"], pos[None],
-                            dtype=compute_dtype)
+        if "wpe" in params:
+            x = x + L.embedding(params["wpe"], pos[None],
+                                dtype=compute_dtype)
 
         def layer(x, inputs):
             bp, ck, cv = inputs
