@@ -58,13 +58,15 @@ class ModelConfig(BaseConfig):
     # sequence-parallel attention on sp>1 meshes: auto | ring | ulysses
     sp_strategy: str = "auto"
     pos: str = "learned"            # position encoding: learned | rope
+    mlp: str = "gelu"               # MLP flavor: gelu | swiglu
 
     def make(self) -> GPTConfig:
         return GPTConfig(vocab=self.vocab, n_layers=self.n_layers,
                          d_model=self.d_model, n_heads=self.n_heads,
                          n_kv_heads=self.n_kv_heads,
                          seq_len=self.seq_len, n_experts=self.n_experts,
-                         sp_strategy=self.sp_strategy, pos=self.pos)
+                         sp_strategy=self.sp_strategy, pos=self.pos,
+                         mlp=self.mlp)
 
 
 @dataclass
@@ -85,6 +87,7 @@ class Config(BaseConfig):
     dataset: DatasetConfig
 
     sample_tokens: int = 0          # > 0: KV-cache sample after training
+    sample_top_p: float = 0.0       # > 0: nucleus filter for sampling
     sample_temperature: float = 0.8
 
 
@@ -191,7 +194,8 @@ def main(conf: Config) -> dict:
         prompt = np.asarray(tokens)[:1, :8].astype(np.int32)
         sampled = GPT.generate(
             state.params, prompt, cfg, n_new=conf.sample_tokens,
-            rng=state.rng, temperature=conf.sample_temperature, top_k=50)
+            rng=state.rng, temperature=conf.sample_temperature, top_k=50,
+            top_p=conf.sample_top_p or None)
         results["sample"] = np.asarray(sampled)[0].tolist()
         if dist.is_primary():
             print("sample:", results["sample"])

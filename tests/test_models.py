@@ -490,3 +490,104 @@ def test_gpt_pos_validated():
         GPT.init(jax.random.PRNGKey(0),
                  GPTConfig(vocab=16, n_layers=1, d_model=16, n_heads=2,
                            seq_len=8, pos="rotary"))
+
+
+def test_gpt_swiglu_trains_and_shards():
+    """mlp="swiglu": gated MLP (separate fc1/fc3 so tp shards cleanly),
+    param count ≈ the gelu MLP's, trains, and a tp mesh matches the
+    single-device forward."""
+    import optax
+
+    from torchbooster_tpu.distributed import make_mesh
+    from torchbooster_tpu.models.gpt import GPT, GPTConfig
+    from torchbooster_tpu.ops.losses import cross_entropy
+    from torchbooster_tpu.utils import TrainState, make_step
+
+    cfg = GPTConfig(vocab=64, n_layers=2, d_model=48, n_heads=4,
+                    seq_len=32, mlp="swiglu")
+    params = GPT.init(jax.random.PRNGKey(0), cfg)
+    assert "mlp_fc3" in params["blocks"]
+
+    base = GPT.init(jax.random.PRNGKey(0),
+                    GPTConfig(vocab=64, n_layers=2, d_model=48, n_heads=4,
+                              seq_len=32))
+    n = lambda p: sum(x.size for x in jax.tree.leaves(p))
+    assert abs(n(params) - n(base)) / n(base) < 0.05
+
+    ids = jax.random.randint(jax.random.PRNGKey(1), (4, cfg.seq_len),
+                             0, cfg.vocab)
+
+    # forward parity BEFORE training: make_step donates params
+    single = GPT.apply(params, ids, cfg, compute_dtype=jnp.float32)
+    mesh = make_mesh("dp:2,tp:4")
+    with mesh:
+        sharded = GPT.apply(params, ids, cfg, mesh=mesh,
+                            compute_dtype=jnp.float32)
+    np.testing.assert_allclose(np.asarray(sharded), np.asarray(single),
+                               rtol=2e-3, atol=2e-3)
+
+    def loss_fn(p, b, rng):
+        del rng
+        logits = GPT.apply(p, b["ids"], cfg, compute_dtype=jnp.float32)
+        return cross_entropy(logits[:, :-1].reshape(-1, cfg.vocab),
+                             b["ids"][:, 1:].reshape(-1)), {}
+
+    tx = optax.adamw(1e-2)
+    state = TrainState.create(params, tx)
+    step = make_step(loss_fn, tx)
+    losses = []
+    for _ in range(6):
+        state, m = step(state, {"ids": ids})
+        losses.append(float(m["loss"]))
+    assert losses[-1] < losses[0]
+
+    with pytest.raises(ValueError, match="mlp"):
+        GPT.init(jax.random.PRNGKey(0),
+                 GPTConfig(vocab=16, n_layers=1, d_model=16, n_heads=2,
+                           seq_len=8, mlp="geglu"))
+
+
+def test_gpt_generate_top_p():
+    """Nucleus sampling: top_p→0 degenerates to greedy; top_p=1 keeps
+    the full distribution (same draw as unfiltered sampling)."""
+    from torchbooster_tpu.models.gpt import GPT, GPTConfig
+
+    cfg = GPTConfig(vocab=97, n_layers=2, d_model=32, n_heads=4,
+                    seq_len=24)
+    params = GPT.init(jax.random.PRNGKey(0), cfg)
+    ids = jax.random.randint(jax.random.PRNGKey(1), (2, 5), 0, cfg.vocab)
+    rng = jax.random.PRNGKey(7)
+
+    greedy = GPT.generate(params, ids, cfg, n_new=6, temperature=0.0,
+                          compute_dtype=jnp.float32)
+    tiny_p = GPT.generate(params, ids, cfg, n_new=6, temperature=1.0,
+                          rng=rng, top_p=1e-9, compute_dtype=jnp.float32)
+    np.testing.assert_array_equal(np.asarray(greedy), np.asarray(tiny_p))
+
+    full_p = GPT.generate(params, ids, cfg, n_new=6, temperature=1.0,
+                          rng=rng, top_p=1.0, compute_dtype=jnp.float32)
+    plain = GPT.generate(params, ids, cfg, n_new=6, temperature=1.0,
+                         rng=rng, compute_dtype=jnp.float32)
+    np.testing.assert_array_equal(np.asarray(full_p), np.asarray(plain))
+
+
+def test_gpt_pos_checkpoint_mismatch_is_loud():
+    """A rope checkpoint run under pos="learned" (or the reverse) must
+    raise, not silently run position-free."""
+    from torchbooster_tpu.models.gpt import GPT, GPTConfig
+
+    rope_cfg = GPTConfig(vocab=32, n_layers=1, d_model=16, n_heads=2,
+                         seq_len=8, pos="rope")
+    learned_cfg = GPTConfig(vocab=32, n_layers=1, d_model=16, n_heads=2,
+                            seq_len=8)
+    rope_params = GPT.init(jax.random.PRNGKey(0), rope_cfg)
+    learned_params = GPT.init(jax.random.PRNGKey(0), learned_cfg)
+    ids = jnp.zeros((1, 4), jnp.int32)
+    with pytest.raises(ValueError, match="wpe"):
+        GPT.apply(rope_params, ids, learned_cfg)
+    with pytest.raises(ValueError, match="wpe"):
+        GPT.apply(learned_params, ids, rope_cfg)
+    with pytest.raises(ValueError, match="top_p"):
+        GPT.generate(learned_params, ids, learned_cfg, n_new=2,
+                     temperature=1.0, rng=jax.random.PRNGKey(0),
+                     top_p=0.0)

@@ -60,6 +60,9 @@ class GPTConfig:
     # paths are unchanged)
     pos: str = "learned"
     rope_base: float = 10_000.0
+    # MLP flavor: "gelu" (GPT-2) or "swiglu" (gated, hidden 2/3·ratio·d
+    # so params match); MoE blocks (n_experts>0) keep their own experts
+    mlp: str = "gelu"
 
     @property
     def kv_heads(self) -> int:
@@ -82,6 +85,8 @@ SHARDING_RULES = [
     (r"attn_proj/kernel", P(None, "tp", "fsdp")),
     (r"mlp_fc1/kernel", P(None, "fsdp", "tp")),
     (r"mlp_fc1/bias", P(None, "tp")),
+    (r"mlp_fc3/kernel", P(None, "fsdp", "tp")),
+    (r"mlp_fc3/bias", P(None, "tp")),
     (r"mlp_fc2/kernel", P(None, "tp", "fsdp")),
     (r"head/kernel", P("fsdp", "tp")),
     # MoE blocks: experts over ep, hidden over tp (models/moe.py)
@@ -116,6 +121,21 @@ def _block_init(rng: jax.Array, cfg: GPTConfig, dtype: Any) -> dict:
 
         block.update(moe_init(ks[2], cfg.n_experts, d, h, std=0.02,
                               out_std=res_std, dtype=dtype))
+    elif cfg.mlp == "swiglu":
+        # gate (fc1) and value (fc3) as separate params so each shards
+        # cleanly over tp (an interleaved (d, 2h) kernel would slice
+        # across the sharded dim); hidden 2/3·(ratio·d) keeps the param
+        # count at the gelu MLP's, rounded up to a multiple of 8 so the
+        # tp rule divides (and lanes stay aligned); the extra key is
+        # fold_in-derived so gelu/MoE init streams stay bit-identical
+        hs = max(-(-2 * h // 3) // 8 * 8, 8)
+        block.update({
+            "mlp_fc1": L.dense_init(ks[2], d, hs, std=0.02, dtype=dtype),
+            "mlp_fc3": L.dense_init(jax.random.fold_in(ks[2], 1), d, hs,
+                                    std=0.02, dtype=dtype),
+            "mlp_fc2": L.dense_init(ks[3], hs, d, std=res_std,
+                                    dtype=dtype),
+        })
     else:
         block.update({
             "mlp_fc1": L.dense_init(ks[2], d, h, std=0.02, dtype=dtype),
@@ -142,6 +162,9 @@ class GPT:
             # a typo'd "rotary" must not silently train learned positions
             raise ValueError(f"unknown pos {cfg.pos!r}; use 'learned' "
                              f"or 'rope'")
+        if cfg.mlp not in ("gelu", "swiglu"):
+            raise ValueError(f"unknown mlp {cfg.mlp!r}; use 'gelu' "
+                             f"or 'swiglu'")
         k_wte, k_wpe, k_blocks, k_head = jax.random.split(rng, 4)
         blocks = jax.vmap(
             lambda k: _block_init(k, cfg, dtype)
@@ -172,6 +195,7 @@ class GPT:
               return_aux: bool = False,
               return_hidden: bool = False) -> jax.Array:
         b, s = ids.shape
+        _check_pos(params, cfg)
         if s > cfg.seq_len:
             # jnp.take would silently fill NaN embeddings for positions
             # beyond the wpe table; shapes are static, so fail loudly
@@ -234,6 +258,21 @@ class GPT:
         if "head" in params:
             return params["head"]["kernel"].T
         return params["wte"]["table"]
+
+
+def _check_pos(params: dict, cfg: GPTConfig) -> None:
+    """A params tree from a rope checkpoint run with pos="learned" (or
+    vice versa) would silently train/decode with NO position signal —
+    the wpe add keys on the params, the rotation on the config. Make
+    the mismatch loud instead."""
+    has_wpe = "wpe" in params
+    if cfg.pos == "rope" and has_wpe:
+        raise ValueError("params carry a wpe table but cfg.pos='rope' "
+                         "— checkpoint/config mismatch")
+    if cfg.pos != "rope" and not has_wpe:
+        raise ValueError("params have no wpe table but cfg.pos="
+                         f"{cfg.pos!r} — was this checkpoint trained "
+                         "with pos='rope'?")
 
 
 def _expand_kv(kv: jax.Array, cfg: GPTConfig) -> jax.Array:
@@ -299,6 +338,9 @@ def _block_core(bp: dict, x: jax.Array, cfg: GPTConfig, attend,
             capacity_factor=cfg.capacity_factor
             if capacity_factor is None else capacity_factor)
         x = constrain(x + m)
+    elif "mlp_fc3" in bp:   # swiglu: silu(xW1) ⊙ xW3 → W2
+        h = jax.nn.silu(L.dense(bp["mlp_fc1"], h)) * L.dense(bp["mlp_fc3"], h)
+        x = constrain(x + L.dense(bp["mlp_fc2"], h))
     else:
         h = jax.nn.gelu(L.dense(bp["mlp_fc1"], h))
         x = constrain(x + L.dense(bp["mlp_fc2"], h))
@@ -355,6 +397,7 @@ def generate(params: dict, ids: jax.Array,
              rng: jax.Array | None = None,
              temperature: float = 1.0,
              top_k: int | None = None,
+             top_p: float | None = None,
              compute_dtype: Any = jnp.bfloat16) -> jax.Array:
     """Autoregressive decoding with a static-shape KV cache.
 
@@ -365,8 +408,10 @@ def generate(params: dict, ids: jax.Array,
     static shapes throughout; SURVEY §7 dynamic-shapes note).
 
     ``temperature=0`` decodes greedily (no rng needed); otherwise
-    ``jax.random.categorical`` samples, with optional ``top_k``
-    filtering. Returns (B, S_prompt + n_new) token ids.
+    ``jax.random.categorical`` samples, with optional ``top_k`` and/or
+    ``top_p`` (nucleus) filtering — top_p keeps the smallest set of
+    tokens whose probability mass reaches p (always at least the top
+    token). Returns (B, S_prompt + n_new) token ids.
     """
     b, s0 = ids.shape
     s_total = s0 + n_new
@@ -378,8 +423,13 @@ def generate(params: dict, ids: jax.Array,
         raise ValueError(f"temperature must be >= 0, got {temperature}")
     if temperature > 0 and rng is None:
         raise ValueError("sampling (temperature > 0) needs rng=")
+    if top_p is not None and not 0.0 < top_p <= 1.0:
+        # top_p=0 would mask EVERY token and categorical would silently
+        # emit id 0 forever
+        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
     if n_new == 0:
         return ids
+    _check_pos(params, cfg)
 
     # --- prefill: full prompt forward, K/V collected per layer ---
     x = L.embedding(params["wte"], ids, dtype=compute_dtype)
@@ -407,9 +457,24 @@ def generate(params: dict, ids: jax.Array,
         if temperature == 0:
             return jnp.argmax(logits, axis=-1).astype(ids.dtype)
         logits = logits.astype(jnp.float32) / temperature
-        if top_k is not None:
-            kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
-            logits = jnp.where(logits < kth, -jnp.inf, logits)
+        if top_k is not None or top_p is not None:
+            # ONE descending sort serves both filters (this runs per
+            # token inside the decode scan)
+            desc = jnp.sort(logits, axis=-1)[:, ::-1]
+            if top_k is not None:
+                logits = jnp.where(logits < desc[:, top_k - 1][:, None],
+                                   -jnp.inf, logits)
+                desc = jnp.where(
+                    jnp.arange(desc.shape[-1])[None] < top_k,
+                    desc, -jnp.inf)
+            if top_p is not None:
+                probs = jax.nn.softmax(desc, axis=-1)
+                # keep while the mass BEFORE a token is < p (top-1
+                # always in)
+                keep = jnp.cumsum(probs, axis=-1) - probs < top_p
+                thresh = jnp.min(jnp.where(keep, desc, jnp.inf),
+                                 axis=-1, keepdims=True)
+                logits = jnp.where(logits >= thresh, logits, -jnp.inf)
         return jax.random.categorical(rng_step, logits).astype(ids.dtype)
 
     rng = jax.random.PRNGKey(0) if rng is None else rng
