@@ -189,3 +189,32 @@ def test_optimizer_agc_clips():
     clipped, unclipped = upd(0.01), upd(0.0)
     assert unclipped == 1e3
     assert clipped < 1.0, clipped
+
+
+def test_optimizer_decay_matrices_only():
+    """decay_matrices_only: weight decay reaches matrices but not
+    rank-1 params (biases/norm scales) — the standard masking rule."""
+    import jax
+    import jax.numpy as jnp
+
+    from torchbooster_tpu.config import OptimizerConfig
+
+    params = {"kernel": jnp.ones((4, 4)), "bias": jnp.ones((4,))}
+    grads = jax.tree.map(jnp.zeros_like, params)
+
+    def delta(masked):
+        tx = OptimizerConfig(name="adamw", lr=0.0, weight_decay=0.1,
+                             decay_matrices_only=masked).make()
+        state = tx.init(params)
+        updates, _ = tx.update(grads, state, params)
+        return updates
+
+    up = delta(True)
+    assert float(jnp.abs(up["bias"]).max()) == 0.0      # masked off
+    # lr=0 zeroes everything; use lr>0 to see decay on the matrix
+    tx = OptimizerConfig(name="adamw", lr=1.0, weight_decay=0.1,
+                         decay_matrices_only=True).make()
+    state = tx.init(params)
+    updates, _ = tx.update(grads, state, params)
+    assert float(jnp.abs(updates["kernel"]).max()) > 0.0
+    assert float(jnp.abs(updates["bias"]).max()) == 0.0

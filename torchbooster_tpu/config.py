@@ -431,6 +431,10 @@ class OptimizerConfig(BaseConfig):
     # models (models/resnet.py norm="ws"), whose sharper loss surface
     # diverges under large adaptive LRs without it
     agc: float = 0.0
+    # decay matrices only: masks weight decay off every rank-≤1 param
+    # (biases, norm scales, per-channel gains) — the standard rule the
+    # reference's torch AdamW applied to everything indiscriminately
+    decay_matrices_only: bool = False
 
     def make(self, schedule: Callable[[Any], Any] | None = None):
         """Return an ``optax.GradientTransformation``. When ``schedule``
@@ -442,6 +446,14 @@ class OptimizerConfig(BaseConfig):
 
         lr = schedule if schedule is not None else self.lr
         name = self.name.lower()
+        # mask=callable: optax evaluates it on the param pytree at
+        # init, so the config needs no access to the model here
+        mask = None
+        if self.decay_matrices_only:
+            import jax
+
+            mask = lambda params: jax.tree.map(lambda p: p.ndim > 1,
+                                               params)
         if name == "sgd":
             factory = lambda learning_rate: optax.sgd(
                 learning_rate, momentum=self.momentum or None,
@@ -449,7 +461,8 @@ class OptimizerConfig(BaseConfig):
             if self.weight_decay:
                 factory_inner = factory
                 factory = lambda learning_rate: optax.chain(
-                    optax.add_decayed_weights(self.weight_decay),
+                    optax.add_decayed_weights(self.weight_decay,
+                                              mask=mask),
                     factory_inner(learning_rate))
         elif name == "adam":
             factory = lambda learning_rate: optax.adam(
@@ -457,11 +470,11 @@ class OptimizerConfig(BaseConfig):
         elif name == "adamw":
             factory = lambda learning_rate: optax.adamw(
                 learning_rate, b1=self.betas[0], b2=self.betas[1],
-                eps=self.eps, weight_decay=self.weight_decay)
+                eps=self.eps, weight_decay=self.weight_decay, mask=mask)
         elif name == "lamb":
             factory = lambda learning_rate: optax.lamb(
                 learning_rate, b1=self.betas[0], b2=self.betas[1],
-                eps=self.eps, weight_decay=self.weight_decay)
+                eps=self.eps, weight_decay=self.weight_decay, mask=mask)
         elif name == "lion":
             factory = lambda learning_rate: optax.lion(
                 learning_rate, b1=self.betas[0], b2=self.betas[1],
