@@ -202,19 +202,16 @@ def test_optimizer_decay_matrices_only():
     params = {"kernel": jnp.ones((4, 4)), "bias": jnp.ones((4,))}
     grads = jax.tree.map(jnp.zeros_like, params)
 
-    def delta(masked):
-        tx = OptimizerConfig(name="adamw", lr=0.0, weight_decay=0.1,
+    def updates(name, masked):
+        tx = OptimizerConfig(name=name, lr=1.0, weight_decay=0.1,
                              decay_matrices_only=masked).make()
         state = tx.init(params)
-        updates, _ = tx.update(grads, state, params)
-        return updates
+        up, _ = tx.update(grads, state, params)
+        return up
 
-    up = delta(True)
-    assert float(jnp.abs(up["bias"]).max()) == 0.0      # masked off
-    # lr=0 zeroes everything; use lr>0 to see decay on the matrix
-    tx = OptimizerConfig(name="adamw", lr=1.0, weight_decay=0.1,
-                         decay_matrices_only=True).make()
-    state = tx.init(params)
-    updates, _ = tx.update(grads, state, params)
-    assert float(jnp.abs(updates["kernel"]).max()) > 0.0
-    assert float(jnp.abs(updates["bias"]).max()) == 0.0
+    for name in ("adamw", "lion"):
+        un = updates(name, False)
+        assert float(jnp.abs(un["bias"]).max()) > 0.0, name   # decays
+        up = updates(name, True)
+        assert float(jnp.abs(up["kernel"]).max()) > 0.0, name  # decays
+        assert float(jnp.abs(up["bias"]).max()) == 0.0, name   # masked

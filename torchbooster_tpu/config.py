@@ -478,7 +478,7 @@ class OptimizerConfig(BaseConfig):
         elif name == "lion":
             factory = lambda learning_rate: optax.lion(
                 learning_rate, b1=self.betas[0], b2=self.betas[1],
-                weight_decay=self.weight_decay)
+                weight_decay=self.weight_decay, mask=mask)
         elif name == "adafactor":
             factory = lambda learning_rate: optax.adafactor(learning_rate)
         else:

@@ -23,8 +23,9 @@ from torchbooster_tpu.models.gan import GAN
 from torchbooster_tpu.models.vgg import VGGFeatures
 from torchbooster_tpu.models.stylenet import StyleNet
 from torchbooster_tpu.models.gpt import GPT
+from torchbooster_tpu.models.unet import UNet
 
 __all__ = [
-    "GAN", "GPT", "LeNet", "ResNet", "StyleNet", "VAE", "VGGFeatures",
-    "layers",
+    "GAN", "GPT", "LeNet", "ResNet", "StyleNet", "UNet", "VAE",
+    "VGGFeatures", "layers",
 ]

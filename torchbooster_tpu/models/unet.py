@@ -1,0 +1,149 @@
+"""Time-conditioned UNet — the denoiser for the DDPM family.
+
+A model family beyond the reference's inventory (classification, VAE,
+GAN, style — SURVEY §2.14): the framework's recipe skeleton, config
+front door, and training utilities drive a diffusion model unchanged
+(examples/img_gen/ddpm). TPU notes: NHWC throughout, GroupNorm in the
+lane-friendly formulation (models/layers), downsampling by strided
+conv and upsampling by ``jax.image.resize`` + conv (no transposed-conv
+checkerboards), static shapes everywhere so the whole sampler scans.
+
+Structure (per resolution level ``i`` with width ``base·mults[i]``):
+down: 2 × ResBlock → strided conv; middle: 2 × ResBlock; up: concat
+skip → 2 × ResBlock → resize-conv. Every ResBlock folds the sinusoidal
+time embedding in through a per-block projection added to the hidden
+activation (the DDPM conditioning pattern).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from torchbooster_tpu.models import layers as L
+
+_GROUPS = 8
+
+
+@dataclass(frozen=True)
+class UNetConfig:
+    in_channels: int = 1
+    base: int = 64
+    mults: tuple = (1, 2, 2)
+    time_dim: int = 256
+
+
+def time_embedding(t: jax.Array, dim: int) -> jax.Array:
+    """Sinusoidal embedding of integer timesteps t (B,) → (B, dim);
+    fp32 angles (bf16 t·freq products alias at large T)."""
+    half = dim // 2
+    freqs = jnp.exp(-jnp.log(10_000.0)
+                    * jnp.arange(half, dtype=jnp.float32) / half)
+    angles = t.astype(jnp.float32)[:, None] * freqs[None, :]
+    return jnp.concatenate([jnp.sin(angles), jnp.cos(angles)], axis=-1)
+
+
+def _resblock_init(rng, cin, cout, time_dim, dtype):
+    ks = jax.random.split(rng, 4)
+    block = {
+        "norm1": L.norm_init(cin, dtype),
+        "conv1": L.conv_init(ks[0], 3, cin, cout, dtype=dtype),
+        "time_proj": L.dense_init(ks[1], time_dim, cout, dtype=dtype),
+        "norm2": L.norm_init(cout, dtype),
+        "conv2": L.conv_init(ks[2], 3, cout, cout, dtype=dtype),
+    }
+    if cin != cout:
+        block["skip"] = L.conv_init(ks[3], 1, cin, cout, dtype=dtype)
+    return block
+
+
+def _resblock(bp, x, temb):
+    h = jax.nn.silu(L.group_norm(bp["norm1"], x, _GROUPS))
+    h = L.conv(bp["conv1"], h, padding=1)
+    h = h + L.dense(bp["time_proj"], jax.nn.silu(temb))[:, None, None, :]
+    h = jax.nn.silu(L.group_norm(bp["norm2"], h, _GROUPS))
+    h = L.conv(bp["conv2"], h, padding=1)
+    if "skip" in bp:
+        x = L.conv(bp["skip"], x)
+    return x + h
+
+
+class UNet:
+    """``init(rng, cfg)`` → params; ``apply(params, x, t, cfg)`` →
+    predicted noise ε with x's shape. ``t`` is (B,) integer steps."""
+
+    Config = UNetConfig
+
+    @staticmethod
+    def init(rng: jax.Array, cfg: UNetConfig = UNetConfig(),
+             dtype: Any = jnp.float32) -> dict:
+        widths = [cfg.base * m for m in cfg.mults]
+        n_levels = len(widths)
+        ks = iter(jax.random.split(rng, 6 * n_levels + 8))
+        td = cfg.time_dim
+        params: dict = {
+            "time_mlp1": L.dense_init(next(ks), td, td, dtype=dtype),
+            "time_mlp2": L.dense_init(next(ks), td, td, dtype=dtype),
+            "stem": L.conv_init(next(ks), 3, cfg.in_channels, widths[0],
+                                dtype=dtype),
+        }
+        cin = widths[0]
+        for i, w in enumerate(widths):
+            params[f"down{i}_a"] = _resblock_init(next(ks), cin, w, td, dtype)
+            params[f"down{i}_b"] = _resblock_init(next(ks), w, w, td, dtype)
+            cin = w
+            if i < n_levels - 1:
+                params[f"down{i}_pool"] = L.conv_init(next(ks), 3, w, w,
+                                                      dtype=dtype)
+        params["mid_a"] = _resblock_init(next(ks), cin, cin, td, dtype)
+        params["mid_b"] = _resblock_init(next(ks), cin, cin, td, dtype)
+        for i in reversed(range(n_levels)):
+            w = widths[i]
+            # input: current features + the level's skip (concat)
+            params[f"up{i}_a"] = _resblock_init(next(ks), cin + w, w, td,
+                                                dtype)
+            params[f"up{i}_b"] = _resblock_init(next(ks), w, w, td, dtype)
+            cin = w
+            if i > 0:
+                params[f"up{i}_conv"] = L.conv_init(next(ks), 3, w,
+                                                    widths[i - 1],
+                                                    dtype=dtype)
+                cin = widths[i - 1]
+        params["out_norm"] = L.norm_init(cin, dtype)
+        params["out_conv"] = L.conv_init(next(ks), 3, cin,
+                                         cfg.in_channels, dtype=dtype)
+        return params
+
+    @staticmethod
+    def apply(params: dict, x: jax.Array, t: jax.Array,
+              cfg: UNetConfig = UNetConfig()) -> jax.Array:
+        n_levels = len(cfg.mults)
+        temb = time_embedding(t, cfg.time_dim)
+        temb = L.dense(params["time_mlp2"],
+                       jax.nn.silu(L.dense(params["time_mlp1"], temb)))
+
+        h = L.conv(params["stem"], x, padding=1)
+        skips = []
+        for i in range(n_levels):
+            h = _resblock(params[f"down{i}_a"], h, temb)
+            h = _resblock(params[f"down{i}_b"], h, temb)
+            skips.append(h)
+            if i < n_levels - 1:
+                h = L.conv(params[f"down{i}_pool"], h, stride=2, padding=1)
+        h = _resblock(params["mid_a"], h, temb)
+        h = _resblock(params["mid_b"], h, temb)
+        for i in reversed(range(n_levels)):
+            h = jnp.concatenate([h, skips[i]], axis=-1)
+            h = _resblock(params[f"up{i}_a"], h, temb)
+            h = _resblock(params[f"up{i}_b"], h, temb)
+            if i > 0:
+                b, hh, ww, c = h.shape
+                h = jax.image.resize(h, (b, hh * 2, ww * 2, c), "nearest")
+                h = L.conv(params[f"up{i}_conv"], h, padding=1)
+        h = jax.nn.silu(L.group_norm(params["out_norm"], h, _GROUPS))
+        return L.conv(params["out_conv"], h, padding=1)
+
+
+__all__ = ["UNet", "UNetConfig", "time_embedding"]

@@ -1,0 +1,133 @@
+"""DDPM machinery: noise schedules, forward corruption, ε-prediction
+loss, and compiled samplers.
+
+TPU-first shape: the whole reverse process is ONE ``lax.scan`` over a
+precomputed schedule table (static T, no per-step host round-trips),
+so a 1000-step sample is a single compiled program. DDIM subsampling
+re-indexes the same table with a static stride, keeping the scan length
+``steps`` while striding the schedule.
+"""
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class DiffusionSchedule(NamedTuple):
+    """Precomputed per-step tables (all (T,) fp32)."""
+
+    betas: jax.Array
+    alphas: jax.Array
+    alpha_bars: jax.Array
+
+    @property
+    def T(self) -> int:  # noqa: N802 - standard diffusion notation
+        return self.betas.shape[0]
+
+
+def linear_schedule(T: int, beta1: float = 1e-4,
+                    beta2: float = 2e-2) -> DiffusionSchedule:
+    """The DDPM paper's linear β ramp."""
+    betas = jnp.linspace(beta1, beta2, T, dtype=jnp.float32)
+    alphas = 1.0 - betas
+    return DiffusionSchedule(betas, alphas, jnp.cumprod(alphas))
+
+
+def cosine_schedule(T: int, s: float = 8e-3) -> DiffusionSchedule:
+    """Improved-DDPM cosine ᾱ — flatter SNR decay at both ends."""
+    steps = jnp.arange(T + 1, dtype=jnp.float32) / T
+    f = jnp.cos((steps + s) / (1.0 + s) * jnp.pi / 2.0) ** 2
+    alpha_bars = f[1:] / f[0]
+    betas = jnp.clip(1.0 - alpha_bars / jnp.concatenate(
+        [jnp.ones((1,)), alpha_bars[:-1]]), 0.0, 0.999)
+    alphas = 1.0 - betas
+    return DiffusionSchedule(betas, alphas, jnp.cumprod(alphas))
+
+
+def make_schedule(name: str, T: int) -> DiffusionSchedule:
+    if name == "linear":
+        return linear_schedule(T)
+    if name == "cosine":
+        return cosine_schedule(T)
+    raise ValueError(f"unknown schedule {name!r}; use 'linear' or 'cosine'")
+
+
+def q_sample(x0: jax.Array, t: jax.Array, noise: jax.Array,
+             sched: DiffusionSchedule) -> jax.Array:
+    """Forward corruption x_t = √ᾱ_t·x₀ + √(1−ᾱ_t)·ε; ``t`` is (B,)."""
+    ab = sched.alpha_bars[t][:, None, None, None]
+    return jnp.sqrt(ab) * x0 + jnp.sqrt(1.0 - ab) * noise
+
+
+def ddpm_loss(apply_fn, params, x0: jax.Array, rng: jax.Array,
+              sched: DiffusionSchedule) -> jax.Array:
+    """ε-prediction MSE at uniformly drawn timesteps (the simple DDPM
+    objective). ``apply_fn(params, x_t, t) -> ε̂``."""
+    k_t, k_eps = jax.random.split(rng)
+    t = jax.random.randint(k_t, (x0.shape[0],), 0, sched.T)
+    noise = jax.random.normal(k_eps, x0.shape, x0.dtype)
+    from torchbooster_tpu.ops.losses import mse_loss
+
+    pred = apply_fn(params, q_sample(x0, t, noise, sched), t)
+    return mse_loss(pred, noise)   # fp32 accumulation (ops/losses.py)
+
+
+def ddpm_sample(apply_fn, params, shape: tuple, rng: jax.Array,
+                sched: DiffusionSchedule) -> jax.Array:
+    """Ancestral sampling: T reverse steps in one ``lax.scan``."""
+    k_init, k_steps = jax.random.split(rng)
+    x = jax.random.normal(k_init, shape, jnp.float32)
+
+    def step(x, inputs):
+        t, k = inputs
+        eps = apply_fn(params, x, jnp.full((shape[0],), t)).astype(
+            jnp.float32)
+        alpha = sched.alphas[t]
+        ab = sched.alpha_bars[t]
+        mean = (x - sched.betas[t] / jnp.sqrt(1.0 - ab) * eps) \
+            / jnp.sqrt(alpha)
+        z = jax.random.normal(k, shape, jnp.float32)
+        x = mean + jnp.where(t > 0, jnp.sqrt(sched.betas[t]), 0.0) * z
+        return x, None
+
+    ts = jnp.arange(sched.T - 1, -1, -1)
+    x, _ = jax.lax.scan(step, x, (ts, jax.random.split(k_steps, sched.T)))
+    return x
+
+
+def ddim_sample(apply_fn, params, shape: tuple, rng: jax.Array,
+                sched: DiffusionSchedule, steps: int = 50,
+                eta: float = 0.0) -> jax.Array:
+    """DDIM: a strided ``steps``-long scan over the same tables;
+    ``eta=0`` is fully deterministic given the initial noise."""
+    k_init, k_steps = jax.random.split(rng)
+    x = jax.random.normal(k_init, shape, jnp.float32)
+    ts = jnp.linspace(sched.T - 1, 0, steps).round().astype(jnp.int32)
+    ts_prev = jnp.concatenate([ts[1:], jnp.array([-1])])
+
+    def step(x, inputs):
+        t, t_prev, k = inputs
+        eps = apply_fn(params, x, jnp.full((shape[0],), t)).astype(
+            jnp.float32)
+        ab = sched.alpha_bars[t]
+        ab_prev = jnp.where(t_prev >= 0,
+                            sched.alpha_bars[jnp.maximum(t_prev, 0)], 1.0)
+        x0 = (x - jnp.sqrt(1.0 - ab) * eps) / jnp.sqrt(ab)
+        sigma = eta * jnp.sqrt((1.0 - ab_prev) / (1.0 - ab)
+                               * (1.0 - ab / ab_prev))
+        dir_xt = jnp.sqrt(jnp.clip(1.0 - ab_prev - sigma ** 2, 0.0, None)) \
+            * eps
+        z = jax.random.normal(k, shape, jnp.float32)
+        x = jnp.sqrt(ab_prev) * x0 + dir_xt + sigma * z
+        return x, None
+
+    x, _ = jax.lax.scan(step, x, (ts, ts_prev,
+                                  jax.random.split(k_steps, steps)))
+    return x
+
+
+__all__ = ["DiffusionSchedule", "cosine_schedule", "ddim_sample",
+           "ddpm_loss", "ddpm_sample", "linear_schedule", "make_schedule",
+           "q_sample"]
