@@ -69,6 +69,8 @@ class Config(BaseConfig):
     scheduler: SchedulerConfig
     dataset: DatasetConfig
 
+    ema_decay: float = 0.999   # 0 disables; sampling uses EMA weights
+
 
 def to_unit(images: jax.Array) -> jax.Array:
     """Pixels → [−1, 1] (the DDPM data range)."""
@@ -106,9 +108,11 @@ def main(conf: Config) -> dict:
     params = conf.env.make(UNet.init(rng, cfg), model=UNet)
     schedule = conf.scheduler.make(conf.optim)
     tx = conf.optim.make(schedule)
-    state = utils.TrainState.create(params, tx, rng=rng)
+    state = utils.TrainState.create(params, tx, rng=rng,
+                                    ema=conf.ema_decay > 0)
     step = utils.make_step(loss_fn, tx,
-                           compute_dtype=conf.env.compute_dtype())
+                           compute_dtype=conf.env.compute_dtype(),
+                           ema_decay=conf.ema_decay or None)
 
     results = {}
     for epoch in range(conf.epochs):
@@ -129,11 +133,13 @@ def main(conf: Config) -> dict:
             probe = probe[..., None]
         shape = (conf.n_samples, *probe.shape[1:])
         k = jax.random.PRNGKey(conf.seed)
+        # the DDPM convention: sample from the EMA weights
+        weights = state.ema if state.ema is not None else state.params
         if conf.sample_steps:
-            images = ddim_sample(apply_fn, state.params, shape, k, sched,
+            images = ddim_sample(apply_fn, weights, shape, k, sched,
                                  steps=conf.sample_steps)
         else:
-            images = ddpm_sample(apply_fn, state.params, shape, k, sched)
+            images = ddpm_sample(apply_fn, weights, shape, k, sched)
         path = Path(conf.samples_path)
         path.parent.mkdir(parents=True, exist_ok=True)
         np.save(path, np.asarray(images))

@@ -202,3 +202,64 @@ def test_make_step_rules_pin_layout():
 
     with pytest.raises(ValueError, match="mesh"):
         make_step(loss_fn, tx, rules=rules)
+
+
+def test_make_step_ema():
+    """ema_decay: the compiled step maintains an EMA params shadow that
+    lags the live params (bias-corrected warmup, so early steps track
+    rather than cling to the init snapshot)."""
+    import optax
+
+    from torchbooster_tpu.utils import TrainState, make_step
+
+    def loss_fn(p, b, rng):
+        del rng
+        return ((p["w"] - b) ** 2).sum(), {}
+
+    tx = optax.sgd(0.2)
+    state = TrainState.create({"w": jnp.zeros((2,))}, tx, ema=True)
+    step = make_step(loss_fn, tx, ema_decay=0.9)
+    target = jnp.ones((2,))
+    for _ in range(15):
+        state, _ = step(state, target)
+    w = float(state.params["w"][0])
+    e = float(state.ema["w"][0])
+    assert 0.5 < w <= 1.0
+    assert 0.0 < e < w          # lags behind, but moved off the init
+
+    # without ema=True the field stays None even when a decay is set
+    state2 = TrainState.create({"w": jnp.zeros((2,))}, tx)
+    step2 = make_step(loss_fn, tx, ema_decay=0.9)
+    state2, _ = step2(state2, target)
+    assert state2.ema is None
+
+
+def test_make_step_ema_accumulation_holds():
+    """With gradient accumulation, the EMA must decay only on boundary
+    micro-steps (params are frozen on holds) — effective half-life
+    stays ema_decay per OPTIMIZER update, not per micro-step."""
+    import optax
+
+    from torchbooster_tpu.utils import TrainState, make_step
+
+    def loss_fn(p, b, rng):
+        del rng
+        return ((p["w"] - b) ** 2).sum(), {}
+
+    tx = optax.sgd(0.5)
+    state = TrainState.create({"w": jnp.zeros((1,))}, tx,
+                              accumulate=True, ema=True)
+    step = make_step(loss_fn, tx, accumulate_every=4, ema_decay=0.5)
+    target = jnp.ones((1,))
+    # 3 hold micro-steps: params AND ema must both be untouched
+    for _ in range(3):
+        state, _ = step(state, target)
+    assert float(state.params["w"][0]) == 0.0
+    assert float(state.ema["w"][0]) == 0.0
+    # the boundary step applies the update and ONE ema decay
+    state, _ = step(state, target)
+    w = float(state.params["w"][0])
+    assert w > 0.0
+    d = min(0.5, (1 + 3) / (10 + 3))
+    np.testing.assert_allclose(float(state.ema["w"][0]), (1 - d) * w,
+                               rtol=1e-5)

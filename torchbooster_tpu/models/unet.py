@@ -79,6 +79,10 @@ class UNet:
     @staticmethod
     def init(rng: jax.Array, cfg: UNetConfig = UNetConfig(),
              dtype: Any = jnp.float32) -> dict:
+        if cfg.time_dim % 2:
+            # sinusoidal embedding emits 2*(dim//2) features; an odd
+            # dim would die later as an opaque dot shape mismatch
+            raise ValueError(f"time_dim must be even, got {cfg.time_dim}")
         widths = [cfg.base * m for m in cfg.mults]
         n_levels = len(widths)
         ks = iter(jax.random.split(rng, 6 * n_levels + 8))

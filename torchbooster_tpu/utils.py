@@ -188,17 +188,23 @@ class TrainState(struct.PyTreeNode):
     step: jax.Array
     rng: jax.Array
     grad_acc: Any = None
+    # exponential moving average of params (sampling weights for
+    # diffusion/GAN-style training); updated inside the compiled step
+    # when make_step(ema_decay=...) is set, checkpointed with the rest
+    ema: Any = None
 
     @classmethod
     def create(cls, params: Any, tx: optax.GradientTransformation,
                rng: jax.Array | int = 0,
-               accumulate: bool = False) -> "TrainState":
+               accumulate: bool = False,
+               ema: bool = False) -> "TrainState":
         if isinstance(rng, int):
             rng = jax.random.PRNGKey(rng)
         grad_acc = jax.tree.map(jnp.zeros_like, params) if accumulate else None
+        ema_tree = jax.tree.map(jnp.array, params) if ema else None
         return cls(params=params, opt_state=tx.init(params),
                    step=jnp.zeros((), jnp.int32), rng=rng,
-                   grad_acc=grad_acc)
+                   grad_acc=grad_acc, ema=ema_tree)
 
 
 def _clip_by_global_norm(grads: Any, clip: float) -> Any:
@@ -217,6 +223,7 @@ def make_step(
     has_aux: bool = True,
     donate: bool = True,
     rules: Any = None,
+    ema_decay: float | None = None,
 ) -> Callable:
     """Build the jitted train step — the functional replacement for the
     reference's per-call ``utils.step`` (ref utils.py:204-252).
@@ -293,9 +300,9 @@ def make_step(
             aux = {}
         grads = _pin(grads)
 
+        boundary = (state.step + 1) % accumulate_every == 0
         if accumulate:
             grad_acc = jax.tree.map(jnp.add, state.grad_acc, grads)
-            boundary = (state.step + 1) % accumulate_every == 0
 
             def apply(_):
                 grads_avg = jax.tree.map(
@@ -323,9 +330,23 @@ def make_step(
             params = optax.apply_updates(state.params, updates)
             grad_acc = state.grad_acc
 
+        ema = state.ema
+        if ema_decay is not None and ema is not None:
+            # bias-corrected decay ramp: early steps track params
+            # closely instead of the init snapshot
+            d = jnp.minimum(ema_decay,
+                            (1.0 + state.step) / (10.0 + state.step))
+            # under accumulation, params only change on boundary
+            # micro-steps — decaying on hold steps would shrink the
+            # effective half-life by accumulate_every
+            if accumulate:
+                d = jnp.where(boundary, d, 1.0)
+            ema = jax.tree.map(lambda e, p: e * d + (1.0 - d) * p,
+                               ema, params)
+
         new_state = state.replace(
             params=_pin(params), opt_state=opt_state, step=state.step + 1,
-            rng=rng, grad_acc=grad_acc)
+            rng=rng, grad_acc=grad_acc, ema=ema)
         metrics = {"loss": loss, **aux}
         return new_state, metrics
 
