@@ -141,3 +141,47 @@ def test_vgg_torch_import_exact():
     np.testing.assert_allclose(np.asarray(got),
                                want.transpose(0, 2, 3, 1),
                                rtol=1e-3, atol=1e-3)
+
+
+def test_gpt2_import_matches_transformers_forward():
+    """load_torch_gpt2 vs the REAL HuggingFace implementation: a tiny
+    GPT2LMHeadModel built from config (no network), eval-mode logits
+    must match our scan forward exactly up to float error."""
+    transformers = pytest.importorskip("transformers")
+
+    from torchbooster_tpu.models.gpt import GPT, load_torch_gpt2
+
+    hf_cfg = transformers.GPT2Config(
+        vocab_size=97, n_positions=24, n_embd=32, n_layer=2, n_head=4,
+        resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0)
+    model = transformers.GPT2LMHeadModel(hf_cfg).eval()
+
+    params, cfg = load_torch_gpt2(model.state_dict(), n_heads=4)
+    assert cfg.vocab == 97 and cfg.n_layers == 2 and cfg.d_model == 32
+
+    ids = np.array([[3, 14, 15, 92, 65, 35], [8, 9, 7, 9, 3, 2]],
+                   np.int32)
+    with torch.no_grad():
+        want = model(torch.from_numpy(ids).long()).logits.numpy()
+    got = np.asarray(GPT.apply(params, jnp.asarray(ids), cfg,
+                               compute_dtype=jnp.float32, remat=False))
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+def test_gpt2_import_transformer_prefix_and_head_inference():
+    """The 'transformer.'-prefixed key form (GPT2LMHeadModel.state_dict
+    uses it) must import identically; unknown d_model without n_heads
+    raises."""
+    transformers = pytest.importorskip("transformers")
+
+    from torchbooster_tpu.models.gpt import load_torch_gpt2
+
+    hf_cfg = transformers.GPT2Config(
+        vocab_size=50, n_positions=16, n_embd=24, n_layer=1, n_head=3)
+    model = transformers.GPT2LMHeadModel(hf_cfg)
+    sd = model.state_dict()
+    assert any(k.startswith("transformer.") for k in sd)
+    with pytest.raises(ValueError, match="n_heads"):
+        load_torch_gpt2(sd)                      # 24 not in the table
+    params, cfg = load_torch_gpt2(sd, n_heads=3)
+    assert cfg.d_model == 24 and cfg.n_heads == 3

@@ -513,6 +513,84 @@ def generate(params: dict, ids: jax.Array,
 GPT.generate = staticmethod(generate)
 
 
+def _np(t):
+    """torch tensor / array → numpy without importing torch."""
+    if hasattr(t, "detach"):
+        t = t.detach().cpu().numpy()
+    import numpy as _onp
+
+    return _onp.asarray(t)
+
+
+def load_torch_gpt2(state_dict, n_heads: int | None = None):
+    """Build (params, cfg) from a HuggingFace GPT-2 ``state_dict`` —
+    the LM counterpart of :func:`models.resnet.load_torch_state` (the
+    reference's pretrained-import capability, ref resnet.py:104-112,
+    extended to the language-model family).
+
+    Accepts ``GPT2Model`` or ``GPT2LMHeadModel`` checkpoints (with or
+    without the ``transformer.`` prefix; torch tensors or numpy
+    arrays). HF's Conv1D stores weights as (in, out) — exactly this
+    framework's dense ``kernel`` layout, so kernels map without
+    transposes; per-layer tensors stack onto the leading layer axis for
+    the ``lax.scan`` forward. GPT-2 ties lm_head to wte, so the import
+    always produces a tied model. ``n_heads`` defaults from d_model via
+    the published GPT-2 family table.
+
+    Numerically exact against ``transformers``' eval-mode forward
+    (tests/test_torch_import.py) — both use the tanh-approximate gelu.
+    """
+    import numpy as _onp
+
+    sd = {(k[12:] if k.startswith("transformer.") else k): v
+          for k, v in state_dict.items()}
+    n_layers = 1 + max(int(k.split(".")[1]) for k in sd
+                       if k.startswith("h."))
+    vocab, d_model = _np(sd["wte.weight"]).shape
+    n_pos = _np(sd["wpe.weight"]).shape[0]
+    if n_heads is None:
+        heads_table = {768: 12, 1024: 16, 1280: 20, 1600: 25}
+        if d_model not in heads_table:
+            raise ValueError(
+                f"n_heads not inferable for d_model={d_model}; pass "
+                "n_heads= explicitly")
+        n_heads = heads_table[d_model]
+    cfg = GPTConfig(vocab=vocab, n_layers=n_layers, d_model=d_model,
+                    n_heads=n_heads, seq_len=n_pos, tie_embeddings=True)
+
+    def stack(fmt: str):
+        return jnp.asarray(_onp.stack(
+            [_np(sd[fmt.format(i)]).astype(_onp.float32)
+             for i in range(n_layers)]))
+
+    blocks = {
+        "ln1": {"scale": stack("h.{}.ln_1.weight"),
+                "bias": stack("h.{}.ln_1.bias")},
+        "attn_qkv": {"kernel": stack("h.{}.attn.c_attn.weight"),
+                     "bias": stack("h.{}.attn.c_attn.bias")},
+        "attn_proj": {"kernel": stack("h.{}.attn.c_proj.weight"),
+                      "bias": stack("h.{}.attn.c_proj.bias")},
+        "ln2": {"scale": stack("h.{}.ln_2.weight"),
+                "bias": stack("h.{}.ln_2.bias")},
+        "mlp_fc1": {"kernel": stack("h.{}.mlp.c_fc.weight"),
+                    "bias": stack("h.{}.mlp.c_fc.bias")},
+        "mlp_fc2": {"kernel": stack("h.{}.mlp.c_proj.weight"),
+                    "bias": stack("h.{}.mlp.c_proj.bias")},
+    }
+    params = {
+        "wte": {"table": jnp.asarray(
+            _np(sd["wte.weight"]).astype(_onp.float32))},
+        "wpe": {"table": jnp.asarray(
+            _np(sd["wpe.weight"]).astype(_onp.float32))},
+        "blocks": blocks,
+        "ln_f": {"scale": jnp.asarray(
+            _np(sd["ln_f.weight"]).astype(_onp.float32)),
+            "bias": jnp.asarray(
+                _np(sd["ln_f.bias"]).astype(_onp.float32))},
+    }
+    return params, cfg
+
+
 def _make_constrainer(mesh: Mesh | None):
     if mesh is None:
         return lambda x: x
@@ -528,4 +606,5 @@ def _make_constrainer(mesh: Mesh | None):
     return constrain
 
 
-__all__ = ["GPT", "GPTConfig", "SHARDING_RULES", "batch_spec"]
+__all__ = ["GPT", "GPTConfig", "SHARDING_RULES", "batch_spec",
+           "load_torch_gpt2"]
