@@ -33,6 +33,7 @@ from torchbooster_tpu.dataset import Split
 from torchbooster_tpu.metrics import MetricsAccumulator
 from torchbooster_tpu.models.unet import UNet, UNetConfig
 from torchbooster_tpu.ops.diffusion import (
+    cfg_apply,
     ddim_sample,
     ddpm_loss,
     ddpm_sample,
@@ -46,10 +47,12 @@ class ModelConfig(BaseConfig):
     base: int = 64
     mults: tuple(int, int, int) = (1, 2, 2)
     time_dim: int = 256
+    n_classes: int = 0      # > 0: class-conditional (CFG-trained)
 
     def make(self) -> UNetConfig:
         return UNetConfig(in_channels=self.in_channels, base=self.base,
-                          mults=tuple(self.mults), time_dim=self.time_dim)
+                          mults=tuple(self.mults), time_dim=self.time_dim,
+                          n_classes=self.n_classes)
 
 
 @dataclass
@@ -70,6 +73,8 @@ class Config(BaseConfig):
     dataset: DatasetConfig
 
     ema_decay: float = 0.999   # 0 disables; sampling uses EMA weights
+    p_uncond: float = 0.1      # CFG label-dropout rate (conditional only)
+    guidance: float = 2.0      # CFG scale w at sampling time
 
 
 def to_unit(images: jax.Array) -> jax.Array:
@@ -80,9 +85,13 @@ def to_unit(images: jax.Array) -> jax.Array:
 
 
 def unpack(batch):
+    """(images, labels-or-None) from dict/tuple/bare batches."""
     if isinstance(batch, dict):
-        return batch.get("image", batch.get("images"))
-    return batch[0] if isinstance(batch, (tuple, list)) else batch
+        return (batch.get("image", batch.get("images")),
+                batch.get("label", batch.get("labels")))
+    if isinstance(batch, (tuple, list)):
+        return batch[0], (batch[1] if len(batch) > 1 else None)
+    return batch, None
 
 
 def main(conf: Config) -> dict:
@@ -95,14 +104,25 @@ def main(conf: Config) -> dict:
                               distributed=conf.env.distributed,
                               seed=conf.seed)
 
-    def apply_fn(params, x_t, t):
-        return UNet.apply(params, x_t, t, cfg)
+    conditional = cfg.n_classes > 0
+
+    def apply_fn(params, x_t, t, labels=None):
+        return UNet.apply(params, x_t, t, cfg, labels=labels)
 
     def loss_fn(params, batch, rng):
-        images = to_unit(unpack(batch))
+        images, labels = unpack(batch)
+        if conditional and labels is None:
+            # training would silently collapse to NULL-class-only while
+            # sampling still guides per class — refuse instead
+            raise ValueError("model.n_classes > 0 needs a labeled "
+                             "dataset (batches carry no labels)")
+        images = to_unit(images)
         if images.ndim == 3:
             images = images[..., None]
-        loss = ddpm_loss(apply_fn, params, images, rng, sched)
+        loss = ddpm_loss(apply_fn, params, images, rng, sched,
+                         labels=labels if conditional else None,
+                         null_label=cfg.n_classes,
+                         p_uncond=conf.p_uncond)
         return loss, {}
 
     params = conf.env.make(UNet.init(rng, cfg), model=UNet)
@@ -128,18 +148,25 @@ def main(conf: Config) -> dict:
 
     if dist.is_primary() and conf.n_samples:
         # image side from one real batch (static shapes for the scan)
-        probe = to_unit(unpack(next(iter(loader))))
+        probe = to_unit(unpack(next(iter(loader)))[0])
         if probe.ndim == 3:
             probe = probe[..., None]
         shape = (conf.n_samples, *probe.shape[1:])
         k = jax.random.PRNGKey(conf.seed)
         # the DDPM convention: sample from the EMA weights
         weights = state.ema if state.ema is not None else state.params
+        if conditional:
+            # one sample per class, cycling; CFG-guided denoiser
+            labels = jnp.arange(conf.n_samples) % cfg.n_classes
+            denoise = lambda p, x, t: cfg_apply(
+                apply_fn, p, x, t, labels, cfg.n_classes, conf.guidance)
+        else:
+            denoise = apply_fn
         if conf.sample_steps:
-            images = ddim_sample(apply_fn, weights, shape, k, sched,
+            images = ddim_sample(denoise, weights, shape, k, sched,
                                  steps=conf.sample_steps)
         else:
-            images = ddpm_sample(apply_fn, weights, shape, k, sched)
+            images = ddpm_sample(denoise, weights, shape, k, sched)
         path = Path(conf.samples_path)
         path.parent.mkdir(parents=True, exist_ok=True)
         np.save(path, np.asarray(images))

@@ -298,3 +298,23 @@ def test_ddpm(monkeypatch, tmp_path):
     assert results["loss"] > 0.0
     samples = np.load(tmp_path / "samples.npy")
     assert samples.shape[0] == 2 and np.isfinite(samples).all()
+
+
+def test_ddpm_conditional_cfg(monkeypatch, tmp_path):
+    """Class-conditional diffusion: CFG label dropout in training,
+    guided per-class sampling at the end."""
+    import numpy as np
+
+    ddpm = load_example(monkeypatch, "img_gen", "ddpm")
+    conf = ddpm.Config.load("ddpm.yml")
+    conf.epochs, conf.loader.batch_size = 1, 32
+    conf.timesteps, conf.sample_steps = 50, 5
+    conf.model.base, conf.model.mults, conf.model.time_dim = 16, (1, 2), 32
+    conf.model.n_classes = 10
+    conf.n_samples, conf.guidance = 4, 1.5
+    conf.samples_path = str(tmp_path / "samples.npy")
+    tiny_env(conf)
+    results = ddpm.main(conf)
+    assert results["loss"] > 0.0
+    samples = np.load(tmp_path / "samples.npy")
+    assert samples.shape[0] == 4 and np.isfinite(samples).all()

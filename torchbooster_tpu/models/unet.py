@@ -33,6 +33,10 @@ class UNetConfig:
     base: int = 64
     mults: tuple = (1, 2, 2)
     time_dim: int = 256
+    # class-conditional generation: n_classes > 0 adds a label
+    # embedding folded into the time embedding; label id n_classes is
+    # the NULL class (classifier-free guidance's unconditional token)
+    n_classes: int = 0
 
 
 def time_embedding(t: jax.Array, dim: int) -> jax.Array:
@@ -93,6 +97,10 @@ class UNet:
             "stem": L.conv_init(next(ks), 3, cfg.in_channels, widths[0],
                                 dtype=dtype),
         }
+        if cfg.n_classes:
+            # +1 row: the NULL (unconditional) class for CFG
+            params["label_emb"] = L.embedding_init(
+                next(ks), cfg.n_classes + 1, td, dtype=dtype)
         cin = widths[0]
         for i, w in enumerate(widths):
             params[f"down{i}_a"] = _resblock_init(next(ks), cin, w, td, dtype)
@@ -122,11 +130,16 @@ class UNet:
 
     @staticmethod
     def apply(params: dict, x: jax.Array, t: jax.Array,
-              cfg: UNetConfig = UNetConfig()) -> jax.Array:
+              cfg: UNetConfig = UNetConfig(),
+              labels: jax.Array | None = None) -> jax.Array:
         n_levels = len(cfg.mults)
         temb = time_embedding(t, cfg.time_dim)
         temb = L.dense(params["time_mlp2"],
                        jax.nn.silu(L.dense(params["time_mlp1"], temb)))
+        if cfg.n_classes:
+            if labels is None:   # unconditional: the NULL class
+                labels = jnp.full((x.shape[0],), cfg.n_classes)
+            temb = temb + L.embedding(params["label_emb"], labels)
 
         h = L.conv(params["stem"], x, padding=1)
         skips = []

@@ -62,16 +62,50 @@ def q_sample(x0: jax.Array, t: jax.Array, noise: jax.Array,
 
 
 def ddpm_loss(apply_fn, params, x0: jax.Array, rng: jax.Array,
-              sched: DiffusionSchedule) -> jax.Array:
+              sched: DiffusionSchedule,
+              labels: jax.Array | None = None,
+              null_label: int | None = None,
+              p_uncond: float = 0.1) -> jax.Array:
     """ε-prediction MSE at uniformly drawn timesteps (the simple DDPM
-    objective). ``apply_fn(params, x_t, t) -> ε̂``."""
-    k_t, k_eps = jax.random.split(rng)
-    t = jax.random.randint(k_t, (x0.shape[0],), 0, sched.T)
-    noise = jax.random.normal(k_eps, x0.shape, x0.dtype)
+    objective). ``apply_fn(params, x_t, t, labels) -> ε̂`` when
+    ``labels`` is given (else the 3-arg form). For classifier-free
+    guidance training, pass ``null_label``: each label is replaced by
+    it with probability ``p_uncond`` so one network learns both the
+    conditional and unconditional scores."""
     from torchbooster_tpu.ops.losses import mse_loss
 
-    pred = apply_fn(params, q_sample(x0, t, noise, sched), t)
+    k_t, k_eps, k_drop = jax.random.split(rng, 3)
+    t = jax.random.randint(k_t, (x0.shape[0],), 0, sched.T)
+    noise = jax.random.normal(k_eps, x0.shape, x0.dtype)
+    x_t = q_sample(x0, t, noise, sched)
+    if labels is None:
+        pred = apply_fn(params, x_t, t)
+    else:
+        if null_label is not None and p_uncond > 0:
+            drop = jax.random.bernoulli(k_drop, p_uncond,
+                                        (x0.shape[0],))
+            labels = jnp.where(drop, null_label, labels)
+        pred = apply_fn(params, x_t, t, labels)
     return mse_loss(pred, noise)   # fp32 accumulation (ops/losses.py)
+
+
+def cfg_apply(apply_fn, params, x: jax.Array, t: jax.Array,
+              labels: jax.Array, null_label: int,
+              guidance: float) -> jax.Array:
+    """Classifier-free guided score:
+    ε̂ = (1+w)·ε̂(x, y) − w·ε̂(x, ∅). ``guidance=0`` short-circuits to
+    the plain conditional model (no doubled batch). Both branches run
+    in one batched call (2B) so the sampler stays a single scan body.
+    """
+    if guidance == 0.0:
+        return apply_fn(params, x, t, labels)
+    double = jnp.concatenate([x, x], axis=0)
+    t2 = jnp.concatenate([t, t], axis=0)
+    y2 = jnp.concatenate([labels,
+                          jnp.full_like(labels, null_label)], axis=0)
+    eps = apply_fn(params, double, t2, y2)
+    cond, uncond = jnp.split(eps, 2, axis=0)
+    return (1.0 + guidance) * cond - guidance * uncond
 
 
 def ddpm_sample(apply_fn, params, shape: tuple, rng: jax.Array,
@@ -128,6 +162,6 @@ def ddim_sample(apply_fn, params, shape: tuple, rng: jax.Array,
     return x
 
 
-__all__ = ["DiffusionSchedule", "cosine_schedule", "ddim_sample",
-           "ddpm_loss", "ddpm_sample", "linear_schedule", "make_schedule",
-           "q_sample"]
+__all__ = ["DiffusionSchedule", "cfg_apply", "cosine_schedule",
+           "ddim_sample", "ddpm_loss", "ddpm_sample", "linear_schedule",
+           "make_schedule", "q_sample"]
