@@ -89,6 +89,7 @@ class Config(BaseConfig):
     sample_tokens: int = 0          # > 0: KV-cache sample after training
     sample_top_p: float = 0.0       # > 0: nucleus filter for sampling
     sample_temperature: float = 0.8
+    eval_batches: int = 0           # > 0: validation-split ppl after training
 
 
 def batch_sharding(mesh) -> NamedSharding:
@@ -187,6 +188,34 @@ def main(conf: Config) -> dict:
                 save_cb.save(it + 1, state=state)
     if save_cb is not None:
         save_cb.wait()
+    if conf.eval_batches > 0:
+        # held-out perplexity on the VALIDATION split (text_file keeps
+        # it disjoint from train/test; synthetic_lm reseeds per split)
+        eval_step = utils.make_eval_step(loss_fn)
+        eval_loader = conf.loader.make(
+            conf.dataset.make(Split.VALIDATION, seq_len=cfg.seq_len + 1,
+                              vocab=cfg.vocab),
+            shuffle=False, distributed=conf.env.distributed,
+            seed=conf.seed)
+        eval_metrics = MetricsAccumulator()
+        with mesh:
+            for i, tokens in enumerate(eval_loader):
+                if i >= conf.eval_batches:
+                    break
+                eval_metrics.update(
+                    eval_step(state.params, shard(tokens), state.rng))
+        evals = eval_metrics.compute()
+        if not evals:
+            if dist.is_primary():
+                print("eval skipped: validation split yielded no full "
+                      "batches (drop_last) — shrink batch_size or grow "
+                      "the corpus")
+        else:
+            results["val_loss"] = evals["loss"]
+            results["val_ppl"] = evals["ppl"]
+            if dist.is_primary():
+                print({"val_loss": round(evals["loss"], 4),
+                       "val_ppl": round(evals["ppl"], 4)})
     if conf.sample_tokens > 0:
         # KV-cache decoding (models/gpt.py generate): prompt with the
         # first tokens of a training example, continue the sequence

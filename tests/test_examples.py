@@ -264,7 +264,7 @@ def test_gpt_text_file_corpus(monkeypatch, tmp_path):
 
     gpt = load_example(monkeypatch, "lm", "gpt")
     conf = gpt.Config.load("gpt.yml")
-    corpus = "the quick brown fox jumps over the lazy dog. " * 200
+    corpus = "the quick brown fox jumps over the lazy dog. " * 600
     path = tmp_path / "corpus.txt"
     path.write_text(corpus)
     conf.dataset.name, conf.dataset.root = "text_file", str(path)
@@ -274,9 +274,11 @@ def test_gpt_text_file_corpus(monkeypatch, tmp_path):
     conf.n_iter, conf.log_every = 4, 4
     conf.loader.batch_size = 8
     conf.sample_tokens = 8
+    conf.eval_batches = 2        # held-out ppl on the disjoint val split
     tiny_env(conf)
     out = gpt.main(conf)
     assert np.isfinite(out["loss"])
+    assert np.isfinite(out["val_loss"]) and out["val_ppl"] > 1.0
     assert len(out["sample"]) == 8 + 8
     assert all(0 <= t < 256 for t in out["sample"])
 
