@@ -26,6 +26,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from torchbooster_tpu.models import layers as L
+from torchbooster_tpu.models.torch_interop import to_numpy as _np
 from torchbooster_tpu.ops.attention import attention
 
 
@@ -511,15 +512,6 @@ def generate(params: dict, ids: jax.Array,
 
 
 GPT.generate = staticmethod(generate)
-
-
-def _np(t):
-    """torch tensor / array → numpy without importing torch."""
-    if hasattr(t, "detach"):
-        t = t.detach().cpu().numpy()
-    import numpy as _onp
-
-    return _onp.asarray(t)
 
 
 def load_torch_gpt2(state_dict, n_heads: int | None = None):

@@ -38,6 +38,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from torchbooster_tpu.models import layers as L
+from torchbooster_tpu.models.torch_interop import to_numpy as _np
 
 # depth → (block kind, stage repeats)
 _CONFIGS = {
@@ -428,13 +429,6 @@ def _nf_apply(params: dict, x: jax.Array, pool_stem: bool | None,
     x = _nf_act(x / jnp.asarray(float(np.sqrt(expected_var)), x.dtype))
     x = L.global_avg_pool(x)
     return L.dense(params["head"], x)
-
-
-def _np(t: Any) -> np.ndarray:
-    """torch tensor / numpy array → numpy (no torch import needed)."""
-    if hasattr(t, "detach"):
-        t = t.detach().cpu().numpy()
-    return np.asarray(t)
 
 
 def _fold_bn(sd: Mapping[str, Any], prefix: str,

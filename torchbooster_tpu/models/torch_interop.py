@@ -1,0 +1,18 @@
+"""Shared helpers for the torch checkpoint importers
+(resnet.load_torch_state, vgg.load_torch_features, gpt.load_torch_gpt2).
+"""
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+
+def to_numpy(t: Any) -> np.ndarray:
+    """torch tensor / numpy array → numpy, without importing torch."""
+    if hasattr(t, "detach"):
+        t = t.detach().cpu().numpy()
+    return np.asarray(t)
+
+
+__all__ = ["to_numpy"]

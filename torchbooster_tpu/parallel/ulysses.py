@@ -30,6 +30,7 @@ import functools
 import math
 
 import jax
+import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 from jax import shard_map
@@ -47,10 +48,13 @@ def _ulysses_local(q: jax.Array, k: jax.Array, v: jax.Array, *, axis: str,
     sequence shards; returns the same-sharded attention output."""
     from torchbooster_tpu.ops.attention import attention
 
-    # seq-sharded → head-sharded: split heads (2), gather seq (1)
-    qh = lax.all_to_all(q, axis, split_axis=2, concat_axis=1, tiled=True)
-    kh = lax.all_to_all(k, axis, split_axis=2, concat_axis=1, tiled=True)
-    vh = lax.all_to_all(v, axis, split_axis=2, concat_axis=1, tiled=True)
+    # seq-sharded → head-sharded: split heads, gather seq — ONE
+    # stacked all-to-all for q/k/v (axes shift by the leading stack
+    # dim) instead of three collective launches
+    qkv = jnp.stack([q, k, v])
+    qkv = lax.all_to_all(qkv, axis, split_axis=3, concat_axis=2,
+                         tiled=True)
+    qh, kh, vh = qkv
     out = attention(qh, kh, vh, causal=causal, sm_scale=sm_scale, impl=impl)
     # head-sharded → seq-sharded: split seq (1), gather heads (2)
     return lax.all_to_all(out, axis, split_axis=1, concat_axis=2, tiled=True)
