@@ -75,6 +75,8 @@ class Config(BaseConfig):
     ema_decay: float = 0.999   # 0 disables; sampling uses EMA weights
     p_uncond: float = 0.1      # CFG label-dropout rate (conditional only)
     guidance: float = 2.0      # CFG scale w at sampling time
+    save_every: int = 0        # checkpoint every N epochs (0 disables)
+    checkpoint_root: str = "checkpoints"
 
 
 def to_unit(images: jax.Array) -> jax.Array:
@@ -130,12 +132,28 @@ def main(conf: Config) -> dict:
     tx = conf.optim.make(schedule)
     state = utils.TrainState.create(params, tx, rng=rng,
                                     ema=conf.ema_decay > 0)
+    # checkpoint + resume (same orbax path as the gpt recipe; the EMA
+    # shadow rides in the state, so resumed sampling stays smoothed)
+    save_cb = None
+    start_epoch = 0
+    if conf.save_every:
+        from torchbooster_tpu.callbacks import SaveCallback
+
+        save_cb = SaveCallback(conf.save_every, conf.epochs,
+                               root=conf.checkpoint_root)
+        restored = save_cb.restore(like={"state": state})
+        if restored is not None:
+            state = restored["state"]
+            steps_per_epoch = max(len(loader), 1)
+            start_epoch = int(np.asarray(state.step)) // steps_per_epoch
+            if dist.is_primary():
+                print(f"resumed at epoch {start_epoch}")
     step = utils.make_step(loss_fn, tx,
                            compute_dtype=conf.env.compute_dtype(),
                            ema_decay=conf.ema_decay or None)
 
     results = {}
-    for epoch in range(conf.epochs):
+    for epoch in range(start_epoch, conf.epochs):
         metrics = MetricsAccumulator()
         for batch in tqdm(loader, desc=f"train {epoch}",
                           disable=not dist.is_primary()):
@@ -145,6 +163,10 @@ def main(conf: Config) -> dict:
         if dist.is_primary():
             print({k: round(v, 4) if isinstance(v, float) else v
                    for k, v in results.items()})
+        if save_cb is not None and (epoch + 1) % conf.save_every == 0:
+            save_cb.save(epoch + 1, state=state)
+    if save_cb is not None:
+        save_cb.wait()
 
     if dist.is_primary() and conf.n_samples:
         # image side from one real batch (static shapes for the scan)

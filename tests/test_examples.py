@@ -320,3 +320,25 @@ def test_ddpm_conditional_cfg(monkeypatch, tmp_path):
     assert results["loss"] > 0.0
     samples = np.load(tmp_path / "samples.npy")
     assert samples.shape[0] == 4 and np.isfinite(samples).all()
+
+
+def test_ddpm_checkpoint_resume(monkeypatch, tmp_path):
+    """The diffusion recipe checkpoints per-epoch (EMA included in the
+    state) and resumes past completed epochs."""
+    ddpm = load_example(monkeypatch, "img_gen", "ddpm")
+    conf = ddpm.Config.load("ddpm.yml")
+    conf.epochs, conf.loader.batch_size = 1, 32
+    conf.timesteps, conf.sample_steps, conf.n_samples = 20, 0, 0
+    conf.model.base, conf.model.mults, conf.model.time_dim = 16, (1, 2), 32
+    conf.save_every = 1
+    conf.checkpoint_root = str(tmp_path / "ckpt")
+    tiny_env(conf)
+    ddpm.main(conf)
+
+    conf.epochs = 2
+    results = ddpm.main(conf)          # resumes at epoch 1
+    assert results["epoch"] == 1
+    from torchbooster_tpu.callbacks import SaveCallback
+
+    cb = SaveCallback(1, 2, root=conf.checkpoint_root)
+    assert cb.latest_step() == 2
