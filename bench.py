@@ -96,6 +96,31 @@ def bench_tpu(batch: int, image: int, steps: int) -> float:
     return batch / timed_steps(step, state, data, steps)
 
 
+def bench_unet(steps: int) -> float:
+    """DDPM UNet train step (64x64 RGB, base 64, cosine schedule) —
+    the diffusion family's throughput, img/s/chip."""
+    from torchbooster_tpu.models.unet import UNet, UNetConfig
+    from torchbooster_tpu.ops.diffusion import ddpm_loss, make_schedule
+
+    batch = int(os.environ.get("BENCH_UNET_BATCH", 64))
+    cfg = UNetConfig(in_channels=3, base=64, mults=(1, 2, 2),
+                     time_dim=256)
+    sched = make_schedule("cosine", 1000)
+    params = UNet.init(jax.random.PRNGKey(0), cfg)
+
+    def loss_fn(p, b, rng):
+        return ddpm_loss(
+            lambda p, x, t: UNet.apply(p, x, t, cfg), p, b["x"], rng,
+            sched), {}
+
+    tx = optax.adamw(2e-4)
+    state = TrainState.create(params, tx, rng=0)
+    step = make_step(loss_fn, tx, compute_dtype=jnp.bfloat16)
+    x = jax.device_put(jax.random.normal(
+        jax.random.PRNGKey(1), (batch, 64, 64, 3), jnp.bfloat16))
+    return batch / timed_steps(step, state, {"x": x}, steps)
+
+
 def bench_gpt(steps: int) -> tuple[float, float]:
     """GPT-2 small (12L/768d/12H, vocab 50257, S=1024) train step —
     driver-captured version of the docs' LM claim. Returns
@@ -415,6 +440,9 @@ def _sub_main(name: str) -> None:
         tok_s, mfu = bench_gpt_long(max(4, steps // 4))
         print(json.dumps({"gpt_long_tokens_per_sec": round(tok_s, 1),
                           "gpt_long_mfu": round(mfu, 4)}))
+    elif name == "unet":
+        ips = bench_unet(max(6, steps // 3))
+        print(json.dumps({"unet_img_per_sec": round(ips, 2)}))
     elif name == "loader":
         workers = int(os.environ.get("BENCH_LOADER_WORKERS",
                                      min(16, (os.cpu_count() or 8))))
@@ -515,6 +543,10 @@ def main() -> None:
             out.update(frag)
     if not env_flag("BENCH_SKIP_LOADER"):
         frag = _run_sub("loader", _deadline("loader", 900))
+        if frag is not None:
+            out.update(frag)
+    if not env_flag("BENCH_SKIP_UNET"):
+        frag = _run_sub("unet", _deadline("unet", 900))
         if frag is not None:
             out.update(frag)
 
