@@ -482,3 +482,56 @@ def test_gpt_hidden_path_matches_logits_path():
                                           labels, chunk_size=8))
         np.testing.assert_allclose(got, want, rtol=1e-5,
                                    err_msg=f"tie={tie}")
+
+
+def _gqa_qkv(key, b=2, s=64, hq=4, hkv=2, d=16):
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (b, s, hq, d))
+    k = jax.random.normal(kk, (b, s, hkv, d))
+    v = jax.random.normal(kv, (b, s, hkv, d))
+    ref = mha_reference(q, jnp.repeat(k, hq // hkv, 2),
+                        jnp.repeat(v, hq // hkv, 2), causal=True)
+    return q, k, v, ref
+
+
+def test_ring_attention_grouped_kv():
+    """GQA K/V circulate the ring UN-expanded (half the ppermute bytes
+    at hq/hkv=2) and must match the expanded reference exactly."""
+    mesh = make_mesh("dp:2,sp:4")
+    q, k, v, ref = _gqa_qkv(jax.random.PRNGKey(10))
+    with mesh:
+        out = ring_attention(q, k, v, mesh, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-3, atol=2e-3)
+
+
+def test_ulysses_attention_grouped_kv():
+    """GQA K/V reshard grouped through the all-to-all (hkv/sp divides)
+    and expand only at the local attention."""
+    from torchbooster_tpu.parallel.ulysses import ulysses_attention
+
+    mesh = make_mesh("dp:4,sp:2")
+    q, k, v, ref = _gqa_qkv(jax.random.PRNGKey(11), b=4)
+    with mesh:
+        out = ulysses_attention(q, k, v, mesh, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-3, atol=2e-3)
+
+
+def test_sequence_attention_grouped_fallback():
+    """hkv=2 on sp:4 cannot stay grouped through the all-to-all — the
+    front door must pre-expand (not crash) and stay exact; direct
+    ulysses_attention refuses the same shape loudly."""
+    from torchbooster_tpu.parallel.ulysses import (
+        sequence_attention, ulysses_attention)
+
+    mesh = make_mesh("dp:2,sp:4")
+    q, k, v, ref = _gqa_qkv(jax.random.PRNGKey(12))
+    with pytest.raises(ValueError, match="kv heads"):
+        with mesh:
+            ulysses_attention(q, k, v, mesh)
+    with mesh:
+        out = sequence_attention(q, k, v, mesh, causal=True,
+                                 strategy="ulysses")
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-3, atol=2e-3)

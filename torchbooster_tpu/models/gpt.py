@@ -214,14 +214,18 @@ class GPT:
                   and mesh.shape["sp"] > 1)
 
         def attend(q, k, v):
-            k, v = _expand_kv(k, cfg), _expand_kv(v, cfg)
             if use_sp:
                 from torchbooster_tpu.parallel.ulysses import (
                     sequence_attention)
 
+                # grouped K/V go in un-expanded: they ride the SP
+                # collectives at kv_heads width and expand only at the
+                # local math (pre-expanded fallback when layouts don't
+                # divide — parallel/ulysses.py)
                 return sequence_attention(q, k, v, mesh=mesh, causal=True,
                                           strategy=cfg.sp_strategy,
                                           impl=attn_impl), None
+            k, v = _expand_kv(k, cfg), _expand_kv(v, cfg)
             return attention(q, k, v, causal=True, impl=attn_impl), None
 
         def block(carry: tuple, bp: dict) -> tuple[tuple, None]:

@@ -27,9 +27,13 @@ NEG_INF = -1e30
 
 
 def _ring_local(q: jax.Array, k: jax.Array, v: jax.Array, *, axis: str,
-                sp_size: int, causal: bool, sm_scale: float) -> jax.Array:
-    """Per-device body under shard_map: q/k/v are local
-    (B, S_loc, H, D) chunks; global chunk id = axis_index."""
+                sp_size: int, causal: bool, sm_scale: float,
+                rep: int = 1) -> jax.Array:
+    """Per-device body under shard_map: q (B, S_loc, H, D) and k/v
+    (B, S_loc, H/rep, D) local chunks; global chunk id = axis_index.
+    Grouped K/V (rep > 1, GQA) circulate the ring UN-expanded — rep×
+    less ppermute traffic — and expand only inside each block's
+    matmuls."""
     b, s_loc, h, d = q.shape
     my_chunk = lax.axis_index(axis)
     perm = [(j, (j + 1) % sp_size) for j in range(sp_size)]
@@ -52,6 +56,9 @@ def _ring_local(q: jax.Array, k: jax.Array, v: jax.Array, *, axis: str,
 
         def attend(kv):
             k_blk, v_blk = kv
+            if rep > 1:
+                k_blk = jnp.repeat(k_blk, rep, axis=2)
+                v_blk = jnp.repeat(v_blk, rep, axis=2)
             scores = jnp.einsum("bqhd,bkhd->bhqk", qf,
                                 k_blk.astype(jnp.float32))
             if causal:
@@ -103,18 +110,30 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
     Drop-in for :func:`torchbooster_tpu.ops.attention.attention` when the
     mesh has a real ``sp`` axis. Batch stays sharded over the data axes;
     heads replicate over ``tp`` handling happens upstream via the qkv
-    projection's output sharding.
+    projection's output sharding. K/V may carry fewer (grouped, GQA)
+    heads than q — they ride the ring grouped and expand per block —
+    as long as the grouped head count still divides ``tp``.
     """
-    *_, head_dim = q.shape
+    *_, n_heads, head_dim = q.shape
+    kv_heads = k.shape[2]
+    if n_heads % kv_heads:
+        raise ValueError(f"query heads ({n_heads}) not divisible by "
+                         f"kv heads ({kv_heads})")
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(head_dim)
     sp_size = mesh.shape[axis]
+    tp_size = mesh.shape.get("tp", 1)
+    if kv_heads % tp_size:
+        raise ValueError(
+            f"ring_attention: kv heads ({kv_heads}) not divisible by "
+            f"tp ({tp_size}); expand K/V to the query head count first")
     data = tuple(a for a in ("dp", "fsdp") if a in mesh.axis_names) or None
     tp = "tp" if "tp" in mesh.axis_names else None
     spec = P(data, axis, tp, None)
 
     body = functools.partial(_ring_local, axis=axis, sp_size=sp_size,
-                             causal=causal, sm_scale=sm_scale)
+                             causal=causal, sm_scale=sm_scale,
+                             rep=n_heads // kv_heads)
     fn = shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
                    out_specs=spec, check_vma=False)
     return fn(q, k, v)

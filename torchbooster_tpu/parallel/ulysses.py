@@ -20,8 +20,15 @@ Trade-offs vs the ring (when a mesh has a real ``sp`` axis):
   mask), but needs H % (sp·tp) == 0 and the full-S attention working
   set must fit one device.
 
+Grouped-query attention composes without inflating the wire: when the
+grouped K/V head count divides the mesh layout, K/V ride the
+collectives UN-expanded (n_heads/kv_heads × less ICI traffic and ring
+transfer) and expand to the query head count only at the local math;
+otherwise the front door falls back to pre-expansion, so any
+head-count combination stays correct.
+
 Heuristic (``sequence_attention(strategy="auto")``): all-to-all when
-the head count divides, ring otherwise — matching the published
+the head counts divide, ring otherwise — matching the published
 guidance (Ulysses for H ≥ sp, ring for extreme S or few heads).
 """
 from __future__ import annotations
@@ -36,28 +43,42 @@ from jax.sharding import Mesh, PartitionSpec as P
 from jax import shard_map
 
 
-def _local_heads(mesh: Mesh, n_heads: int) -> int:
-    """Per-device head count after the spec's tp sharding — the number
-    the all-to-all must further divide by sp."""
-    return n_heads // mesh.shape.get("tp", 1)
+def _expand(kv: jax.Array, rep: int) -> jax.Array:
+    return kv if rep == 1 else jnp.repeat(kv, rep, axis=2)
 
 
 def _ulysses_local(q: jax.Array, k: jax.Array, v: jax.Array, *, axis: str,
-                   causal: bool, sm_scale: float, impl: str) -> jax.Array:
-    """Per-device body under shard_map: q/k/v are (B, S_loc, H_loc, D)
-    sequence shards; returns the same-sharded attention output."""
+                   causal: bool, sm_scale: float, impl: str,
+                   rep: int) -> jax.Array:
+    """Per-device body under shard_map: q (B, S_loc, Hq_loc, D) and
+    k/v (B, S_loc, Hkv_loc, D) sequence shards; returns the q-shaped
+    attention output, sequence-sharded again."""
     from torchbooster_tpu.ops.attention import attention
 
-    # seq-sharded → head-sharded: split heads, gather seq — ONE
-    # stacked all-to-all for q/k/v (axes shift by the leading stack
-    # dim) instead of three collective launches
-    qkv = jnp.stack([q, k, v])
-    qkv = lax.all_to_all(qkv, axis, split_axis=3, concat_axis=2,
-                         tiled=True)
-    qh, kh, vh = qkv
+    # seq-sharded → head-sharded: split heads, gather seq. q and the
+    # (stacked) k/v pair reshard separately when head counts differ;
+    # grouped K/V stay grouped on the wire and expand only here.
+    if rep == 1:
+        qkv = lax.all_to_all(jnp.stack([q, k, v]), axis, split_axis=3,
+                             concat_axis=2, tiled=True)
+        qh, kh, vh = qkv
+    else:
+        qh = lax.all_to_all(q, axis, split_axis=2, concat_axis=1,
+                            tiled=True)
+        kv = lax.all_to_all(jnp.stack([k, v]), axis, split_axis=3,
+                            concat_axis=2, tiled=True)
+        kh, vh = _expand(kv[0], rep), _expand(kv[1], rep)
     out = attention(qh, kh, vh, causal=causal, sm_scale=sm_scale, impl=impl)
     # head-sharded → seq-sharded: split seq (1), gather heads (2)
     return lax.all_to_all(out, axis, split_axis=1, concat_axis=2, tiled=True)
+
+
+def _validate_heads(q: jax.Array, k: jax.Array) -> int:
+    n_heads, kv_heads = q.shape[2], k.shape[2]
+    if n_heads % kv_heads:
+        raise ValueError(f"query heads ({n_heads}) not divisible by "
+                         f"kv heads ({kv_heads})")
+    return n_heads // kv_heads
 
 
 def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
@@ -66,26 +87,29 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
     """Exact attention over (B, S, H, D) with S sharded on ``axis``.
 
     Same contract as :func:`parallel.ring.ring_attention` (drop-in);
-    requires the per-device head count to divide by the ``sp`` size.
-    ``impl`` feeds the local attention dispatch ("auto" engages the
-    flash kernel on TPU from S≥4096).
+    requires the per-device head counts (query AND grouped k/v) to
+    divide by the ``sp`` size. ``impl`` feeds the local attention
+    dispatch ("auto" engages the flash kernel on TPU from S≥4096).
     """
     *_, n_heads, head_dim = q.shape
+    rep = _validate_heads(q, k)
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(head_dim)
     sp_size = mesh.shape[axis]
-    local_heads = _local_heads(mesh, n_heads)
-    if local_heads % sp_size:
-        raise ValueError(
-            f"ulysses_attention needs heads/tp ({local_heads}) divisible "
-            f"by sp ({sp_size}); use ring_attention for this shape")
+    tp_size = mesh.shape.get("tp", 1)
+    for name, heads in (("query", n_heads), ("kv", k.shape[2])):
+        if heads % tp_size or (heads // tp_size) % sp_size:
+            raise ValueError(
+                f"ulysses_attention needs {name} heads ({heads}) "
+                f"divisible by tp·sp ({tp_size}·{sp_size}); expand K/V "
+                "first or use ring_attention")
 
     data = tuple(a for a in ("dp", "fsdp") if a in mesh.axis_names) or None
     tp = "tp" if "tp" in mesh.axis_names else None
     spec = P(data, axis, tp, None)
 
     body = functools.partial(_ulysses_local, axis=axis, causal=causal,
-                             sm_scale=sm_scale, impl=impl)
+                             sm_scale=sm_scale, impl=impl, rep=rep)
     fn = shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
                    out_specs=spec, check_vma=False)
     return fn(q, k, v)
@@ -98,18 +122,40 @@ def sequence_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
     """One front door for sequence-parallel attention.
 
     ``strategy``: "ring", "ulysses", or "auto" (all-to-all whenever the
-    head count divides — it is never slower on TPU meshes where both
+    head counts divide — it is never slower on TPU meshes where both
     apply, and unlocks the flash kernel; ring is the fallback that
-    always works). ``impl`` feeds the all-to-all path's local attention
-    dispatch; the ring is online-softmax by construction and has no
-    kernel choice to make.
+    always works). K/V may carry fewer (grouped) heads than q: they
+    stay grouped across the collectives when the mesh layout divides,
+    and are pre-expanded otherwise. ``impl`` feeds the all-to-all
+    path's local attention dispatch; the ring is online-softmax by
+    construction and has no kernel choice to make.
     """
     from torchbooster_tpu.parallel.ring import ring_attention
 
+    rep = _validate_heads(q, k)
+    n_heads, kv_heads = q.shape[2], k.shape[2]
+    sp_size = mesh.shape[axis]
+    tp_size = mesh.shape.get("tp", 1)
+
+    def divides(heads: int, with_sp: bool) -> bool:
+        return heads % tp_size == 0 and (
+            not with_sp or (heads // tp_size) % sp_size == 0)
+
     if strategy == "auto":
-        *_, n_heads, _ = q.shape
-        divides = _local_heads(mesh, n_heads) % mesh.shape[axis] == 0
-        strategy = "ulysses" if divides else "ring"
+        strategy = "ulysses" if divides(n_heads, True) else "ring"
+        # GQA wire cost: if grouped K/V fit the ring but would need
+        # rep-times expansion to ride the all-to-alls, the ring moves
+        # far fewer bytes — prefer it (the "ulysses never slower"
+        # rationale assumed K/V at query width)
+        if (strategy == "ulysses" and rep > 1
+                and not divides(kv_heads, True)
+                and divides(kv_heads, False)):
+            strategy = "ring"
+    # grouped K/V must fit the strategy's layout; expand as a fallback
+    grouped_ok = (divides(kv_heads, strategy == "ulysses")
+                  if rep > 1 else True)
+    if rep > 1 and not grouped_ok:
+        k, v = _expand(k, rep), _expand(v, rep)
     if strategy == "ulysses":
         return ulysses_attention(q, k, v, mesh, causal=causal,
                                  sm_scale=sm_scale, axis=axis, impl=impl)
