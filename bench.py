@@ -127,7 +127,9 @@ def bench_gpt(steps: int) -> tuple[float, float]:
     (tokens/s, mfu)."""
     from torchbooster_tpu.models.gpt import GPT, GPTConfig
 
-    cfg = GPTConfig()
+    # BENCH_GPT_POS=rope / BENCH_GPT_MLP=swiglu: architecture A/B knobs
+    cfg = GPTConfig(pos=os.environ.get("BENCH_GPT_POS", "learned"),
+                    mlp=os.environ.get("BENCH_GPT_MLP", "gelu"))
     batch = int(os.environ.get("BENCH_GPT_BATCH", 16))
     params = GPT.init(jax.random.PRNGKey(0), cfg)
     n_params = sum(p.size for p in jax.tree.leaves(params))
