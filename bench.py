@@ -523,6 +523,12 @@ def main() -> None:
         "mfu": None,
     }
 
+    def tunnel_died() -> bool:
+        """After a sub-bench timeout: distinguish a slow kernel from a
+        dead tunnel — if the chip no longer answers, burning every
+        remaining deadline serves nobody; emit what we have."""
+        return _probe_tpu(120) != "tpu"
+
     # pallas paths (BENCH_FUSED resnet, flash gpt_long) get longer
     # deadlines: mosaic compiles are the slow tail
     res_deadline = _deadline(
@@ -535,22 +541,28 @@ def main() -> None:
     else:
         out["error"] = "resnet sub-bench produced no result (twice)"
 
-    if not env_flag("BENCH_SKIP_GPT"):
-        frag = _run_sub("gpt", _deadline("gpt", 900))
+    def add_error(msg: str) -> None:
+        out["error"] = "; ".join(filter(None, [out.get("error"), msg]))
+
+    resnet_failed = frag is None
+    aborted = None   # lazily probed: the answer gates only live work
+    secondary = [("gpt", 900), ("gpt_long", 1500), ("loader", 900),
+                 ("unet", 900)]
+    for name, default in secondary:
+        if env_flag(f"BENCH_SKIP_{name.upper()}"):
+            continue
+        if aborted is None and resnet_failed:
+            aborted = tunnel_died()
+            if aborted:
+                add_error("tunnel dead; secondary benches skipped")
+        if aborted:
+            continue
+        frag = _run_sub(name, _deadline(name, default))
         if frag is not None:
             out.update(frag)
-    if not env_flag("BENCH_SKIP_GPT_LONG"):
-        frag = _run_sub("gpt_long", _deadline("gpt_long", 1500))
-        if frag is not None:
-            out.update(frag)
-    if not env_flag("BENCH_SKIP_LOADER"):
-        frag = _run_sub("loader", _deadline("loader", 900))
-        if frag is not None:
-            out.update(frag)
-    if not env_flag("BENCH_SKIP_UNET"):
-        frag = _run_sub("unet", _deadline("unet", 900))
-        if frag is not None:
-            out.update(frag)
+        elif tunnel_died():
+            add_error(f"tunnel died during {name}; remaining skipped")
+            aborted = True
 
     if out["value"] is not None:
         out["vs_baseline"] = round(
