@@ -127,9 +127,12 @@ def bench_gpt(steps: int) -> tuple[float, float]:
     (tokens/s, mfu)."""
     from torchbooster_tpu.models.gpt import GPT, GPTConfig
 
-    # BENCH_GPT_POS=rope / BENCH_GPT_MLP=swiglu: architecture A/B knobs
+    # BENCH_GPT_POS=rope / BENCH_GPT_MLP=swiglu / BENCH_GPT_KV_HEADS:
+    # architecture A/B knobs
     cfg = GPTConfig(pos=os.environ.get("BENCH_GPT_POS", "learned"),
-                    mlp=os.environ.get("BENCH_GPT_MLP", "gelu"))
+                    mlp=os.environ.get("BENCH_GPT_MLP", "gelu"),
+                    n_kv_heads=int(os.environ.get("BENCH_GPT_KV_HEADS",
+                                                  0)))
     batch = int(os.environ.get("BENCH_GPT_BATCH", 16))
     params = GPT.init(jax.random.PRNGKey(0), cfg)
     n_params = sum(p.size for p in jax.tree.leaves(params))

@@ -46,6 +46,7 @@ QUEUE: list[tuple[str, str, dict, int]] = [
     ("gpt_b32", "gpt", {"BENCH_GPT_BATCH": "32"}, 1200),
     ("gpt_rope", "gpt", {"BENCH_GPT_POS": "rope"}, 1200),
     ("gpt_swiglu", "gpt", {"BENCH_GPT_MLP": "swiglu"}, 1200),
+    ("gpt_gqa4", "gpt", {"BENCH_GPT_KV_HEADS": "4"}, 1200),
     ("gpt_long_flash", "gpt_long", {}, 1800),
     ("gpt_long_b2", "gpt_long", {"BENCH_GPT_LONG_BATCH": "2"}, 1500),
     ("gpt_long_b4", "gpt_long", {"BENCH_GPT_LONG_BATCH": "4"}, 1500),

@@ -38,8 +38,9 @@ class GPTConfig:
     n_heads: int = 12
     # grouped-query attention: 0 → = n_heads (standard MHA). Fewer KV
     # heads shrink the decode KV cache (and its HBM traffic) by
-    # n_heads / n_kv_heads; training K/V are repeated to full heads
-    # before the attention kernel, so flash/ring paths are unchanged
+    # n_heads / n_kv_heads; training K/V stay GROUPED end to end — the
+    # flash kernel indexes grouped tiles natively and SP collectives
+    # carry grouped width (ops/flash_attention.py, parallel/ulysses.py)
     n_kv_heads: int = 0
     seq_len: int = 1024
     mlp_ratio: int = 4
@@ -225,7 +226,9 @@ class GPT:
                 return sequence_attention(q, k, v, mesh=mesh, causal=True,
                                           strategy=cfg.sp_strategy,
                                           impl=attn_impl), None
-            k, v = _expand_kv(k, cfg), _expand_kv(v, cfg)
+            # grouped K/V go straight to the dispatcher: the flash
+            # kernel indexes grouped tiles natively (expanded K/V never
+            # exist in HBM); the XLA reference expands internally
             return attention(q, k, v, causal=True, impl=attn_impl), None
 
         def block(carry: tuple, bp: dict) -> tuple[tuple, None]:
@@ -282,9 +285,11 @@ def _check_pos(params: dict, cfg: GPTConfig) -> None:
 
 def _expand_kv(kv: jax.Array, cfg: GPTConfig) -> jax.Array:
     """Repeat grouped K/V heads up to the full query-head count (GQA):
-    (B, S, kv_heads, Dh) → (B, S, n_heads, Dh)."""
-    rep = cfg.n_heads // cfg.kv_heads
-    return kv if rep == 1 else jnp.repeat(kv, rep, axis=2)
+    (B, S, kv_heads, Dh) → (B, S, n_heads, Dh) — the shared block-
+    repeat convention (ops.attention.expand_kv_heads)."""
+    from torchbooster_tpu.ops.attention import expand_kv_heads
+
+    return expand_kv_heads(kv, cfg.n_heads // cfg.kv_heads)
 
 
 def _rope(x: jax.Array, positions: jax.Array,
@@ -444,9 +449,9 @@ def generate(params: dict, ids: jax.Array,
 
     def prefill_block(x, bp):
         def attend(q, k, v):
-            # cache keeps the grouped kv_heads; expand only for attend
-            return attention(q, _expand_kv(k, cfg), _expand_kv(v, cfg),
-                             causal=True), (k, v)
+            # cache keeps the grouped kv_heads; the dispatcher handles
+            # grouped widths natively
+            return attention(q, k, v, causal=True), (k, v)
 
         x, _, kv = _block_core(bp, x, cfg, attend)
         return x, kv

@@ -13,13 +13,26 @@ import jax
 import jax.numpy as jnp
 
 
+def expand_kv_heads(kv: jax.Array, rep: int) -> jax.Array:
+    """THE grouped→query head-expansion convention: block-repeat on the
+    head axis, so query head ``h`` reads grouped head ``h // rep``.
+    Every consumer (reference math, SP fallbacks, GPT cache) and the
+    flash kernels' ``b // rep`` index maps assume exactly this ordering
+    — keep it in one place."""
+    return kv if rep == 1 else jnp.repeat(kv, rep, axis=2)
+
+
 def mha_reference(q: jax.Array, k: jax.Array, v: jax.Array,
                   causal: bool = True,
                   sm_scale: float | None = None) -> jax.Array:
     """Plain attention over (B, S, H, D): softmax(QKᵀ/√d + mask)V.
     Softmax in fp32 regardless of compute dtype (bf16 scores lose too
-    much around the max)."""
+    much around the max). Grouped (GQA) k/v expand to the query head
+    count here — the reference path has no grouped math."""
     *_, head_dim = q.shape
+    if k.shape[2] != q.shape[2]:
+        rep = q.shape[2] // k.shape[2]
+        k, v = expand_kv_heads(k, rep), expand_kv_heads(v, rep)
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(head_dim)
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
@@ -73,14 +86,17 @@ def attention(q: jax.Array, k: jax.Array, v: jax.Array,
     from torchbooster_tpu.ops.flash_attention import flash_attention
 
     b, s_q, h, d = q.shape
-    s_kv = k.shape[1]
-    # fold heads into batch: kernel grid parallelizes over B*H
+    s_kv, h_kv = k.shape[1], k.shape[2]
+    # fold heads into batch: kernel grid parallelizes over B*H. Grouped
+    # (GQA) k/v fold at their OWN width — the kernel indexes grouped
+    # tiles directly (ops/flash_attention.py), so the expansion never
+    # exists in HBM
     qf = q.transpose(0, 2, 1, 3).reshape(b * h, s_q, d)
-    kf = k.transpose(0, 2, 1, 3).reshape(b * h, s_kv, d)
-    vf = v.transpose(0, 2, 1, 3).reshape(b * h, s_kv, d)
+    kf = k.transpose(0, 2, 1, 3).reshape(b * h_kv, s_kv, d)
+    vf = v.transpose(0, 2, 1, 3).reshape(b * h_kv, s_kv, d)
     out = flash_attention(qf, kf, vf, causal=causal, sm_scale=sm_scale,
                           interpret=(impl == "flash_interpret"))
     return out.reshape(b, h, s_q, d).transpose(0, 2, 1, 3)
 
 
-__all__ = ["attention", "mha_reference"]
+__all__ = ["attention", "expand_kv_heads", "mha_reference"]

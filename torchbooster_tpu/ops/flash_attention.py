@@ -146,7 +146,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
 def _fwd_pallas(q, k, v, *, causal, sm_scale, block_q, block_k, interpret,
                 save_residuals):
     bh, seq_q, head_dim = q.shape
-    _, seq_kv, _ = k.shape
+    bh_kv, seq_kv, _ = k.shape
+    kv_rep = bh // bh_kv
     kernel = functools.partial(
         _fwd_kernel, block_q=block_q, block_k=block_k, causal=causal,
         sm_scale=sm_scale, seq_q=seq_q, seq_kv=seq_kv)
@@ -167,8 +168,13 @@ def _fwd_pallas(q, k, v, *, causal, sm_scale, block_q, block_k, interpret,
         grid=grid,
         in_specs=[
             pl.BlockSpec((None, block_q, head_dim), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((None, block_k, head_dim), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((None, block_k, head_dim), lambda b, i, j: (b, j, 0)),
+            # GQA: rows of the GROUPED k/v (bh_kv = bh_q // kv_rep) —
+            # q heads in one group are contiguous in the flat bh order,
+            # so the grouped row is simply b // kv_rep
+            pl.BlockSpec((None, block_k, head_dim),
+                         lambda b, i, j, r=kv_rep: (b // r, j, 0)),
+            pl.BlockSpec((None, block_k, head_dim),
+                         lambda b, i, j, r=kv_rep: (b // r, j, 0)),
         ],
         out_specs=out_specs,
         out_shape=out_shape,
@@ -245,15 +251,18 @@ def _dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref,
 def _dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
                 dk_ref, dv_ref, dk_scr, dv_scr, *, block_q: int,
                 block_k: int, causal: bool, sm_scale: float, seq_q: int,
-                seq_kv: int):
-    # NOTE the transposed grid: (BH, kv_blocks, q_blocks), q innermost —
-    # each kv tile owns its dK/dV rows and sweeps all q tiles.
+                seq_kv: int, n_qblocks: int):
+    # NOTE the transposed grid: (BH_kv, kv_blocks, q_blocks·rep), the q
+    # sweep innermost — each GROUPED kv tile owns its dK/dV rows and
+    # sweeps all q tiles of every head in its group; the causal mask
+    # depends only on the POSITION part of the sweep index.
     kv_index = pl.program_id(1)
-    q_index = pl.program_id(2)
-    n_q = pl.num_programs(2)
+    sweep = pl.program_id(2)
+    q_index = sweep % n_qblocks
+    n_sweep = pl.num_programs(2)
     offset = seq_kv - seq_q
 
-    @pl.when(q_index == 0)
+    @pl.when(sweep == 0)
     def _init():
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
@@ -277,7 +286,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
         ds = p * (dp - delta[:, None])
         dk_scr[:] += sm_scale * (ds.T @ q_ref[:].astype(jnp.float32))
 
-    @pl.when(q_index == n_q - 1)
+    @pl.when(sweep == n_sweep - 1)
     def _finalize():
         dk_ref[:] = dk_scr[:].astype(dk_ref.dtype)
         dv_ref[:] = dv_scr[:].astype(dv_ref.dtype)
@@ -286,12 +295,13 @@ def _dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
 def _bwd_pallas(q, k, v, out, lse, do, *, causal, sm_scale, block_q,
                 block_k, interpret):
     bh, seq_q, head_dim = q.shape
-    _, seq_kv, _ = k.shape
+    bh_kv, seq_kv, _ = k.shape
+    kv_rep = bh // bh_kv
 
     q_spec = pl.BlockSpec((None, block_q, head_dim),
                           lambda b, i, j: (b, i, 0))
     kv_spec = pl.BlockSpec((None, block_k, head_dim),
-                           lambda b, i, j: (b, j, 0))
+                           lambda b, i, j, r=kv_rep: (b // r, j, 0))
     row_spec = pl.BlockSpec((None, block_q, LANES),
                             lambda b, i, j: (b, i, 0))
     common = dict(causal=causal, sm_scale=sm_scale, block_q=block_q,
@@ -311,16 +321,22 @@ def _bwd_pallas(q, k, v, out, lse, do, *, causal, sm_scale, block_q,
         interpret=interpret,
     )(q, k, v, out, do, lse)
 
-    # transposed grid: index maps see (b, kv_index=i, q_index=j)
-    q_spec_t = pl.BlockSpec((None, block_q, head_dim),
-                            lambda b, i, j: (b, j, 0))
+    # transposed grid: (bh_kv, kv_blocks, q_blocks·rep) — each GROUPED
+    # kv row owns its dK/dV tile and sweeps every q tile of every query
+    # head in its group (the group members' contributions accumulate in
+    # the same VMEM scratch; j decomposes as g·n_q + q_block)
+    n_q = seq_q // block_q
+    q_spec_t = pl.BlockSpec(
+        (None, block_q, head_dim),
+        lambda b, i, j, r=kv_rep, n=n_q: (b * r + j // n, j % n, 0))
     kv_spec_t = pl.BlockSpec((None, block_k, head_dim),
                              lambda b, i, j: (b, i, 0))
-    row_spec_t = pl.BlockSpec((None, block_q, LANES),
-                              lambda b, i, j: (b, j, 0))
+    row_spec_t = pl.BlockSpec(
+        (None, block_q, LANES),
+        lambda b, i, j, r=kv_rep, n=n_q: (b * r + j // n, j % n, 0))
     dk, dv = pl.pallas_call(
-        functools.partial(_dkv_kernel, **common),
-        grid=(bh, seq_kv // block_k, seq_q // block_q),
+        functools.partial(_dkv_kernel, n_qblocks=n_q, **common),
+        grid=(bh_kv, seq_kv // block_k, n_q * kv_rep),
         in_specs=[q_spec_t, kv_spec_t, kv_spec_t, q_spec_t, q_spec_t,
                   row_spec_t],
         out_specs=[
@@ -328,8 +344,8 @@ def _bwd_pallas(q, k, v, out, lse, do, *, causal, sm_scale, block_q,
             pl.BlockSpec((None, block_k, head_dim), lambda b, i, j: (b, i, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, seq_kv, head_dim), k.dtype),
-            jax.ShapeDtypeStruct((bh, seq_kv, head_dim), v.dtype),
+            jax.ShapeDtypeStruct((bh_kv, seq_kv, head_dim), k.dtype),
+            jax.ShapeDtypeStruct((bh_kv, seq_kv, head_dim), v.dtype),
         ],
         scratch_shapes=[pltpu.VMEM((block_k, head_dim), jnp.float32),
                         pltpu.VMEM((block_k, head_dim), jnp.float32)],
@@ -388,9 +404,18 @@ def flash_attention(
     divide the sequence lengths; the 1024 defaults measured ~2x faster
     than 128 at S=8k on v5e (the TPU grid runs blocks sequentially per
     core, so bigger tiles amortize overhead — VMEM, not parallelism,
-    is the constraint)."""
-    _, seq_q, head_dim = q.shape
-    _, seq_kv, _ = k.shape
+    is the constraint).
+
+    GQA-native: k/v may carry FEWER leading rows than q (q flattened
+    batch-major with group-contiguous heads, k/v at grouped width) —
+    the kernels index the grouped tiles directly, so expanded K/V never
+    exist in HBM, and dK/dV come back at grouped width with the group's
+    contributions accumulated in-kernel."""
+    bh, seq_q, head_dim = q.shape
+    bh_kv, seq_kv, _ = k.shape
+    if bh % bh_kv:
+        raise ValueError(f"flash_attention: q rows ({bh}) not divisible "
+                         f"by grouped k/v rows ({bh_kv})")
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(head_dim)
     block_q = _pick_block(block_q, seq_q, "seq_q")

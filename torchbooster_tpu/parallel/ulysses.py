@@ -23,7 +23,8 @@ Trade-offs vs the ring (when a mesh has a real ``sp`` axis):
 Grouped-query attention composes without inflating the wire: when the
 grouped K/V head count divides the mesh layout, K/V ride the
 collectives UN-expanded (n_heads/kv_heads × less ICI traffic and ring
-transfer) and expand to the query head count only at the local math;
+transfer) and stay grouped into the local attention (the flash kernel
+reads grouped tiles natively; the XLA reference expands internally);
 otherwise the front door falls back to pre-expansion, so any
 head-count combination stays correct.
 
@@ -43,10 +44,6 @@ from jax.sharding import Mesh, PartitionSpec as P
 from jax import shard_map
 
 
-def _expand(kv: jax.Array, rep: int) -> jax.Array:
-    return kv if rep == 1 else jnp.repeat(kv, rep, axis=2)
-
-
 def _ulysses_local(q: jax.Array, k: jax.Array, v: jax.Array, *, axis: str,
                    causal: bool, sm_scale: float, impl: str,
                    rep: int) -> jax.Array:
@@ -57,7 +54,9 @@ def _ulysses_local(q: jax.Array, k: jax.Array, v: jax.Array, *, axis: str,
 
     # seq-sharded → head-sharded: split heads, gather seq. q and the
     # (stacked) k/v pair reshard separately when head counts differ;
-    # grouped K/V stay grouped on the wire and expand only here.
+    # grouped K/V stay grouped through the wire AND into the local
+    # attention — the dispatcher (flash kernel included) reads grouped
+    # widths natively, so the expansion never materializes.
     if rep == 1:
         qkv = lax.all_to_all(jnp.stack([q, k, v]), axis, split_axis=3,
                              concat_axis=2, tiled=True)
@@ -67,7 +66,9 @@ def _ulysses_local(q: jax.Array, k: jax.Array, v: jax.Array, *, axis: str,
                             tiled=True)
         kv = lax.all_to_all(jnp.stack([k, v]), axis, split_axis=3,
                             concat_axis=2, tiled=True)
-        kh, vh = _expand(kv[0], rep), _expand(kv[1], rep)
+        # stay grouped INTO the local attention too: the dispatcher
+        # (and the flash kernel) handle grouped widths natively
+        kh, vh = kv[0], kv[1]
     out = attention(qh, kh, vh, causal=causal, sm_scale=sm_scale, impl=impl)
     # head-sharded → seq-sharded: split seq (1), gather heads (2)
     return lax.all_to_all(out, axis, split_axis=1, concat_axis=2, tiled=True)
@@ -155,7 +156,9 @@ def sequence_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
     grouped_ok = (divides(kv_heads, strategy == "ulysses")
                   if rep > 1 else True)
     if rep > 1 and not grouped_ok:
-        k, v = _expand(k, rep), _expand(v, rep)
+        from torchbooster_tpu.ops.attention import expand_kv_heads
+
+        k, v = expand_kv_heads(k, rep), expand_kv_heads(v, rep)
     if strategy == "ulysses":
         return ulysses_attention(q, k, v, mesh, causal=causal,
                                  sm_scale=sm_scale, axis=axis, impl=impl)
