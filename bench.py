@@ -191,7 +191,9 @@ def bench_gpt_long(steps: int) -> tuple[float, float]:
     from torchbooster_tpu.models.gpt import GPT, GPTConfig
     from torchbooster_tpu.ops.flash_attention import tileable
 
-    cfg = GPTConfig(n_layers=4, seq_len=8192)
+    cfg = GPTConfig(n_layers=4, seq_len=8192,
+                    n_kv_heads=int(os.environ.get(
+                        "BENCH_GPT_LONG_KV_HEADS", 0)))
     # assert the EXACT predicate the model's dispatch will evaluate
     # (ops/attention.py:49-54) — a lookalike check once passed here
     # while the dispatch itself took the reference path (r3 finding)

@@ -50,6 +50,7 @@ QUEUE: list[tuple[str, str, dict, int]] = [
     ("gpt_long_flash", "gpt_long", {}, 1800),
     ("gpt_long_b2", "gpt_long", {"BENCH_GPT_LONG_BATCH": "2"}, 1500),
     ("gpt_long_b4", "gpt_long", {"BENCH_GPT_LONG_BATCH": "4"}, 1500),
+    ("gpt_long_gqa4", "gpt_long", {"BENCH_GPT_LONG_KV_HEADS": "4"}, 1500),
     ("unet", "unet", {}, 1200),
     ("loader_thread", "loader", {}, 1200),
     ("loader_process", "loader", {"BENCH_LOADER_MODE": "process"}, 1200),
