@@ -13,8 +13,9 @@ TPU-first design:
   ``fsdp`` shards the other dim (ZeRO-style), ``sp`` shards the
   sequence axis of activations;
 - attention runs through :func:`torchbooster_tpu.ops.attention`
-  (pallas flash kernel on TPU) or, when the mesh has a real ``sp``
-  axis, ring attention (:mod:`torchbooster_tpu.parallel.ring`).
+  (pallas flash kernel on TPU, GQA-native) or, when the mesh has a
+  real ``sp`` axis, :func:`parallel.ulysses.sequence_attention`
+  (auto-picked ring / all-to-all strategy per ``cfg.sp_strategy``).
 """
 from __future__ import annotations
 
