@@ -284,15 +284,6 @@ def _check_pos(params: dict, cfg: GPTConfig) -> None:
                          "with pos='rope'?")
 
 
-def _expand_kv(kv: jax.Array, cfg: GPTConfig) -> jax.Array:
-    """Repeat grouped K/V heads up to the full query-head count (GQA):
-    (B, S, kv_heads, Dh) → (B, S, n_heads, Dh) — the shared block-
-    repeat convention (ops.attention.expand_kv_heads)."""
-    from torchbooster_tpu.ops.attention import expand_kv_heads
-
-    return expand_kv_heads(kv, cfg.n_heads // cfg.kv_heads)
-
-
 def _rope(x: jax.Array, positions: jax.Array,
           base: float = 10_000.0) -> jax.Array:
     """Rotary position embedding (rotate-half form) over (B, S, H, D);
@@ -371,22 +362,27 @@ def _cached_block(bp: dict, x: jax.Array, cache_k: jax.Array,
     s_cache = cache_k.shape[1]
 
     def attend(q, k, v):
-        # the cache stores only kv_heads (the GQA memory win); heads
-        # expand to the query count at attention time
+        # the cache stores only kv_heads (the GQA memory win) and is
+        # read GROUPED: q folds to (B, S, groups, rep, D) and the
+        # einsums contract against the grouped cache directly — the
+        # decode hot loop never materializes the rep-times expansion
+        # (its HBM reads dominate each step)
         ck = jax.lax.dynamic_update_slice(
             cache_k, k.astype(cache_k.dtype), (0, pos, 0, 0))
         cv = jax.lax.dynamic_update_slice(
             cache_v, v.astype(cache_v.dtype), (0, pos, 0, 0))
-        ck_full = _expand_kv(ck, cfg)
-        cv_full = _expand_kv(cv, cfg)
-        scores = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
-                            ck_full.astype(jnp.float32)) / (head_dim ** 0.5)
-        visible = jnp.arange(s_cache)[None, None, None, :] <= pos
+        b, s_q, n_heads, _ = q.shape
+        kv_heads = ck.shape[2]
+        rep = n_heads // kv_heads
+        qg = q.reshape(b, s_q, kv_heads, rep, head_dim)
+        scores = jnp.einsum("bqgrd,bkgd->bgrqk", qg.astype(jnp.float32),
+                            ck.astype(jnp.float32)) / (head_dim ** 0.5)
+        visible = jnp.arange(s_cache)[None, None, None, None, :] <= pos
         scores = jnp.where(visible, scores, -1e30)
         probs = jax.nn.softmax(scores, axis=-1)
-        o = jnp.einsum("bhqk,bkhd->bqhd", probs,
-                       cv_full.astype(jnp.float32)).astype(q.dtype)
-        return o, (ck, cv)
+        o = jnp.einsum("bgrqk,bkgd->bqgrd", probs,
+                       cv.astype(jnp.float32)).astype(q.dtype)
+        return o.reshape(b, s_q, n_heads, head_dim), (ck, cv)
 
     x, _, (cache_k, cache_v) = _block_core(
         bp, x, cfg, attend,
