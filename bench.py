@@ -18,8 +18,14 @@ Prints exactly ONE JSON line:
      "vs_baseline": N, "mfu": N,
      "gpt_tokens_per_sec": N, "gpt_mfu": N}
 
-Env knobs: BENCH_BATCH, BENCH_STEPS, BENCH_IMAGE (side),
-BENCH_SKIP_TORCH, BENCH_SKIP_GPT.
+Env knobs — shapes: BENCH_BATCH, BENCH_STEPS, BENCH_IMAGE (side),
+BENCH_GPT_BATCH, BENCH_GPT_LONG_BATCH, BENCH_UNET_BATCH; skips:
+BENCH_SKIP_TORCH/GPT/GPT_LONG/LOADER/UNET; A/B variants (see
+scripts/run_ab.py, which drains them through `--sub` children):
+BENCH_FUSED, BENCH_S2D, BENCH_NF (ResNet), BENCH_GPT_CHUNKED,
+BENCH_GPT_REMAT=0, BENCH_GPT_POS=rope, BENCH_GPT_MLP=swiglu,
+BENCH_GPT_KV_HEADS, BENCH_GPT_LONG_KV_HEADS, BENCH_LOADER_MODE/WORKERS;
+deadlines: BENCH_SUB_DEADLINE or BENCH_DEADLINE_<name>.
 """
 from __future__ import annotations
 
