@@ -54,11 +54,13 @@ def run(norm: str, epochs: int = 3, batch: int = 64) -> dict:
         xs.append(x); ys.append(y)
     X = jnp.asarray(np.stack(xs)); Y = jnp.asarray(np.stack(ys))
     t0 = time.time()
+    train_loss = float("nan")
     for ep in range(epochs):
         perm = np.random.RandomState(ep).permutation(n)
         for s0 in range(0, n - batch + 1, batch):
             idx = perm[s0:s0 + batch]
             state, m = step(state, {"x": X[idx], "y": Y[idx]})
+        train_loss = float(m["loss"])
     xs, ys = [], []
     for i in range(len(test)):
         x, y = test[i]
@@ -71,8 +73,8 @@ def run(norm: str, epochs: int = 3, batch: int = 64) -> dict:
                       jax.random.PRNGKey(0))
         accs.append(float(m["acc"]))
     out = {"norm": norm, "epochs": epochs,
-           "train_loss": float(m["loss"]),
-           "test_acc": round(float(np.mean(accs)), 4),
+           "train_loss": train_loss,
+           "test_acc": round(float(np.mean(accs)), 4) if accs else None,
            "seconds": round(time.time() - t0, 1)}
     os.makedirs(os.path.dirname(OUT), exist_ok=True)
     with open(OUT, "a") as f:

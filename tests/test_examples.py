@@ -353,7 +353,7 @@ def test_gpt_long_yaml_resolves_and_trains_tiny(monkeypatch, tmp_path):
 
     gpt = load_example(monkeypatch, "lm", "gpt")
     conf = gpt.Config.load("gpt-long.yml")
-    assert conf.model.pos == "rope" and conf.model.n_kv_heads == 4
+    assert conf.model.pos == "rope" and conf.model.n_kv_heads == 8
     assert conf.model.seq_len == 8192 and conf.env.mesh == "sp:8"
     assert conf.optim.decay_matrices_only
 

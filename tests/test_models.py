@@ -736,3 +736,26 @@ def test_gpt_gqa_sequence_parallel_matches_single():
         np.testing.assert_allclose(np.asarray(sharded),
                                    np.asarray(single), rtol=2e-3,
                                    atol=2e-3, err_msg=strategy)
+
+
+def test_unet_sharding_rules_flip():
+    """The one-switch contract extends to the diffusion family: on an
+    fsdp mesh, UNet conv kernels shard their output channels and the
+    time-MLP kernels their input dim; label embedding replicates."""
+    from jax.sharding import PartitionSpec as P
+
+    from torchbooster_tpu.distributed import make_mesh
+    from torchbooster_tpu.models.unet import UNet, UNetConfig
+    from torchbooster_tpu.parallel import shard_params
+
+    cfg = UNetConfig(in_channels=1, base=16, mults=(1, 2), time_dim=32,
+                     n_classes=4)
+    params = UNet.init(jax.random.PRNGKey(0), cfg)
+    mesh = make_mesh("dp:2,fsdp:4")
+    placed = shard_params(params, mesh, UNet.SHARDING_RULES)
+    assert placed["stem"]["kernel"].sharding.spec \
+        == P(None, None, None, "fsdp")
+    assert placed["down0_a"]["conv1"]["kernel"].sharding.spec \
+        == P(None, None, None, "fsdp")
+    assert placed["time_mlp1"]["kernel"].sharding.spec == P("fsdp", None)
+    assert not any(placed["label_emb"]["table"].sharding.spec)

@@ -131,7 +131,7 @@ def _block_init(rng: jax.Array, cfg: GPTConfig, dtype: Any) -> dict:
         # count at the gelu MLP's, rounded up to a multiple of 8 so the
         # tp rule divides (and lanes stay aligned); the extra key is
         # fold_in-derived so gelu/MoE init streams stay bit-identical
-        hs = max(-(-2 * h // 3) // 8 * 8, 8)
+        hs = max((-(-2 * h // 3) + 7) // 8 * 8, 8)
         block.update({
             "mlp_fc1": L.dense_init(ks[2], d, hs, std=0.02, dtype=dtype),
             "mlp_fc3": L.dense_init(jax.random.fold_in(ks[2], 1), d, hs,

@@ -21,6 +21,7 @@ from typing import Any
 
 import jax
 import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
 
 from torchbooster_tpu.models import layers as L
 
@@ -74,11 +75,25 @@ def _resblock(bp, x, temb):
     return x + h
 
 
+# FSDP/ZeRO layout for the config front door (EnvConfig.make): conv
+# kernels shard the output-channel dim, the dense time projections
+# their input dim; dp-only meshes filter these away → replication.
+SHARDING_RULES = [
+    (r"time_mlp[12]/kernel", P("fsdp", None)),
+    (r"time_proj/kernel", P("fsdp", None)),
+    # every remaining kernel is a 4-d conv (stem, res conv1/2, skip,
+    # *_pool, up*_conv, out_conv)
+    (r"kernel", P(None, None, None, "fsdp")),
+    (r".*", P()),
+]
+
+
 class UNet:
     """``init(rng, cfg)`` → params; ``apply(params, x, t, cfg)`` →
     predicted noise ε with x's shape. ``t`` is (B,) integer steps."""
 
     Config = UNetConfig
+    SHARDING_RULES = SHARDING_RULES
 
     @staticmethod
     def init(rng: jax.Array, cfg: UNetConfig = UNetConfig(),
