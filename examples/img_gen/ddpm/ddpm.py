@@ -80,10 +80,16 @@ class Config(BaseConfig):
 
 
 def to_unit(images: jax.Array) -> jax.Array:
-    """Pixels → [−1, 1] (the DDPM data range)."""
+    """Pixels → [−1, 1] (the DDPM data range).
+
+    Integer inputs are raw [0, 255] pixels; float inputs are assumed
+    already normalized to [0, 1] (the loader convention). Both map
+    linearly — the ε-objective wants a symmetric data range, so no
+    squashing nonlinearity here; clip guards loaders that hand us
+    float pixels slightly outside [0, 1]."""
     if jnp.issubdtype(images.dtype, jnp.integer):
         return images.astype(jnp.float32) / 127.5 - 1.0
-    return jnp.tanh(images.astype(jnp.float32))
+    return jnp.clip(images.astype(jnp.float32) * 2.0 - 1.0, -1.0, 1.0)
 
 
 def unpack(batch):
@@ -128,6 +134,11 @@ def main(conf: Config) -> dict:
         return loss, {}
 
     params = conf.env.make(UNet.init(rng, cfg), model=UNet)
+    # n_iter: 0 in YAML means "the real run length" — epochs × steps per
+    # epoch. A hardcoded shorter value pins the LR at lr*final_multiplier
+    # (≈ 0) for the whole tail of training.
+    if conf.scheduler.n_iter <= 0:
+        conf.scheduler.n_iter = conf.epochs * max(len(loader), 1)
     schedule = conf.scheduler.make(conf.optim)
     tx = conf.optim.make(schedule)
     state = utils.TrainState.create(params, tx, rng=rng,
