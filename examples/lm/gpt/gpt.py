@@ -59,6 +59,7 @@ class ModelConfig(BaseConfig):
     sp_strategy: str = "auto"
     pos: str = "learned"            # position encoding: learned | rope
     mlp: str = "gelu"               # MLP flavor: gelu | swiglu
+    dropout: float = 0.0            # residual/embedding dropout (train)
 
     def make(self) -> GPTConfig:
         return GPTConfig(vocab=self.vocab, n_layers=self.n_layers,
@@ -66,7 +67,7 @@ class ModelConfig(BaseConfig):
                          n_kv_heads=self.n_kv_heads,
                          seq_len=self.seq_len, n_experts=self.n_experts,
                          sp_strategy=self.sp_strategy, pos=self.pos,
-                         mlp=self.mlp)
+                         mlp=self.mlp, dropout=self.dropout)
 
 
 @dataclass
@@ -112,18 +113,27 @@ def main(conf: Config) -> dict:
                               distributed=conf.env.distributed,
                               seed=conf.seed)
 
-    def loss_fn(params, batch, rng):
-        del rng
+    def _loss(params, batch, dropout_rng):
         ids, labels = batch["ids"], batch["labels"]
         logits, aux = GPT.apply(params, ids, cfg=cfg, mesh=mesh,
                                 compute_dtype=conf.env.compute_dtype(),
-                                remat=conf.model.remat, return_aux=True)
+                                remat=conf.model.remat, return_aux=True,
+                                dropout_rng=dropout_rng)
         loss = cross_entropy(logits, labels)
         metrics = {"ppl": jax.numpy.exp(loss)}
         if cfg.n_experts:
             metrics["aux"] = aux
             loss = loss + conf.model.aux_weight * aux
         return loss, metrics
+
+    def loss_fn(params, batch, rng):
+        # make_step splits a fresh rng per step → per-step dropout masks
+        # (identity when model.dropout is 0)
+        return _loss(params, batch, rng)
+
+    def eval_loss_fn(params, batch, rng):
+        del rng                       # eval forward stays deterministic
+        return _loss(params, batch, None)
 
     schedule = conf.scheduler.make(conf.optim)
     tx = conf.optim.make(schedule)
@@ -191,7 +201,7 @@ def main(conf: Config) -> dict:
     if conf.eval_batches > 0:
         # held-out perplexity on the VALIDATION split (text_file keeps
         # it disjoint from train/test; synthetic_lm reseeds per split)
-        eval_step = utils.make_eval_step(loss_fn)
+        eval_step = utils.make_eval_step(eval_loss_fn)
         eval_loader = conf.loader.make(
             conf.dataset.make(Split.VALIDATION, seq_len=cfg.seq_len + 1,
                               vocab=cfg.vocab),

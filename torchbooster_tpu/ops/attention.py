@@ -64,6 +64,19 @@ def _on_tpu() -> bool:
     return "tpu" in kind.lower() or backend == "axon"
 
 
+def flash_auto_engaged(seq_len_q: int, seq_len_kv: int | None = None) -> bool:
+    """THE predicate ``attention(impl="auto")`` evaluates — exposed so
+    callers (bench.py's dispatch assertion and its ``flash_engaged``
+    JSON flag) test the real dispatch rather than a lookalike check
+    that can drift from it (the r3 silent-reference-path failure)."""
+    from torchbooster_tpu.ops.flash_attention import tileable
+
+    if seq_len_kv is None:
+        seq_len_kv = seq_len_q
+    return (_on_tpu() and seq_len_q >= 4096
+            and tileable(seq_len_q) and tileable(seq_len_kv))
+
+
 def attention(q: jax.Array, k: jax.Array, v: jax.Array,
               causal: bool = True, sm_scale: float | None = None,
               impl: str = "auto") -> jax.Array:
@@ -75,11 +88,8 @@ def attention(q: jax.Array, k: jax.Array, v: jax.Array,
     score materialization thrashes HBM); below that XLA's fused
     reference is faster. Off-TPU always reference."""
     if impl == "auto":
-        from torchbooster_tpu.ops.flash_attention import tileable
-
-        use_flash = (_on_tpu() and q.shape[1] >= 4096
-                     and tileable(q.shape[1]) and tileable(k.shape[1]))
-        impl = "flash" if use_flash else "reference"
+        impl = ("flash" if flash_auto_engaged(q.shape[1], k.shape[1])
+                else "reference")
     if impl == "reference":
         return mha_reference(q, k, v, causal, sm_scale)
 
@@ -99,4 +109,5 @@ def attention(q: jax.Array, k: jax.Array, v: jax.Array,
     return out.reshape(b, h, s_q, d).transpose(0, 2, 1, 3)
 
 
-__all__ = ["attention", "expand_kv_heads", "mha_reference"]
+__all__ = ["attention", "expand_kv_heads", "flash_auto_engaged",
+           "mha_reference"]

@@ -14,6 +14,23 @@ per tick.
 Differentiable end to end: ``jax.grad`` through the kernel yields the
 reverse schedule automatically (ppermute transposes to the reverse
 ring), so ``pipeline_apply`` drops into a jitted train step unchanged.
+
+Cost model (honest limits at scale):
+
+- **Inactive-tick compute**: every stage runs its layers on every tick
+  and discards inactive results via ``jnp.where`` — SPMD has one
+  program, so the bubble ticks still burn MXU. Overhead factor is
+  (m + P − 1)/m of the ideal schedule's FLOPs: ~2× at m = P (the
+  default), amortizing to +12.5% at m = 8P. Raise ``n_microbatches``
+  to buy efficiency with smaller per-microbatch matmuls.
+- **Epilogue broadcast**: finished microbatches live on the last
+  stage; the mask + ``psum`` broadcasts the (B, ...) output across the
+  pp axis — one all-reduce of the output activation per call. For
+  LM training (output feeds a loss computed identically everywhere)
+  this is the layout jit wants anyway; a ``ppermute``-to-stage-0
+  epilogue would save ICI bytes when only one host consumes the
+  result. Measured at dryrun scale this is noise; revisit against a
+  profile before hand-optimizing.
 """
 from __future__ import annotations
 
@@ -31,13 +48,14 @@ except ImportError:  # pragma: no cover
 
 
 def pipeline_apply(
-    layer_fn: Callable[[Any, jax.Array], jax.Array],
+    layer_fn: Callable[..., jax.Array],
     stacked_params: Any,
     x: jax.Array,
     mesh: Mesh,
     axis: str = "pp",
     n_microbatches: int | None = None,
     batch_axes: tuple[str, ...] | None = None,
+    with_mb_index: bool = False,
 ) -> jax.Array:
     """Run ``layer_fn`` over ``L`` stacked layers, pipelined over the
     mesh's ``axis``.
@@ -47,6 +65,12 @@ def pipeline_apply(
     ``(B, ...)``; it is split into ``n_microbatches`` (default: the
     pipeline depth) along axis 0. ``B`` must divide evenly and ``L``
     must divide the ``axis`` size.
+
+    ``with_mb_index=True`` calls ``layer_fn(layer_params, x, mb_index)``
+    with the (traced) index of the microbatch being processed — for
+    per-microbatch state like independent dropout streams (without it,
+    stochastic layers would draw IDENTICAL noise for every microbatch,
+    noise the un-pipelined full-batch forward draws independently).
 
     ``batch_axes`` are the mesh axes the per-microbatch batch dimension
     shards over — default: whichever of ``dp``/``fsdp`` the mesh has.
@@ -88,8 +112,10 @@ def pipeline_apply(
         stage = jax.lax.axis_index(axis)
         right = [(j, (j + 1) % n_stages) for j in range(n_stages)]
 
-        def run_stage(carry_x: jax.Array) -> jax.Array:
+        def run_stage(carry_x: jax.Array, mb_idx: jax.Array) -> jax.Array:
             def one(carry, layer_params):
+                if with_mb_index:
+                    return layer_fn(layer_params, carry, mb_idx), None
                 return layer_fn(layer_params, carry), None
 
             out, _ = jax.lax.scan(one, carry_x, stage_params)
@@ -104,7 +130,7 @@ def pipeline_apply(
             fresh = jax.lax.dynamic_index_in_dim(
                 x_mb, jnp.clip(t, 0, m - 1), 0, keepdims=False)
             x_in = jnp.where(stage == 0, fresh, held)
-            y = run_stage(x_in)
+            y = run_stage(x_in, jnp.clip(mb_index, 0, m - 1))
             y = jnp.where(active, y, x_in)
             # the final stage banks its finished microbatch
             write = active & (stage == n_stages - 1)
