@@ -4,8 +4,10 @@ Long-context story (SURVEY §5.7 notes the reference has none; here it
 is first-class). The sequence axis of q/k/v is sharded over the mesh's
 ``sp`` axis; each device holds an S/sp slice. K/V blocks rotate around
 the ring with ``ppermute`` while each device folds every visiting block
-into its local queries' online-softmax state — attention memory stays
-O(S·S/sp²) per device and the (S, S) score matrix never exists.
+into its local queries' online-softmax state — and each visiting block
+is itself consumed in ``block_k``-wide flash-style slices, so the live
+score buffer is O(S/sp · block_k) per device: neither the (S, S)
+matrix nor the (S/sp, S/sp) local block ever exists.
 
 The ppermute for step t+1 is issued *before* step t's matmuls so XLA
 can overlap the ICI transfer with MXU work (the ring-attention
@@ -26,25 +28,51 @@ from jax import shard_map
 NEG_INF = -1e30
 
 
+def _largest_divisor_block(s_loc: int, target: int) -> int:
+    """Largest block size <= target that divides s_loc (static shapes:
+    runs at trace time)."""
+    blk = min(target, s_loc)
+    while s_loc % blk:
+        blk -= 1
+    return blk
+
+
 def _ring_local(q: jax.Array, k: jax.Array, v: jax.Array, *, axis: str,
                 sp_size: int, causal: bool, sm_scale: float,
-                rep: int = 1) -> jax.Array:
+                rep: int = 1, block_k: int = 512) -> jax.Array:
     """Per-device body under shard_map: q (B, S_loc, H, D) and k/v
     (B, S_loc, H/rep, D) local chunks; global chunk id = axis_index.
     Grouped K/V (rep > 1, GQA) circulate the ring UN-expanded — rep×
     less ppermute traffic — and expand only inside each block's
-    matmuls."""
+    matmuls.
+
+    The local attention against each visiting K/V chunk is ITSELF
+    blocked (flash-style): an inner loop folds ``block_k``-wide slices
+    through the online-softmax recurrence, so the live score buffer is
+    (B, H, S_loc, block_k) instead of (B, H, S_loc, S_loc). At the
+    extreme-S regimes where ring is the only applicable strategy (few
+    heads), this caps the FORWARD's per-device HBM at
+    O(S_loc·block_k) per ring step rather than the quadratic local
+    block (VERDICT r3 weak #7). For the BACKWARD, the inner body is
+    ``jax.checkpoint``ed so reverse-mode AD recomputes each block's
+    scores instead of saving them across the scan — what remains saved
+    per inner step is the (m, l, acc) carry, O(S_loc·d) per block
+    (Σ = O(S_loc²·d/block_k) per ring step): a block_k/d-fold
+    reduction over the unblocked residuals, not full flash-style O(S)
+    — that needs the custom-VJP pallas kernel (ops/flash_attention)."""
     b, s_loc, h, d = q.shape
     my_chunk = lax.axis_index(axis)
     perm = [(j, (j + 1) % sp_size) for j in range(sp_size)]
+    blk = _largest_divisor_block(s_loc, block_k)
+    n_blocks = s_loc // blk
 
     qf = q.astype(jnp.float32) * sm_scale
     m = jnp.full((b, h, s_loc), NEG_INF, jnp.float32)
     l = jnp.zeros((b, h, s_loc), jnp.float32)
     acc = jnp.zeros((b, s_loc, h, d), jnp.float32)
 
-    iq = lax.broadcasted_iota(jnp.int32, (s_loc, s_loc), 0)
-    ik = lax.broadcasted_iota(jnp.int32, (s_loc, s_loc), 1)
+    iq = lax.broadcasted_iota(jnp.int32, (s_loc, blk), 0)
+    ik = lax.broadcasted_iota(jnp.int32, (s_loc, blk), 1)
 
     def step(t, carry):
         k_t, v_t, m_prev, l_prev, acc_prev = carry
@@ -55,33 +83,50 @@ def _ring_local(q: jax.Array, k: jax.Array, v: jax.Array, *, axis: str,
         src_chunk = (my_chunk - t) % sp_size
 
         def attend(kv):
-            k_blk, v_blk = kv
-            if rep > 1:
-                k_blk = jnp.repeat(k_blk, rep, axis=2)
-                v_blk = jnp.repeat(v_blk, rep, axis=2)
-            scores = jnp.einsum("bqhd,bkhd->bhqk", qf,
-                                k_blk.astype(jnp.float32))
-            if causal:
-                # src < mine: fully visible; src == mine: lower triangle
-                # (src > mine never reaches here — skipped below)
-                tri = iq >= ik
-                visible = jnp.where(src_chunk == my_chunk, tri, True)
-                mask = jnp.broadcast_to(visible, scores.shape)
-            else:
-                mask = jnp.ones_like(scores, bool)
+            k_chunk, v_chunk = kv
 
-            scores = jnp.where(mask, scores, NEG_INF)
-            m_cur = jnp.maximum(m_prev, scores.max(axis=-1))
-            correction = jnp.exp(m_prev - m_cur)
-            # multiply by mask so masked rows contribute exactly 0
-            # (avoids exp(-inf − -inf) = 1 poisoning)
-            p = jnp.exp(scores - m_cur[..., None]) * mask
-            l_cur = l_prev * correction + p.sum(axis=-1)
-            pv = jnp.einsum("bhqk,bkhd->bqhd", p,
-                            v_blk.astype(jnp.float32))
-            acc_cur = (acc_prev * correction.transpose(0, 2, 1)[..., None]
-                       + pv)
-            return m_cur, l_cur, acc_cur
+            # checkpointed: under reverse-mode AD the fori_loop becomes
+            # a scan that would save each block's (S_loc, blk) scores/p
+            # as residuals — Σ O(S_loc²) again; remat recomputes them
+            # from (qf, k_blk, v_blk) and saves only the carry
+            @jax.checkpoint
+            def block_math(st, j, k_blk, v_blk):
+                m_p, l_p, acc_p = st
+                if rep > 1:
+                    k_blk = jnp.repeat(k_blk, rep, axis=2)
+                    v_blk = jnp.repeat(v_blk, rep, axis=2)
+                scores = jnp.einsum("bqhd,bkhd->bhqk", qf,
+                                    k_blk.astype(jnp.float32))
+                if causal:
+                    # src < mine: fully visible; src == mine: lower
+                    # triangle against this k-block's global column
+                    # offset (src > mine never reaches here)
+                    tri = iq >= ik + j * blk
+                    visible = jnp.where(src_chunk == my_chunk, tri, True)
+                    mask = jnp.broadcast_to(visible, scores.shape)
+                else:
+                    mask = jnp.ones_like(scores, bool)
+
+                scores = jnp.where(mask, scores, NEG_INF)
+                m_cur = jnp.maximum(m_p, scores.max(axis=-1))
+                correction = jnp.exp(m_p - m_cur)
+                # multiply by mask so masked rows contribute exactly 0
+                # (avoids exp(-inf − -inf) = 1 poisoning)
+                p = jnp.exp(scores - m_cur[..., None]) * mask
+                l_cur = l_p * correction + p.sum(axis=-1)
+                pv = jnp.einsum("bhqk,bkhd->bqhd", p,
+                                v_blk.astype(jnp.float32))
+                acc_cur = (acc_p * correction.transpose(0, 2, 1)[..., None]
+                           + pv)
+                return m_cur, l_cur, acc_cur
+
+            def kb(j, st):
+                k_blk = lax.dynamic_slice_in_dim(k_chunk, j * blk, blk, 1)
+                v_blk = lax.dynamic_slice_in_dim(v_chunk, j * blk, blk, 1)
+                return block_math(st, j, k_blk, v_blk)
+
+            return lax.fori_loop(0, n_blocks, kb,
+                                 (m_prev, l_prev, acc_prev))
 
         if causal:
             # a wrapped-future block (src > mine) is fully masked: its
@@ -104,7 +149,8 @@ def _ring_local(q: jax.Array, k: jax.Array, v: jax.Array, *, axis: str,
 def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
                    causal: bool = True,
                    sm_scale: float | None = None,
-                   axis: str = "sp") -> jax.Array:
+                   axis: str = "sp",
+                   block_k: int = 512) -> jax.Array:
     """Exact attention over (B, S, H, D) with S sharded on ``axis``.
 
     Drop-in for :func:`torchbooster_tpu.ops.attention.attention` when the
@@ -113,6 +159,8 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
     projection's output sharding. K/V may carry fewer (grouped, GQA)
     heads than q — they ride the ring grouped and expand per block —
     as long as the grouped head count still divides ``tp``.
+    ``block_k`` bounds the inner flash-style slice width (clamped to
+    the largest divisor of the local chunk length).
     """
     *_, n_heads, head_dim = q.shape
     kv_heads = k.shape[2]
@@ -133,7 +181,7 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
 
     body = functools.partial(_ring_local, axis=axis, sp_size=sp_size,
                              causal=causal, sm_scale=sm_scale,
-                             rep=n_heads // kv_heads)
+                             rep=n_heads // kv_heads, block_k=block_k)
     fn = shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
                    out_specs=spec, check_vma=False)
     return fn(q, k, v)
