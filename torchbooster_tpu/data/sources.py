@@ -11,6 +11,9 @@ torchvision/torchtext have no role here; instead:
    a zero-egress environment;
 2. **local record store** — ``root/<split>.bstore`` built by
    ``BaseDataset.prepare`` (or any BoosterStore file);
+2b. **local IDX files** — for ``mnist``, the standard LeCun IDX files
+   under ``root`` (data/idx.py) resolve before any network path, so
+   the real dataset trains in a zero-egress environment;
 3. **HuggingFace ``datasets``** — by name (+ ``task`` as config name),
    with the reference's 80/20 train-split fallback when a dataset lacks
    a test split (ref config.py:589-614); real ``mnist``/``cifar10``
@@ -162,6 +165,17 @@ def _text_file(conf: Any, split: Split, seq_len: int = 256,
     return ArrayDataset(windows)
 
 
+@register_dataset("mnist_idx")
+def _mnist_idx(conf: Any, split: Split, **kw):
+    """Real MNIST from standard IDX files under ``root`` (no network,
+    no HF — data/idx.py). TEST and VALIDATION both read the t10k
+    files (MNIST ships no validation split; documented alias)."""
+    from torchbooster_tpu.data.idx import load_mnist_idx
+
+    images, labels = load_mnist_idx(conf.root, train=split == Split.TRAIN)
+    return ArrayDataset(images, labels)
+
+
 @register_dataset("synthetic_lm")
 def _synthetic_lm(conf: Any, split: Split, seq_len: int = 256,
                   vocab: int = 1_024, **kw):
@@ -281,7 +295,16 @@ def resolve_dataset(conf: Any, split: Split | str, download: bool = True,
         if Path(store).exists():
             dataset = StoreDataset(conf.root, split)
         else:
-            dataset = _try_huggingface(conf, split)
+            dataset = None
+            if name == "mnist":
+                # real IDX files dropped under root win over the
+                # network path — the zero-egress real-data route
+                from torchbooster_tpu.data.idx import mnist_idx_available
+
+                if mnist_idx_available(conf.root):
+                    dataset = _REGISTRY["mnist_idx"](conf, split, **kwargs)
+            if dataset is None:
+                dataset = _try_huggingface(conf, split)
             if dataset is None and name in _SYNTHETIC_TWINS:
                 logging.warning(
                     "dataset %r unavailable (offline?); using %s stand-in",
