@@ -101,6 +101,11 @@ def main(conf: Config) -> dict:
     # params replicated over the mesh (the DDP-broadcast analogue,
     # ref conf.env.make(model) lenet.py:42)
     params = conf.env.make(LeNet.init(rng), model=LeNet)
+    # n_iter: 0 in YAML = the real run length (epochs × steps/epoch) —
+    # a stale constant would pin the LR at ~lr*final_multiplier for the
+    # whole tail once a real-sized dataset (MNIST IDX) resolves
+    if conf.scheduler.n_iter <= 0:
+        conf.scheduler.n_iter = conf.epochs * max(len(train_loader), 1)
     schedule = conf.scheduler.make(conf.optim)
     tx = conf.optim.make(schedule)
     state = utils.TrainState.create(params, tx, rng=rng)
@@ -125,15 +130,37 @@ def main(conf: Config) -> dict:
     return results
 
 
+def sweep(path: str = "lenet-sweep.yml") -> list[dict]:
+    """Sequential hyperparameter sweep: one full ``main`` run per
+    config the sweep grammar generates (ref config.py:274-301's
+    ``hyperparams=True`` odometer loop). Returns one result dict per
+    point, tagged with the swept lr so outcomes are comparable."""
+    outcomes = []
+    for conf in Config.load(path, hyperparams=True):
+        if dist.is_primary():
+            print(f"sweep point: lr={conf.optim.lr}")
+        results = main(conf)
+        outcomes.append({"lr": conf.optim.lr, **results})
+    if dist.is_primary():
+        best = max(outcomes, key=lambda r: r.get("test_acc", 0.0))
+        print({"best_lr": best["lr"], "best_test_acc": best["test_acc"]})
+    return outcomes
+
+
 if __name__ == "__main__":
-    # ref lenet.py:111-124: hardcoded config path, seed, boost, launch
-    conf = Config.load("lenet.yml")
+    import sys
+
     utils.boost()
-    dist.launch(
-        main,
-        conf.env.n_devices,
-        conf.env.n_machine,
-        conf.env.machine_rank,
-        conf.env.dist_url,
-        args=(conf,),
-    )
+    if "--sweep" in sys.argv:
+        sweep()
+    else:
+        # ref lenet.py:111-124: hardcoded config path, seed, boost, launch
+        conf = Config.load("lenet.yml")
+        dist.launch(
+            main,
+            conf.env.n_devices,
+            conf.env.n_machine,
+            conf.env.machine_rank,
+            conf.env.dist_url,
+            args=(conf,),
+        )
