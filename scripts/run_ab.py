@@ -28,7 +28,13 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 OUT = os.path.join(REPO, "logs", "ab_results.jsonl")
 
 sys.path.insert(0, REPO)
-from bench import _first_json_line, _probe_tpu, _run_group  # noqa: E402
+from bench import (  # noqa: E402
+    _AB_GPT_VARIANTS,
+    _AB_RESNET_VARIANTS,
+    _first_json_line,
+    _probe_tpu,
+    _run_group,
+)
 
 # name -> (sub-bench, env overrides, deadline seconds). Deadlines are
 # generous: first-compile on the tunnel is slow, and the pallas paths
@@ -54,7 +60,19 @@ QUEUE: list[tuple[str, str, dict, int]] = [
     ("unet", "unet", {}, 1200),
     ("loader_thread", "loader", {}, 1200),
     ("loader_process", "loader", {"BENCH_LOADER_MODE": "process"}, 1200),
+    # serving: KV-cache decode tokens/s, MHA vs GQA cache width at
+    # 1k/8k cache (bench.bench_decode; VERDICT r3 missing #4)
+    ("decode", "decode", {}, 1800),
 ]
+
+# bench.py's gate-flip tables (_ab_best) re-run the recorded winner by
+# these names/knobs — any drift between the two silently breaks the
+# headline's variant pick, so fail fast at watcher start instead.
+_QUEUE_ENV = {name: env for name, _, env, _ in QUEUE}
+for _name, _env in {**_AB_RESNET_VARIANTS, **_AB_GPT_VARIANTS}.items():
+    assert _QUEUE_ENV.get(_name) == _env, (
+        f"bench.py A/B variant {_name!r} ({_env}) out of sync with "
+        f"run_ab.py QUEUE ({_QUEUE_ENV.get(_name)})")
 
 def log(msg: str) -> None:
     print(f"[{time.strftime('%H:%M:%S')}] {msg}", flush=True)
