@@ -116,6 +116,76 @@ def test_ring_attention_blocked_inner_loop(causal):
             err_msg=f"d{name} (causal={causal})")
 
 
+@pytest.mark.parametrize("causal", [True, False])
+def test_ring_flash_matches_reference(causal):
+    """Ring x flash: the pallas kernel as the per-chunk body with
+    log-sum-exp chunk merging — fwd must equal plain attention across
+    chunk boundaries (interpret mode: same kernel code path, CPU)."""
+    mesh = make_mesh("dp:2,sp:4")
+    q, k, v = _qkv(jax.random.PRNGKey(11), b=2, s=256, h=2, d=16)
+    ref = mha_reference(q, k, v, causal=causal)
+    with mesh:
+        out = ring_attention(q, k, v, mesh, causal=causal,
+                             impl="flash_interpret")
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-3, atol=2e-3)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_ring_flash_grads_match_reference(causal):
+    """The ring-flash backward: each chunk's pallas backward consumes
+    the GLOBAL (out, lse) and dK/dV accumulators rotate home with
+    their chunks — grads must equal autodiff through the reference."""
+    mesh = make_mesh("dp:2,sp:4")
+    q, k, v = _qkv(jax.random.PRNGKey(12), b=2, s=128, h=2, d=16)
+
+    def loss(fn):
+        return lambda q, k, v: (fn(q, k, v) ** 2).sum()
+
+    ref = jax.grad(loss(lambda q, k, v: mha_reference(
+        q, k, v, causal=causal)), argnums=(0, 1, 2))(q, k, v)
+    with mesh:
+        got = jax.grad(loss(lambda q, k, v: ring_attention(
+            q, k, v, mesh, causal=causal, impl="flash_interpret")),
+            argnums=(0, 1, 2))(q, k, v)
+    for name, r, g in zip("qkv", ref, got):
+        np.testing.assert_allclose(
+            np.asarray(g), np.asarray(r), rtol=2e-3, atol=2e-3,
+            err_msg=f"d{name} (causal={causal})")
+
+
+def test_ring_flash_grouped_kv():
+    """GQA through ring-flash: grouped K/V circulate the ring at their
+    own width and the kernel indexes grouped tiles — fwd + grouped-
+    width dK/dV parity vs the expanded reference."""
+    mesh = make_mesh("dp:2,sp:4")
+    ks = jax.random.split(jax.random.PRNGKey(13), 3)
+    q = jax.random.normal(ks[0], (2, 128, 4, 16))
+    k = jax.random.normal(ks[1], (2, 128, 2, 16))
+    v = jax.random.normal(ks[2], (2, 128, 2, 16))
+    ref = mha_reference(q, k, v, causal=True)
+    with mesh:
+        out = ring_attention(q, k, v, mesh, causal=True,
+                             impl="flash_interpret")
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-3, atol=2e-3)
+
+    def loss(fn):
+        return lambda q, k, v: (fn(q, k, v) ** 2).sum()
+
+    refg = jax.grad(loss(lambda q, k, v: mha_reference(
+        q, k, v, causal=True)), argnums=(0, 1, 2))(q, k, v)
+    with mesh:
+        got = jax.grad(loss(lambda q, k, v: ring_attention(
+            q, k, v, mesh, causal=True, impl="flash_interpret")),
+            argnums=(0, 1, 2))(q, k, v)
+    for name, r, g in zip("qkv", refg, got):
+        assert g.shape == r.shape, f"d{name} width"
+        np.testing.assert_allclose(
+            np.asarray(g), np.asarray(r), rtol=2e-3, atol=3e-3,
+            err_msg=f"d{name}")
+
+
 @pytest.mark.slow
 def test_ring_attention_32k_grad_bounded_memory():
     """The extreme-S regime ring exists for (VERDICT r3 weak #7):

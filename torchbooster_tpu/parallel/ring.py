@@ -146,11 +146,183 @@ def _ring_local(q: jax.Array, k: jax.Array, v: jax.Array, *, axis: str,
     return out.astype(q.dtype)
 
 
+# =========================================================================
+# Ring x flash: the pallas kernel as the per-chunk body
+# =========================================================================
+#
+# The blocked-XLA body above is exact and portable, but on TPU the hot
+# inner math should be the pallas flash kernel (ops/flash_attention):
+# per ring step each device runs the kernel's forward on (its queries x
+# the visiting K/V chunk) getting a NORMALIZED partial output plus its
+# logsumexp, and folds it into a running (out, lse) with the stable
+# log-sum-exp combine. The backward is the standard ring-flash trick:
+# save only (q, k_local, v_local, out, lse) — O(S/sp) per device — and
+# re-run the ring, feeding each chunk's pallas backward the GLOBAL
+# (out, lse, dout): probabilities recomputed against the global lse ARE
+# the global softmax columns, so per-chunk dq sum up exactly and dK/dV
+# accumulate in buffers that rotate alongside their chunk (arriving
+# home after the full cycle). No dlse term exists because lse is
+# consumed only as a residual, never as a differentiated output.
+
+def _rf_merge(out: jax.Array, lse: jax.Array, out_c: jax.Array,
+              lse_c: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Fold a chunk's normalized output+lse into the running pair.
+    Both lse's are finite: the running pair is initialized from the
+    always-visited diagonal chunk (where every causal row sees at
+    least itself), and fully-masked chunks are skipped."""
+    m = jnp.maximum(lse, lse_c)
+    w = jnp.exp(lse - m)
+    w_c = jnp.exp(lse_c - m)
+    denom = w + w_c
+    return (out * (w / denom)[..., None]
+            + out_c.astype(jnp.float32) * (w_c / denom)[..., None],
+            m + jnp.log(denom))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _ring_flash(qf, kf, vf, axis, sp_size, causal, sm_scale, interpret):
+    out, _ = _rf_forward(qf, kf, vf, axis, sp_size, causal, sm_scale,
+                         interpret)
+    return out
+
+
+def _rf_forward(qf, kf, vf, axis, sp_size, causal, sm_scale, interpret):
+    from torchbooster_tpu.ops.flash_attention import (_fwd_pallas,
+                                                      _pick_block)
+
+    bh, s_loc, _ = qf.shape
+    # blocks must divide the chunk length (a block larger than the
+    # chunk would give an empty grid and uninitialized outputs)
+    blk = _pick_block(1024, s_loc, "ring chunk")
+    my = lax.axis_index(axis)
+    perm = [(j, (j + 1) % sp_size) for j in range(sp_size)]
+
+    def run(k_t, v_t, causal_flag):
+        o, l = _fwd_pallas(
+            qf, k_t, v_t, causal=causal_flag, sm_scale=sm_scale,
+            block_q=blk, block_k=blk, interpret=interpret,
+            save_residuals=True)
+        return o, l[..., 0]
+
+    # t = 0 peeled: every device starts on its OWN (diagonal) chunk —
+    # the only step that needs the causal-kernel flavor — and it
+    # initializes (out, lse) directly, so the loop body is one
+    # non-causal kernel and the merge never sees a sentinel
+    k_t = lax.ppermute(kf, axis, perm)
+    v_t = lax.ppermute(vf, axis, perm)
+    out0, lse0 = run(kf, vf, causal)
+
+    def step(t, carry):
+        k_t, v_t, out, lse = carry
+        # rotate early: independent of the kernels below → overlappable
+        k_next = lax.ppermute(k_t, axis, perm)
+        v_next = lax.ppermute(v_t, axis, perm)
+        src = (my - t) % sp_size
+
+        def visit(_):
+            # src < my here (src == my only at t=0): fully visible
+            return _rf_merge(out, lse, *run(k_t, v_t, False))
+
+        if causal:
+            # wrapped-future chunk: fully masked — skip the kernel
+            out, lse = lax.cond(src > my, lambda _: (out, lse), visit,
+                                None)
+        else:
+            out, lse = visit(None)
+        return k_next, v_next, out, lse
+
+    _, _, out, lse = lax.fori_loop(
+        1, sp_size, step, (k_t, v_t, out0.astype(jnp.float32), lse0))
+    return out.astype(qf.dtype), lse
+
+
+def _rf_fwd(qf, kf, vf, axis, sp_size, causal, sm_scale, interpret):
+    out, lse = _rf_forward(qf, kf, vf, axis, sp_size, causal, sm_scale,
+                           interpret)
+    return out, (qf, kf, vf, out, lse)
+
+
+def _rf_bwd(axis, sp_size, causal, sm_scale, interpret, res, do):
+    from torchbooster_tpu.ops.flash_attention import (LANES, _bwd_pallas,
+                                                      _pick_block)
+
+    qf, kf, vf, out, lse = res
+    blk = _pick_block(1024, qf.shape[1], "ring chunk")
+    lse_b = jnp.broadcast_to(lse[..., None], (*lse.shape, LANES))
+    my = lax.axis_index(axis)
+    perm = [(j, (j + 1) % sp_size) for j in range(sp_size)]
+
+    def run(k_t, v_t, causal_flag):
+        return _bwd_pallas(
+            qf, k_t, v_t, out, lse_b, do, causal=causal_flag,
+            sm_scale=sm_scale, block_q=blk, block_k=blk,
+            interpret=interpret)
+
+    # t = 0 peeled, mirroring the forward: the diagonal chunk takes the
+    # causal-kernel flavor and initializes the accumulators
+    dq_c, dk_c, dv_c = run(kf, vf, causal)
+    carry = (lax.ppermute(kf, axis, perm),
+             lax.ppermute(vf, axis, perm),
+             lax.ppermute(dk_c.astype(jnp.float32), axis, perm),
+             lax.ppermute(dv_c.astype(jnp.float32), axis, perm),
+             dq_c.astype(jnp.float32))
+
+    def step(t, carry):
+        k_t, v_t, dk_t, dv_t, dq = carry
+        # rotate K/V early — independent of this step's kernels, so the
+        # ICI transfer overlaps the MXU work (dk/dv genuinely depend on
+        # the kernels and must rotate after)
+        k_next = lax.ppermute(k_t, axis, perm)
+        v_next = lax.ppermute(v_t, axis, perm)
+        src = (my - t) % sp_size
+
+        def visit(_):
+            dq_c, dk_c, dv_c = run(k_t, v_t, False)
+            return (dq + dq_c.astype(jnp.float32),
+                    dk_t + dk_c.astype(jnp.float32),
+                    dv_t + dv_c.astype(jnp.float32))
+
+        if causal:
+            dq, dk_t, dv_t = lax.cond(
+                src > my, lambda _: (dq, dk_t, dv_t), visit, None)
+        else:
+            dq, dk_t, dv_t = visit(None)
+        # grads rotate WITH their chunk: after the full cycle each dk/dv
+        # buffer has collected every device's contribution and is home
+        dk_t = lax.ppermute(dk_t, axis, perm)
+        dv_t = lax.ppermute(dv_t, axis, perm)
+        return k_next, v_next, dk_t, dv_t, dq
+
+    _, _, dk, dv, dq = lax.fori_loop(1, sp_size, step, carry)
+    return dq.astype(qf.dtype), dk.astype(kf.dtype), dv.astype(vf.dtype)
+
+
+_ring_flash.defvjp(_rf_fwd, _rf_bwd)
+
+
+def _ring_flash_local(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                      axis: str, sp_size: int, causal: bool,
+                      sm_scale: float, interpret: bool) -> jax.Array:
+    """shard_map body: fold heads into rows (group-contiguous, the
+    flash kernels' GQA convention — grouped K/V fold at their OWN
+    width and are indexed by ``row // rep`` in-kernel), run the ring,
+    unfold."""
+    b, s_loc, h, d = q.shape
+    h_kv = k.shape[2]
+    qf = q.transpose(0, 2, 1, 3).reshape(b * h, s_loc, d)
+    kf = k.transpose(0, 2, 1, 3).reshape(b * h_kv, s_loc, d)
+    vf = v.transpose(0, 2, 1, 3).reshape(b * h_kv, s_loc, d)
+    out = _ring_flash(qf, kf, vf, axis, sp_size, causal, sm_scale,
+                      interpret)
+    return out.reshape(b, h, s_loc, d).transpose(0, 2, 1, 3)
+
+
 def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
                    causal: bool = True,
                    sm_scale: float | None = None,
                    axis: str = "sp",
-                   block_k: int = 512) -> jax.Array:
+                   block_k: int = 512,
+                   impl: str = "auto") -> jax.Array:
     """Exact attention over (B, S, H, D) with S sharded on ``axis``.
 
     Drop-in for :func:`torchbooster_tpu.ops.attention.attention` when the
@@ -159,8 +331,15 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
     projection's output sharding. K/V may carry fewer (grouped, GQA)
     heads than q — they ride the ring grouped and expand per block —
     as long as the grouped head count still divides ``tp``.
-    ``block_k`` bounds the inner flash-style slice width (clamped to
+    ``block_k`` bounds the XLA body's inner slice width (clamped to
     the largest divisor of the local chunk length).
+
+    ``impl`` picks the per-chunk body: "flash" runs the pallas kernel
+    per visiting chunk with log-sum-exp merging and the ring-flash
+    backward (global-lse per-chunk gradients, O(S/sp) residuals);
+    "flash_interpret" is its CPU-debuggable mode; "reference" the
+    blocked-XLA online-softmax body; "auto" takes flash on TPU when
+    the local chunk tiles, reference otherwise.
     """
     *_, n_heads, head_dim = q.shape
     kv_heads = k.shape[2]
@@ -179,9 +358,22 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
     tp = "tp" if "tp" in mesh.axis_names else None
     spec = P(data, axis, tp, None)
 
-    body = functools.partial(_ring_local, axis=axis, sp_size=sp_size,
-                             causal=causal, sm_scale=sm_scale,
-                             rep=n_heads // kv_heads, block_k=block_k)
+    if impl == "auto":
+        from torchbooster_tpu.ops.attention import _on_tpu
+        from torchbooster_tpu.ops.flash_attention import tileable
+
+        s_loc = q.shape[1] // sp_size
+        impl = "flash" if _on_tpu() and tileable(s_loc) else "reference"
+    if impl in ("flash", "flash_interpret"):
+        body = functools.partial(
+            _ring_flash_local, axis=axis, sp_size=sp_size, causal=causal,
+            sm_scale=sm_scale, interpret=impl == "flash_interpret")
+    elif impl == "reference":
+        body = functools.partial(_ring_local, axis=axis, sp_size=sp_size,
+                                 causal=causal, sm_scale=sm_scale,
+                                 rep=n_heads // kv_heads, block_k=block_k)
+    else:
+        raise ValueError(f"unknown ring impl {impl!r}")
     fn = shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
                    out_specs=spec, check_vma=False)
     return fn(q, k, v)

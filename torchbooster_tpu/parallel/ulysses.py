@@ -13,8 +13,9 @@ sharding for the rest of the network.
 Trade-offs vs the ring (when a mesh has a real ``sp`` axis):
 
 - ring: O(S/sp) activation memory per device, K/V circulate in ``sp``
-  ppermute hops overlapped with compute; works for any head count;
-  attention math stays in the online-softmax form (no flash kernel).
+  ppermute hops overlapped with compute; works for any head count; on
+  TPU the per-chunk body IS the pallas flash kernel (ring-flash, with
+  log-sum-exp chunk merging), blocked-XLA online softmax elsewhere.
 - all-to-all: 4 collectives total (3 in, 1 out) moving O(S/sp·H·D)
   each, attention runs on full S locally (flash-friendly, exact tril
   mask), but needs H % (sp·tp) == 0 and the full-S attention working
@@ -127,9 +128,10 @@ def sequence_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
     apply, and unlocks the flash kernel; ring is the fallback that
     always works). K/V may carry fewer (grouped) heads than q: they
     stay grouped across the collectives when the mesh layout divides,
-    and are pre-expanded otherwise. ``impl`` feeds the all-to-all
-    path's local attention dispatch; the ring is online-softmax by
-    construction and has no kernel choice to make.
+    and are pre-expanded otherwise. ``impl`` feeds both strategies'
+    local body dispatch: the all-to-all's full-sequence attention, or
+    the ring's per-chunk body (pallas ring-flash on TPU, blocked-XLA
+    online softmax otherwise — parallel/ring.py).
     """
     from torchbooster_tpu.parallel.ring import ring_attention
 
@@ -164,7 +166,7 @@ def sequence_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
                                  sm_scale=sm_scale, axis=axis, impl=impl)
     if strategy == "ring":
         return ring_attention(q, k, v, mesh, causal=causal,
-                              sm_scale=sm_scale, axis=axis)
+                              sm_scale=sm_scale, axis=axis, impl=impl)
     raise ValueError(f"unknown sequence-parallel strategy {strategy!r}")
 
 
