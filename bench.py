@@ -28,8 +28,8 @@ scripts/run_ab.py, which drains them through `--sub` children):
 BENCH_FUSED, BENCH_S2D, BENCH_NF (ResNet), BENCH_GPT_CHUNKED,
 BENCH_GPT_REMAT=0, BENCH_GPT_POS=rope, BENCH_GPT_MLP=swiglu,
 BENCH_GPT_KV_HEADS, BENCH_GPT_LONG_KV_HEADS, BENCH_LOADER_MODE/WORKERS;
-BENCH_DECODE=1 adds the serving sub-bench (tokens/s through the jitted
-KV-cache decode loop; BENCH_DECODE_BATCH/NEW/CACHES shape it);
+the decode sub-bench (tokens/s through the jitted KV-cache loop;
+BENCH_DECODE_BATCH/NEW/CACHES shape it, BENCH_SKIP_DECODE skips);
 deadlines: BENCH_SUB_DEADLINE or BENCH_DEADLINE_<name>.
 """
 from __future__ import annotations
@@ -709,8 +709,6 @@ def main() -> None:
     for name, default in secondary:
         if env_flag(f"BENCH_SKIP_{name.upper()}"):
             continue
-        if name == "decode" and not env_flag("BENCH_DECODE"):
-            continue    # opt-in: the serving metric, not the train headline
         if aborted is None and resnet_failed:
             aborted = tunnel_died()
             if aborted:

@@ -1,0 +1,94 @@
+"""Summarize logs/ab_results.jsonl into a markdown table.
+
+Run after the chip watcher (scripts/run_ab.py) has drained some of its
+queue: prints one row per config (latest ok attempt wins), the headline
+value it measured, and the delta vs its family baseline — the exact
+evidence the gate-flip policy (bench._ab_best) consumes, rendered for
+docs/performance.md.
+
+Usage: python scripts/ab_summary.py [path-to-jsonl]
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# config name -> (family, metric key); families share a baseline row
+METRICS = {
+    "baseline": ("resnet img/s", "value"),
+    "fused": ("resnet img/s", "value"),
+    "s2d": ("resnet img/s", "value"),
+    "fused_s2d": ("resnet img/s", "value"),
+    "nf": ("resnet img/s", "value"),
+    "nf_s2d": ("resnet img/s", "value"),
+    "gpt": ("gpt tok/s", "gpt_tokens_per_sec"),
+    "gpt_chunked": ("gpt tok/s", "gpt_tokens_per_sec"),
+    "gpt_noremat": ("gpt tok/s", "gpt_tokens_per_sec"),
+    "gpt_b32": ("gpt tok/s", "gpt_tokens_per_sec"),
+    "gpt_rope": ("gpt tok/s", "gpt_tokens_per_sec"),
+    "gpt_swiglu": ("gpt tok/s", "gpt_tokens_per_sec"),
+    "gpt_gqa4": ("gpt tok/s", "gpt_tokens_per_sec"),
+    "gpt_long_flash": ("gpt-long tok/s", "gpt_long_tokens_per_sec"),
+    "gpt_long_b2": ("gpt-long tok/s", "gpt_long_tokens_per_sec"),
+    "gpt_long_b4": ("gpt-long tok/s", "gpt_long_tokens_per_sec"),
+    "gpt_long_gqa4": ("gpt-long tok/s", "gpt_long_tokens_per_sec"),
+    "unet": ("unet img/s", "unet_img_per_sec"),
+    "loader_thread": ("loader img/s", "loader_img_per_sec"),
+    "loader_process": ("loader img/s", "loader_img_per_sec"),
+}
+BASELINES = {"resnet img/s": "baseline", "gpt tok/s": "gpt",
+             "gpt-long tok/s": "gpt_long_flash",
+             "loader img/s": "loader_thread"}
+
+
+def main() -> None:
+    path = sys.argv[1] if len(sys.argv) > 1 else os.path.join(
+        REPO, "logs", "ab_results.jsonl")
+    latest: dict[str, dict] = {}
+    attempts: dict[str, int] = {}
+    try:
+        with open(path) as f:
+            for ln in f:
+                try:
+                    e = json.loads(ln)
+                except json.JSONDecodeError:
+                    continue
+                name = e.get("config", "?")
+                attempts[name] = attempts.get(name, 0) + 1
+                if e.get("status") == "ok":
+                    latest[name] = e
+    except OSError:
+        print(f"no results at {path}")
+        return
+
+    print("| config | metric | value | vs family baseline | status |")
+    print("|---|---|---|---|---|")
+    for name, (family, key) in METRICS.items():
+        e = latest.get(name)
+        if e is None:
+            status = (f"{attempts[name]} failed attempt(s)"
+                      if attempts.get(name) else "pending")
+            print(f"| {name} | {family} | — | — | {status} |")
+            continue
+        value = (e.get("result") or {}).get(key)
+        base_e = latest.get(BASELINES[family])
+        base = (base_e.get("result") or {}).get(key) if base_e else None
+        delta = (f"{(value / base - 1) * 100:+.1f}%"
+                 if value and base and name != BASELINES[family] else "—")
+        extra = ""
+        for flag in ("gpt_flash_engaged", "gpt_long_flash_engaged"):
+            if flag in (e.get("result") or {}):
+                extra = f" flash={e['result'][flag]}"
+        print(f"| {name} | {family} | {value} | {delta} "
+              f"| ok ({e.get('seconds', '?')}s){extra} |")
+    decode = latest.get("decode")
+    if decode:
+        print("\ndecode (tokens/s):",
+              json.dumps(decode.get("result", {}), indent=None))
+
+
+if __name__ == "__main__":
+    main()
