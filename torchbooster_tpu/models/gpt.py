@@ -249,14 +249,16 @@ class GPT:
         use_pp = (mesh is not None and "pp" in mesh.axis_names
                   and mesh.shape["pp"] > 1)
         if use_pp:
-            x = _pipelined_blocks(params, x, cfg, mesh, remat, attn_impl,
-                                  drop, layer_keys, use_sp)
-            aux = jnp.zeros((), jnp.float32)
+            x, aux = _pipelined_blocks(params, x, cfg, mesh, remat,
+                                       attn_impl, drop, layer_keys,
+                                       use_sp)
             if return_hidden:
                 out = L.layer_norm(params["ln_f"], x)
             else:
                 out = _lm_head(params, x)
-            return (out, aux) if return_aux else out
+            # same normalization as the scan path: mean over layers
+            return (out, aux / max(cfg.n_layers, 1)) if return_aux \
+                else out
 
         def attend(q, k, v):
             if use_sp:
@@ -350,29 +352,35 @@ def _rope(x: jax.Array, positions: jax.Array,
 def _pipelined_blocks(params: dict, x: jax.Array, cfg: GPTConfig,
                       mesh: Mesh, remat: bool, attn_impl: str,
                       drop: float, layer_keys: jax.Array,
-                      use_sp: bool) -> jax.Array:
+                      use_sp: bool) -> tuple[jax.Array, jax.Array]:
     """Route the layer-stacked block scan through the GPipe kernel when
     the mesh has ``pp > 1`` — the blocks were layer-stacked for exactly
     this (parallel/pipeline.py): each pp stage holds ``L/pp`` contiguous
     layers, microbatches ride one ppermute ring, and dp/fsdp batch axes
     compose (each data group drives its own ring). Embedding and LM head
-    stay outside the pipeline (they are not layer-stacked).
+    stay outside the pipeline (they are not layer-stacked). Returns
+    (x, aux).
+
+    MoE blocks pipeline too: experts run replicated within each stage
+    (an ``ep`` axis is not sharded inside the pipeline's shard_map) and
+    the load-balance aux is the per-microbatch estimator — expert load
+    fractions and capacity are computed per microbatch, so aux tracks
+    but does not bitwise-match the un-pipelined value. At TIGHT
+    capacity factors the drop decisions themselves are per-microbatch,
+    so overflowing tokens may differ from the un-pipelined forward
+    (pipeline_apply's docstring spells out the contract); with ample
+    capacity the logits match bitwise.
 
     Composition limits are loud, not silent: tp/sp shard *within* a
     block, which would need collectives nested inside the pipeline's
     shard_map — not wired yet."""
-    if cfg.n_experts > 0:
-        raise NotImplementedError(
-            "pp with MoE blocks is not wired yet (the load-balance aux "
-            "loss does not thread through the pipeline ring)")
     if use_sp or ("tp" in mesh.axis_names and mesh.shape["tp"] > 1):
         raise NotImplementedError(
             "pp composes with dp/fsdp batch axes; tp/sp shard within a "
             "block and are not supported inside the pipeline yet")
     from torchbooster_tpu.parallel.pipeline import pipeline_apply
 
-    def pp_layer(layer_in: tuple, h: jax.Array,
-                 mb_idx: jax.Array) -> jax.Array:
+    def pp_layer(layer_in: tuple, h: jax.Array, mb_idx: jax.Array):
         bp, key = layer_in
         # fold the microbatch index into the layer key: every microbatch
         # must draw an INDEPENDENT dropout mask (the full-batch forward
@@ -381,19 +389,19 @@ def _pipelined_blocks(params: dict, x: jax.Array, cfg: GPTConfig,
         key = jax.random.fold_in(key, mb_idx) if drop else key
         # plain attention dispatch: inside the pipeline's shard_map the
         # global constrainer must not re-annotate shardings
-        h, _, _ = _block_core(
+        h, layer_aux, _ = _block_core(
             bp, h, cfg,
             lambda q, k, v: (attention(q, k, v, causal=True,
                                        impl=attn_impl), None),
             dropout=drop, dropout_key=key)
-        return h
+        return h, layer_aux
 
     layer = jax.checkpoint(
         pp_layer,
         policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
     ) if remat else pp_layer
     return pipeline_apply(layer, (params["blocks"], layer_keys), x, mesh,
-                          with_mb_index=True)
+                          with_mb_index=True, with_aux=True)
 
 
 def _dropout(x: jax.Array, rate: float,

@@ -56,7 +56,8 @@ def pipeline_apply(
     n_microbatches: int | None = None,
     batch_axes: tuple[str, ...] | None = None,
     with_mb_index: bool = False,
-) -> jax.Array:
+    with_aux: bool = False,
+) -> jax.Array | tuple[jax.Array, jax.Array]:
     """Run ``layer_fn`` over ``L`` stacked layers, pipelined over the
     mesh's ``axis``.
 
@@ -72,6 +73,14 @@ def pipeline_apply(
     stochastic layers would draw IDENTICAL noise for every microbatch,
     noise the un-pipelined full-batch forward draws independently).
 
+    ``with_aux=True``: ``layer_fn`` additionally returns a scalar aux
+    loss (MoE load balance); ``pipeline_apply`` returns ``(out, aux)``
+    where aux is the SUM over layers of the MEAN over microbatches —
+    the microbatch-granular estimator of the full-batch aux (batch
+    statistics like expert load fractions are computed per microbatch
+    here, so the value is close to, not bitwise-equal to, the
+    un-pipelined one).
+
     ``batch_axes`` are the mesh axes the per-microbatch batch dimension
     shards over — default: whichever of ``dp``/``fsdp`` the mesh has.
     Each data-parallel group then runs its own pp ring on its own batch
@@ -80,7 +89,13 @@ def pipeline_apply(
     of the batch axes.
 
     Returns the full-batch output, identical (up to float reassociation)
-    to sequentially scanning the layers on one device.
+    to sequentially scanning the layers on one device — EXCEPT for
+    layers whose math depends on batch-level statistics: those see one
+    microbatch (one dp slice of it) at a time. Concretely, MoE capacity
+    and token-drop decisions are made per microbatch-slice, so at tight
+    capacity factors a different token set overflows than in the
+    un-pipelined forward (ample capacity → bitwise-matching outputs;
+    the aux estimator differs regardless — see ``with_aux``).
     """
     n_stages = mesh.shape[axis]
     n_layers = jax.tree.leaves(stacked_params)[0].shape[0]
@@ -112,17 +127,23 @@ def pipeline_apply(
         stage = jax.lax.axis_index(axis)
         right = [(j, (j + 1) % n_stages) for j in range(n_stages)]
 
-        def run_stage(carry_x: jax.Array, mb_idx: jax.Array) -> jax.Array:
+        def run_stage(carry_x: jax.Array, mb_idx: jax.Array):
             def one(carry, layer_params):
-                if with_mb_index:
-                    return layer_fn(layer_params, carry, mb_idx), None
-                return layer_fn(layer_params, carry), None
+                x, aux = carry
+                args = (layer_params, x, mb_idx) if with_mb_index \
+                    else (layer_params, x)
+                y = layer_fn(*args)
+                if with_aux:
+                    y, layer_aux = y
+                    aux = aux + layer_aux
+                return (y, aux), None
 
-            out, _ = jax.lax.scan(one, carry_x, stage_params)
-            return out
+            (out, aux), _ = jax.lax.scan(
+                one, (carry_x, jnp.zeros((), jnp.float32)), stage_params)
+            return out, aux
 
         def tick(t: int, state: tuple) -> tuple:
-            held, out = state
+            held, out, aux_sum = state
             mb_index = t - stage
             active = (mb_index >= 0) & (mb_index < m)
             # stage 0 pulls a fresh microbatch; others use the activation
@@ -130,8 +151,9 @@ def pipeline_apply(
             fresh = jax.lax.dynamic_index_in_dim(
                 x_mb, jnp.clip(t, 0, m - 1), 0, keepdims=False)
             x_in = jnp.where(stage == 0, fresh, held)
-            y = run_stage(x_in, jnp.clip(mb_index, 0, m - 1))
+            y, aux = run_stage(x_in, jnp.clip(mb_index, 0, m - 1))
             y = jnp.where(active, y, x_in)
+            aux_sum = aux_sum + jnp.where(active, aux, 0.0)
             # the final stage banks its finished microbatch
             write = active & (stage == n_stages - 1)
             slot = jnp.clip(mb_index, 0, m - 1)
@@ -139,23 +161,38 @@ def pipeline_apply(
                 out, jnp.where(write, y, jax.lax.dynamic_index_in_dim(
                     out, slot, 0, keepdims=False)), slot, 0)
             held = jax.lax.ppermute(y, axis, right)
-            return held, banked
+            return held, banked, aux_sum
 
         held = jnp.zeros(x_mb.shape[1:], x_mb.dtype)
         out = jnp.zeros_like(x_mb)
-        _, out = jax.lax.fori_loop(0, m + n_stages - 1, tick, (held, out))
+        _, out, aux_sum = jax.lax.fori_loop(
+            0, m + n_stages - 1, tick,
+            (held, out, jnp.zeros((), jnp.float32)))
         # results live on the last stage; mask + psum broadcasts them
         out = out * jnp.where(stage == n_stages - 1, 1.0, 0.0).astype(out.dtype)
-        return jax.lax.psum(out, axis)
+        out = jax.lax.psum(out, axis)
+        if with_aux:
+            # each (stage, microbatch) pair contributed once; psum over
+            # pp sums the stages (mean over the batch axes so every
+            # data group agrees), /m gives mean-over-microbatches
+            aux = jax.lax.psum(aux_sum, axis) / m
+            if batch_axes:
+                aux = jax.lax.pmean(aux, batch_axes)
+            return out, aux
+        return out
 
+    out_specs = (mb_spec, P()) if with_aux else mb_spec
     try:        # jax >= 0.8 spells the replication-check flag check_vma
         mapped = shard_map(kernel, mesh=mesh,
                            in_specs=(param_specs, mb_spec),
-                           out_specs=mb_spec, check_vma=False)
+                           out_specs=out_specs, check_vma=False)
     except TypeError:  # pragma: no cover - older jax
         mapped = shard_map(kernel, mesh=mesh,
                            in_specs=(param_specs, mb_spec),
-                           out_specs=mb_spec, check_rep=False)
+                           out_specs=out_specs, check_rep=False)
+    if with_aux:
+        out_mb, aux = mapped(stacked_params, x_mb)
+        return out_mb.reshape(batch, *x.shape[1:]), aux
     out_mb = mapped(stacked_params, x_mb)
     return out_mb.reshape(batch, *x.shape[1:])
 
