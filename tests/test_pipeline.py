@@ -175,20 +175,65 @@ def test_gpt_pipeline_dropout_independent_per_microbatch():
     np.testing.assert_array_equal(out, np.asarray(out2))
 
 
-def test_gpt_pipeline_composition_limits_are_loud():
-    """tp/sp inside the pipeline are unimplemented — they must raise,
-    not silently misshard."""
+def test_gpt_pipeline_tensor_parallel_matches_single_device():
+    """tp INSIDE the pipeline: on a dp:2,pp:2,tp:2 mesh the block
+    weights shard Megatron-style across tp within each pp stage
+    (manual psum in _block_core; rank-major qkv column permutation) —
+    forward and grads must match the single-device model, GQA
+    included."""
+    import optax
+
     from torchbooster_tpu.models.gpt import GPT, GPTConfig
 
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(2, 2, 2),
+                ("dp", "pp", "tp"))
+    cfg = GPTConfig(vocab=64, n_layers=4, d_model=32, n_heads=4,
+                    seq_len=16, n_kv_heads=2, mlp="swiglu", pos="rope")
+    params = GPT.init(jax.random.PRNGKey(0), cfg)
+    ids = jax.random.randint(jax.random.PRNGKey(1), (8, 16), 0, 64)
+
+    want = GPT.apply(params, ids, cfg, compute_dtype=jnp.float32)
+    with mesh:
+        got = jax.jit(lambda p, i: GPT.apply(
+            p, i, cfg, mesh=mesh, compute_dtype=jnp.float32))(params, ids)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=3e-4)
+
+    def loss(p, use_mesh):
+        lg = GPT.apply(p, ids, cfg, mesh=mesh if use_mesh else None,
+                       compute_dtype=jnp.float32)
+        return optax.softmax_cross_entropy_with_integer_labels(
+            lg[:, :-1], ids[:, 1:]).mean()
+
+    g_seq = jax.grad(lambda p: loss(p, False))(params)
+    with mesh:
+        g_pp = jax.jit(jax.grad(lambda p: loss(p, True)))(params)
+    for a, b in zip(jax.tree.leaves(g_pp), jax.tree.leaves(g_seq)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-3, atol=2e-3)
+
+
+def test_gpt_pipeline_composition_limits_are_loud():
+    """sp inside the pipeline (and MoE x tp) are unimplemented — they
+    must raise, not silently misshard."""
+    from torchbooster_tpu.models.gpt import GPT, GPTConfig
+
+    mesh_sp = Mesh(np.array(jax.devices()[:8]).reshape(4, 2),
+                   ("pp", "sp"))
     cfg = GPTConfig(vocab=64, n_layers=4, d_model=32, n_heads=2,
                     seq_len=16)
     params = GPT.init(jax.random.PRNGKey(0), cfg)
     ids = jnp.zeros((4, 16), jnp.int32)
+    with pytest.raises(NotImplementedError, match="sp"):
+        GPT.apply(params, ids, cfg, mesh=mesh_sp)
 
+    cfg_moe = GPTConfig(vocab=64, n_layers=4, d_model=32, n_heads=2,
+                        seq_len=16, n_experts=2)
+    params_moe = GPT.init(jax.random.PRNGKey(0), cfg_moe)
     mesh_tp = Mesh(np.array(jax.devices()[:8]).reshape(4, 2),
                    ("pp", "tp"))
-    with pytest.raises(NotImplementedError, match="tp/sp"):
-        GPT.apply(params, ids, cfg, mesh=mesh_tp)
+    with pytest.raises(NotImplementedError, match="MoE"):
+        GPT.apply(params_moe, ids, cfg_moe, mesh=mesh_tp)
 
 
 def test_gpt_pipeline_moe_aux_threads_through():

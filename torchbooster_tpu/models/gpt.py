@@ -371,14 +371,75 @@ def _pipelined_blocks(params: dict, x: jax.Array, cfg: GPTConfig,
     (pipeline_apply's docstring spells out the contract); with ample
     capacity the logits match bitwise.
 
-    Composition limits are loud, not silent: tp/sp shard *within* a
-    block, which would need collectives nested inside the pipeline's
-    shard_map — not wired yet."""
-    if use_sp or ("tp" in mesh.axis_names and mesh.shape["tp"] > 1):
+    Tensor parallelism composes INSIDE the pipeline: with ``tp > 1`` in
+    the mesh, block weights additionally shard Megatron-style across tp
+    (qkv/fc1/fc3 column-parallel with each rank holding its head/hidden
+    subset, proj/fc2 row-parallel with an explicit psum —
+    ``_block_core(tp=...)``). The qkv kernel's output columns are the
+    concatenation [q | k | v], so a contiguous tp split would misalign
+    with the per-rank [q_i | k_i | v_i] the local math slices — the
+    columns are permuted rank-major first. Params stay canonical
+    everywhere else, which costs a cross-device reshard of the stacked
+    qkv kernel per step when the rule table stored it tp-sharded
+    (weights-sized, once per step — acceptable at dryrun/test scale;
+    if pp x tp ships on real hardware, permute once at placement time
+    instead and skip this per-step gather).
+
+    Remaining loud limit: sp shards the sequence within a block (ring /
+    all-to-all collectives nested in the pipeline's shard_map) — not
+    wired."""
+    if use_sp:
         raise NotImplementedError(
-            "pp composes with dp/fsdp batch axes; tp/sp shard within a "
-            "block and are not supported inside the pipeline yet")
+            "pp composes with dp/fsdp batch axes and tp; sp inside the "
+            "pipeline is not supported yet")
     from torchbooster_tpu.parallel.pipeline import pipeline_apply
+    from torchbooster_tpu.parallel.sharding import path_str as _path_str
+
+    tp_size = mesh.shape.get("tp", 1)
+    tp = ("tp", tp_size) if tp_size > 1 else None
+    blocks = params["blocks"]
+    if tp is not None:
+        if cfg.n_experts > 0:
+            raise NotImplementedError(
+                "pp x tp with MoE blocks is not wired (expert kernels "
+                "would need their own manual-collective dispatch)")
+        if cfg.n_heads % tp_size or cfg.kv_heads % tp_size:
+            raise ValueError(
+                f"pp x tp needs n_heads ({cfg.n_heads}) and kv_heads "
+                f"({cfg.kv_heads}) divisible by tp ({tp_size})")
+        head_dim = cfg.d_model // cfg.n_heads
+        kv_dim = cfg.kv_heads * head_dim
+        import numpy as onp
+
+        sections = onp.split(
+            onp.arange(cfg.d_model + 2 * kv_dim),
+            [cfg.d_model, cfg.d_model + kv_dim])
+        perm = jnp.asarray(onp.concatenate([
+            onp.concatenate([s.reshape(tp_size, -1)[i] for s in sections])
+            for i in range(tp_size)]))
+        qkv = blocks["attn_qkv"]
+        blocks = {**blocks, "attn_qkv": {
+            "kernel": jnp.take(qkv["kernel"], perm, axis=2),
+            **({"bias": jnp.take(qkv["bias"], perm, axis=1)}
+               if "bias" in qkv else {})}}
+
+        col = {"attn_qkv", "mlp_fc1", "mlp_fc3"}   # out dim over tp
+        row = {"attn_proj", "mlp_fc2"}             # in dim over tp
+
+        def assign(path: tuple, leaf: Any) -> P:
+            name = _path_str(path)
+            layer, kind = name.split("/")[0], name.split("/")[-1]
+            if layer in col:
+                return P("pp", None, "tp") if kind == "kernel" \
+                    else P("pp", "tp")
+            if layer in row and kind == "kernel":
+                return P("pp", "tp", None)
+            return P("pp")
+
+        block_specs = jax.tree_util.tree_map_with_path(assign, blocks)
+        param_specs = (block_specs, P("pp"))
+    else:
+        param_specs = None
 
     def pp_layer(layer_in: tuple, h: jax.Array, mb_idx: jax.Array):
         bp, key = layer_in
@@ -393,15 +454,16 @@ def _pipelined_blocks(params: dict, x: jax.Array, cfg: GPTConfig,
             bp, h, cfg,
             lambda q, k, v: (attention(q, k, v, causal=True,
                                        impl=attn_impl), None),
-            dropout=drop, dropout_key=key)
+            dropout=drop, dropout_key=key, tp=tp)
         return h, layer_aux
 
     layer = jax.checkpoint(
         pp_layer,
         policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
     ) if remat else pp_layer
-    return pipeline_apply(layer, (params["blocks"], layer_keys), x, mesh,
-                          with_mb_index=True, with_aux=True)
+    return pipeline_apply(layer, (blocks, layer_keys), x, mesh,
+                          with_mb_index=True, with_aux=True,
+                          param_specs=param_specs)
 
 
 def _dropout(x: jax.Array, rate: float,
@@ -415,12 +477,24 @@ def _dropout(x: jax.Array, rate: float,
     return jnp.where(mask, x / keep, 0.0).astype(x.dtype)
 
 
+def _row_dense(params: dict, x: jax.Array, reduce) -> jax.Array:
+    """Row-parallel dense: ``reduce`` (a psum over the tp axis, or
+    identity) runs BETWEEN the matmul and the bias add — each device
+    holds a row slice of the kernel, so partial products sum across
+    devices while the (replicated) bias is added exactly once."""
+    y = reduce(x @ params["kernel"].astype(x.dtype))
+    if "bias" in params:
+        y = y + params["bias"].astype(x.dtype)
+    return y
+
+
 def _block_core(bp: dict, x: jax.Array, cfg: GPTConfig, attend,
                 constrain=lambda x: x,
                 capacity_factor: float | None = None,
                 positions: jax.Array | None = None,
                 dropout: float = 0.0,
-                dropout_key: jax.Array | None = None
+                dropout_key: jax.Array | None = None,
+                tp: tuple[str, int] | None = None
                 ) -> tuple[jax.Array, jax.Array, Any]:
     """The transformer block math, shared by every path (training
     forward, prefill, cached decode) so they cannot drift apart.
@@ -431,18 +505,31 @@ def _block_core(bp: dict, x: jax.Array, cfg: GPTConfig, attend,
     rotated K flows into caches/rings/all-to-alls uniformly.
     ``dropout``/``dropout_key``: residual-branch dropout (training
     forward only; prefill/decode leave the defaults = off).
+    ``tp=(axis, size)``: MANUAL tensor parallelism for shard_map
+    callers (the pipeline): bp holds per-rank Megatron slices —
+    column-parallel qkv/fc1/fc3 (local head/hidden subset), row-
+    parallel proj/fc2 (psum over ``axis`` before the bias). The
+    auto-SPMD paths leave this None and let XLA place the collectives.
     Returns (x, aux_loss, extras)."""
     b, s, d = x.shape
     n_heads, kv_heads = cfg.n_heads, cfg.kv_heads
     head_dim = d // n_heads
+    reduce = lambda y: y
+    if tp is not None:
+        tp_axis, tp_size = tp
+        n_heads //= tp_size
+        kv_heads //= tp_size
+        reduce = lambda y: jax.lax.psum(y, tp_axis)
+    q_width = n_heads * head_dim
     aux = jnp.zeros((), jnp.float32)
 
     h = L.layer_norm(bp["ln1"], x)
     qkv = L.dense(bp["attn_qkv"], h)
-    q = qkv[..., :d].reshape(b, s, n_heads, head_dim)
+    q = qkv[..., :q_width].reshape(b, s, n_heads, head_dim)
     kv_dim = kv_heads * head_dim
-    k = qkv[..., d:d + kv_dim].reshape(b, s, kv_heads, head_dim)
-    v = qkv[..., d + kv_dim:].reshape(b, s, kv_heads, head_dim)
+    k = qkv[..., q_width:q_width + kv_dim].reshape(b, s, kv_heads,
+                                                   head_dim)
+    v = qkv[..., q_width + kv_dim:].reshape(b, s, kv_heads, head_dim)
     if cfg.pos == "rope":
         if positions is None:
             positions = jnp.arange(s)
@@ -454,7 +541,8 @@ def _block_core(bp: dict, x: jax.Array, cfg: GPTConfig, attend,
         k_attn = k_mlp = None
     o, extras = attend(q, k, v)
     x = constrain(x + _dropout(
-        L.dense(bp["attn_proj"], o.reshape(b, s, d)), dropout, k_attn))
+        _row_dense(bp["attn_proj"], o.reshape(b, s, q_width), reduce),
+        dropout, k_attn))
     h = L.layer_norm(bp["ln2"], x)
     if cfg.n_experts > 0:
         from torchbooster_tpu.models.moe import moe_apply
@@ -466,12 +554,12 @@ def _block_core(bp: dict, x: jax.Array, cfg: GPTConfig, attend,
         x = constrain(x + _dropout(m, dropout, k_mlp))
     elif "mlp_fc3" in bp:   # swiglu: silu(xW1) ⊙ xW3 → W2
         h = jax.nn.silu(L.dense(bp["mlp_fc1"], h)) * L.dense(bp["mlp_fc3"], h)
-        x = constrain(x + _dropout(L.dense(bp["mlp_fc2"], h), dropout,
-                                   k_mlp))
+        x = constrain(x + _dropout(
+            _row_dense(bp["mlp_fc2"], h, reduce), dropout, k_mlp))
     else:
         h = jax.nn.gelu(L.dense(bp["mlp_fc1"], h))
-        x = constrain(x + _dropout(L.dense(bp["mlp_fc2"], h), dropout,
-                                   k_mlp))
+        x = constrain(x + _dropout(
+            _row_dense(bp["mlp_fc2"], h, reduce), dropout, k_mlp))
     return x, aux, extras
 
 

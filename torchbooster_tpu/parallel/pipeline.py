@@ -57,6 +57,7 @@ def pipeline_apply(
     batch_axes: tuple[str, ...] | None = None,
     with_mb_index: bool = False,
     with_aux: bool = False,
+    param_specs: Any | None = None,
 ) -> jax.Array | tuple[jax.Array, jax.Array]:
     """Run ``layer_fn`` over ``L`` stacked layers, pipelined over the
     mesh's ``axis``.
@@ -119,8 +120,12 @@ def pipeline_apply(
 
     # params shard their layer axis over pp (replicating across the data
     # axes); microbatches shard their batch dim over the data axes, so
-    # each dp group drives an independent pp ring on its own slice
-    param_specs = jax.tree.map(lambda _: P(axis), stacked_params)
+    # each dp group drives an independent pp ring on its own slice.
+    # ``param_specs`` overrides the default for callers that ALSO shard
+    # within-layer dims over a manual axis (tensor parallelism — the
+    # layer_fn is then responsible for the matching collectives).
+    if param_specs is None:
+        param_specs = jax.tree.map(lambda _: P(axis), stacked_params)
     mb_spec = P(None, batch_axes or None)
 
     def kernel(stage_params: Any, x_mb: jax.Array) -> jax.Array:
