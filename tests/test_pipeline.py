@@ -213,27 +213,86 @@ def test_gpt_pipeline_tensor_parallel_matches_single_device():
                                    rtol=2e-3, atol=2e-3)
 
 
-def test_gpt_pipeline_composition_limits_are_loud():
-    """sp inside the pipeline (and MoE x tp) are unimplemented — they
-    must raise, not silently misshard."""
+def test_gpt_pipeline_sequence_parallel_matches_single_device():
+    """sp INSIDE the pipeline: activations shard their sequence dim
+    over sp within each pipeline stage and attention runs the ring
+    body over the manual sp axis — dp:2,pp:2,sp:2 GPT (rope, GQA)
+    matches the single-device forward and grads."""
+    import optax
+
     from torchbooster_tpu.models.gpt import GPT, GPTConfig
 
-    mesh_sp = Mesh(np.array(jax.devices()[:8]).reshape(4, 2),
-                   ("pp", "sp"))
-    cfg = GPTConfig(vocab=64, n_layers=4, d_model=32, n_heads=2,
-                    seq_len=16)
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(2, 2, 2),
+                ("dp", "pp", "sp"))
+    cfg = GPTConfig(vocab=64, n_layers=4, d_model=32, n_heads=4,
+                    seq_len=16, n_kv_heads=2, pos="rope")
     params = GPT.init(jax.random.PRNGKey(0), cfg)
-    ids = jnp.zeros((4, 16), jnp.int32)
-    with pytest.raises(NotImplementedError, match="sp"):
-        GPT.apply(params, ids, cfg, mesh=mesh_sp)
+    ids = jax.random.randint(jax.random.PRNGKey(1), (8, 16), 0, 64)
+
+    want = GPT.apply(params, ids, cfg, compute_dtype=jnp.float32)
+    with mesh:
+        got = jax.jit(lambda p, i: GPT.apply(
+            p, i, cfg, mesh=mesh, compute_dtype=jnp.float32))(params, ids)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=3e-4)
+
+    def loss(p, use_mesh):
+        lg = GPT.apply(p, ids, cfg, mesh=mesh if use_mesh else None,
+                       compute_dtype=jnp.float32)
+        return optax.softmax_cross_entropy_with_integer_labels(
+            lg[:, :-1], ids[:, 1:]).mean()
+
+    g_seq = jax.grad(lambda p: loss(p, False))(params)
+    with mesh:
+        g_pp = jax.jit(jax.grad(lambda p: loss(p, True)))(params)
+    for a, b in zip(jax.tree.leaves(g_pp), jax.tree.leaves(g_seq)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-3, atol=2e-3)
+
+
+def test_gpt_pipeline_full_composition_pp_tp_sp():
+    """The maximal nested composition on 8 devices: pp:2 stages, each
+    running Megatron tp:2 within the block AND ring sp:2 across the
+    sequence — parity with single-device for BOTH ring bodies (the
+    pallas ring-flash kernel in interpret mode, and the blocked-XLA
+    reference, selected by attn_impl exactly as outside the
+    pipeline)."""
+    from torchbooster_tpu.models.gpt import GPT, GPTConfig
+
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(2, 2, 2),
+                ("pp", "tp", "sp"))
+    cfg = GPTConfig(vocab=64, n_layers=4, d_model=32, n_heads=4,
+                    seq_len=16, n_kv_heads=2, mlp="swiglu")
+    params = GPT.init(jax.random.PRNGKey(0), cfg)
+    ids = jax.random.randint(jax.random.PRNGKey(1), (4, 16), 0, 64)
+
+    want = GPT.apply(params, ids, cfg, compute_dtype=jnp.float32)
+    for impl in ("auto", "flash_interpret"):
+        with mesh:
+            got = jax.jit(lambda p, i: GPT.apply(
+                p, i, cfg, mesh=mesh, compute_dtype=jnp.float32,
+                attn_impl=impl))(params, ids)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=3e-4, err_msg=impl)
+
+
+def test_gpt_pipeline_composition_limits_are_loud():
+    """MoE x tp and MoE x sp inside the pipeline are unimplemented —
+    they must raise, not silently misshard."""
+    from torchbooster_tpu.models.gpt import GPT, GPTConfig
 
     cfg_moe = GPTConfig(vocab=64, n_layers=4, d_model=32, n_heads=2,
                         seq_len=16, n_experts=2)
     params_moe = GPT.init(jax.random.PRNGKey(0), cfg_moe)
+    ids = jnp.zeros((4, 16), jnp.int32)
     mesh_tp = Mesh(np.array(jax.devices()[:8]).reshape(4, 2),
                    ("pp", "tp"))
     with pytest.raises(NotImplementedError, match="MoE"):
         GPT.apply(params_moe, ids, cfg_moe, mesh=mesh_tp)
+    mesh_sp = Mesh(np.array(jax.devices()[:8]).reshape(4, 2),
+                   ("pp", "sp"))
+    with pytest.raises(NotImplementedError, match="MoE"):
+        GPT.apply(params_moe, ids, cfg_moe, mesh=mesh_sp)
 
 
 def test_gpt_pipeline_moe_aux_threads_through():

@@ -385,18 +385,23 @@ def _pipelined_blocks(params: dict, x: jax.Array, cfg: GPTConfig,
     if pp x tp ships on real hardware, permute once at placement time
     instead and skip this per-step gather).
 
-    Remaining loud limit: sp shards the sequence within a block (ring /
-    all-to-all collectives nested in the pipeline's shard_map) — not
-    wired."""
-    if use_sp:
-        raise NotImplementedError(
-            "pp composes with dp/fsdp batch axes and tp; sp inside the "
-            "pipeline is not supported yet")
+    Sequence parallelism also composes: with ``sp > 1`` the microbatch
+    spec shards the SEQUENCE dim over sp and the attend hook is the
+    ring-attention per-device body (parallel/ring.py ``_ring_local`` —
+    ppermute online softmax over the manual sp axis, grouped K/V
+    un-expanded); rope rotates by the shard's GLOBAL positions and
+    dropout keys fold in the sp rank so masks stay independent across
+    sequence shards."""
     from torchbooster_tpu.parallel.pipeline import pipeline_apply
     from torchbooster_tpu.parallel.sharding import path_str as _path_str
 
     tp_size = mesh.shape.get("tp", 1)
     tp = ("tp", tp_size) if tp_size > 1 else None
+    sp_size = mesh.shape["sp"] if use_sp else 1
+    if use_sp and cfg.n_experts > 0:
+        raise NotImplementedError(
+            "pp x sp with MoE blocks is not wired (per-sequence-shard "
+            "routing/capacity semantics undefined)")
     blocks = params["blocks"]
     if tp is not None:
         if cfg.n_experts > 0:
@@ -441,19 +446,59 @@ def _pipelined_blocks(params: dict, x: jax.Array, cfg: GPTConfig,
     else:
         param_specs = None
 
+    if use_sp:
+        import math as _math
+
+        from torchbooster_tpu.ops.attention import _on_tpu
+        from torchbooster_tpu.ops.flash_attention import tileable
+        from torchbooster_tpu.parallel.ring import (_ring_flash_local,
+                                                    _ring_local)
+
+        head_dim = cfg.d_model // cfg.n_heads
+        sm_scale = 1.0 / _math.sqrt(head_dim)
+
+        def attend(q, k, v):
+            # per-device ring body, directly: inside the pipeline's
+            # shard_map the sp axis is already manual, so the ring's
+            # collectives run as-is (no nested shard_map). Same body
+            # selection as ring_attention: pallas ring-flash when the
+            # chunk tiles on TPU (or attn_impl forces it), blocked-XLA
+            # online softmax otherwise — the pipeline must not silently
+            # drop the flash kernel at exactly the scale sp targets
+            impl = attn_impl
+            if impl == "auto":
+                impl = ("flash" if _on_tpu() and tileable(q.shape[1])
+                        else "reference")
+            if impl in ("flash", "flash_interpret"):
+                return _ring_flash_local(
+                    q, k, v, axis="sp", sp_size=sp_size, causal=True,
+                    sm_scale=sm_scale,
+                    interpret=impl == "flash_interpret"), None
+            return _ring_local(
+                q, k, v, axis="sp", sp_size=sp_size, causal=True,
+                sm_scale=sm_scale, rep=q.shape[2] // k.shape[2]), None
+    else:
+        def attend(q, k, v):
+            # plain attention dispatch: inside the pipeline's shard_map
+            # the global constrainer must not re-annotate shardings
+            return attention(q, k, v, causal=True, impl=attn_impl), None
+
     def pp_layer(layer_in: tuple, h: jax.Array, mb_idx: jax.Array):
         bp, key = layer_in
         # fold the microbatch index into the layer key: every microbatch
         # must draw an INDEPENDENT dropout mask (the full-batch forward
         # draws one mask over all samples; reusing one key per layer
-        # here would correlate the noise m-fold across microbatches)
+        # here would correlate the noise m-fold across microbatches);
+        # under sp, fold the sequence-shard rank too
+        positions = None
+        if use_sp:
+            shard = jax.lax.axis_index("sp")
+            positions = shard * h.shape[1] + jnp.arange(h.shape[1])
+            if drop:
+                key = jax.random.fold_in(key, shard)
         key = jax.random.fold_in(key, mb_idx) if drop else key
-        # plain attention dispatch: inside the pipeline's shard_map the
-        # global constrainer must not re-annotate shardings
         h, layer_aux, _ = _block_core(
-            bp, h, cfg,
-            lambda q, k, v: (attention(q, k, v, causal=True,
-                                       impl=attn_impl), None),
+            bp, h, cfg, attend, positions=positions,
             dropout=drop, dropout_key=key, tp=tp)
         return h, layer_aux
 
@@ -461,9 +506,12 @@ def _pipelined_blocks(params: dict, x: jax.Array, cfg: GPTConfig,
         pp_layer,
         policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
     ) if remat else pp_layer
+    data = tuple(a for a in ("dp", "fsdp") if a in mesh.axis_names) \
+        or None
+    x_spec = P(None, data, "sp") if use_sp else None
     return pipeline_apply(layer, (blocks, layer_keys), x, mesh,
                           with_mb_index=True, with_aux=True,
-                          param_specs=param_specs)
+                          param_specs=param_specs, x_spec=x_spec)
 
 
 def _dropout(x: jax.Array, rate: float,

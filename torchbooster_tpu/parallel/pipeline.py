@@ -58,6 +58,7 @@ def pipeline_apply(
     with_mb_index: bool = False,
     with_aux: bool = False,
     param_specs: Any | None = None,
+    x_spec: P | None = None,
 ) -> jax.Array | tuple[jax.Array, jax.Array]:
     """Run ``layer_fn`` over ``L`` stacked layers, pipelined over the
     mesh's ``axis``.
@@ -126,7 +127,19 @@ def pipeline_apply(
     # layer_fn is then responsible for the matching collectives).
     if param_specs is None:
         param_specs = jax.tree.map(lambda _: P(axis), stacked_params)
-    mb_spec = P(None, batch_axes or None)
+    # ``x_spec`` overrides the microbatch layout for callers that ALSO
+    # shard activation dims over a manual axis (sequence parallelism:
+    # P(None, data, "sp", ...) — the layer_fn then runs the matching
+    # collectives, e.g. a ring attention body). The leading entry is
+    # the microbatch axis and must stay unsharded.
+    if x_spec is not None and len(x_spec) and x_spec[0] is not None:
+        # a sharded microbatch axis would make the kernel's global
+        # dynamic_index_in_dim clamp out of local range — silently
+        # re-feeding the last local microbatch instead of erroring
+        raise ValueError(
+            f"x_spec {x_spec} shards the leading (microbatch) axis; "
+            "it must stay unsharded")
+    mb_spec = P(None, batch_axes or None) if x_spec is None else x_spec
 
     def kernel(stage_params: Any, x_mb: jax.Array) -> jax.Array:
         stage = jax.lax.axis_index(axis)
