@@ -654,7 +654,13 @@ def main() -> None:
             "value": None, "unit": "images/sec/chip",
             "vs_baseline": None, "mfu": None,
             "error": "tpu unreachable (backend init/matmul probe timed "
-                     "out); no measurement possible"}))
+                     "out); no measurement possible",
+            "watcher": "scripts/run_ab.py keeps probing and drains the "
+                       "full A/B queue (resnet variants, gpt, gpt_long "
+                       "flash-asserted, loader, decode) the moment the "
+                       "chip answers; results land in "
+                       "logs/ab_results.jsonl and the headline engages "
+                       "recorded wins automatically (_ab_best)"}))
         return
 
     batch, image, steps = _shapes(True)

@@ -449,10 +449,7 @@ def _pipelined_blocks(params: dict, x: jax.Array, cfg: GPTConfig,
     if use_sp:
         import math as _math
 
-        from torchbooster_tpu.ops.attention import _on_tpu
-        from torchbooster_tpu.ops.flash_attention import tileable
-        from torchbooster_tpu.parallel.ring import (_ring_flash_local,
-                                                    _ring_local)
+        from torchbooster_tpu.parallel.ring import select_ring_body
 
         head_dim = cfg.d_model // cfg.n_heads
         sm_scale = 1.0 / _math.sqrt(head_dim)
@@ -460,23 +457,16 @@ def _pipelined_blocks(params: dict, x: jax.Array, cfg: GPTConfig,
         def attend(q, k, v):
             # per-device ring body, directly: inside the pipeline's
             # shard_map the sp axis is already manual, so the ring's
-            # collectives run as-is (no nested shard_map). Same body
-            # selection as ring_attention: pallas ring-flash when the
-            # chunk tiles on TPU (or attn_impl forces it), blocked-XLA
-            # online softmax otherwise — the pipeline must not silently
-            # drop the flash kernel at exactly the scale sp targets
-            impl = attn_impl
-            if impl == "auto":
-                impl = ("flash" if _on_tpu() and tileable(q.shape[1])
-                        else "reference")
-            if impl in ("flash", "flash_interpret"):
-                return _ring_flash_local(
-                    q, k, v, axis="sp", sp_size=sp_size, causal=True,
-                    sm_scale=sm_scale,
-                    interpret=impl == "flash_interpret"), None
-            return _ring_local(
-                q, k, v, axis="sp", sp_size=sp_size, causal=True,
-                sm_scale=sm_scale, rep=q.shape[2] // k.shape[2]), None
+            # collectives run as-is (no nested shard_map). Body choice
+            # is ring_attention's own policy (shared selector — the
+            # pipeline must not silently drop the flash kernel at
+            # exactly the scale sp targets, and unknown impl names
+            # stay loud)
+            body = select_ring_body(
+                attn_impl, s_loc=q.shape[1], sp_size=sp_size,
+                causal=True, sm_scale=sm_scale,
+                rep=q.shape[2] // k.shape[2])
+            return body(q, k, v), None
     else:
         def attend(q, k, v):
             # plain attention dispatch: inside the pipeline's shard_map

@@ -132,13 +132,23 @@ def pipeline_apply(
     # P(None, data, "sp", ...) — the layer_fn then runs the matching
     # collectives, e.g. a ring attention body). The leading entry is
     # the microbatch axis and must stay unsharded.
-    if x_spec is not None and len(x_spec) and x_spec[0] is not None:
-        # a sharded microbatch axis would make the kernel's global
-        # dynamic_index_in_dim clamp out of local range — silently
-        # re-feeding the last local microbatch instead of erroring
-        raise ValueError(
-            f"x_spec {x_spec} shards the leading (microbatch) axis; "
-            "it must stay unsharded")
+    if x_spec is not None:
+        if len(x_spec) and x_spec[0] is not None:
+            # a sharded microbatch axis would make the kernel's global
+            # dynamic_index_in_dim clamp out of local range — silently
+            # re-feeding the last local microbatch instead of erroring
+            raise ValueError(
+                f"x_spec {x_spec} shards the leading (microbatch) "
+                "axis; it must stay unsharded")
+        for entry in x_spec:
+            axes = entry if isinstance(entry, (tuple, list)) else (entry,)
+            if axis in axes:
+                # activations must replicate across pp: the ring hands
+                # each stage's output to the next as ITS input — a
+                # pp-sharded activation would silently mix batch slices
+                raise ValueError(
+                    f"x_spec {x_spec} shards over the pipeline axis "
+                    f"{axis!r}; activations must replicate across it")
     mb_spec = P(None, batch_axes or None) if x_spec is None else x_spec
 
     def kernel(stage_params: Any, x_mb: jax.Array) -> jax.Array:

@@ -358,25 +358,38 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
     tp = "tp" if "tp" in mesh.axis_names else None
     spec = P(data, axis, tp, None)
 
-    if impl == "auto":
-        from torchbooster_tpu.ops.attention import _on_tpu
-        from torchbooster_tpu.ops.flash_attention import tileable
-
-        s_loc = q.shape[1] // sp_size
-        impl = "flash" if _on_tpu() and tileable(s_loc) else "reference"
-    if impl in ("flash", "flash_interpret"):
-        body = functools.partial(
-            _ring_flash_local, axis=axis, sp_size=sp_size, causal=causal,
-            sm_scale=sm_scale, interpret=impl == "flash_interpret")
-    elif impl == "reference":
-        body = functools.partial(_ring_local, axis=axis, sp_size=sp_size,
-                                 causal=causal, sm_scale=sm_scale,
-                                 rep=n_heads // kv_heads, block_k=block_k)
-    else:
-        raise ValueError(f"unknown ring impl {impl!r}")
+    body = select_ring_body(impl, s_loc=q.shape[1] // sp_size,
+                            sp_size=sp_size, causal=causal,
+                            sm_scale=sm_scale, rep=n_heads // kv_heads,
+                            axis=axis, block_k=block_k)
     fn = shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
                    out_specs=spec, check_vma=False)
     return fn(q, k, v)
 
 
-__all__ = ["ring_attention"]
+def select_ring_body(impl: str, *, s_loc: int, sp_size: int, causal: bool,
+                     sm_scale: float, rep: int = 1, axis: str = "sp",
+                     block_k: int = 512):
+    """THE ring body-selection policy, shared by :func:`ring_attention`
+    and the pipeline's nested-sp attend hook (models/gpt.py) so the
+    two sites cannot drift: "auto" takes the pallas ring-flash body on
+    TPU when the local chunk tiles, the blocked-XLA online softmax
+    otherwise; unknown names raise. Returns a per-device
+    ``fn(q, k, v)`` for use under an ALREADY-manual sp axis."""
+    if impl == "auto":
+        from torchbooster_tpu.ops.attention import _on_tpu
+        from torchbooster_tpu.ops.flash_attention import tileable
+
+        impl = "flash" if _on_tpu() and tileable(s_loc) else "reference"
+    if impl in ("flash", "flash_interpret"):
+        return functools.partial(
+            _ring_flash_local, axis=axis, sp_size=sp_size, causal=causal,
+            sm_scale=sm_scale, interpret=impl == "flash_interpret")
+    if impl == "reference":
+        return functools.partial(_ring_local, axis=axis, sp_size=sp_size,
+                                 causal=causal, sm_scale=sm_scale,
+                                 rep=rep, block_k=block_k)
+    raise ValueError(f"unknown ring impl {impl!r}")
+
+
+__all__ = ["ring_attention", "select_ring_body"]
