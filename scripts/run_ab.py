@@ -39,30 +39,35 @@ from bench import (  # noqa: E402
 # name -> (sub-bench, env overrides, deadline seconds). Deadlines are
 # generous: first-compile on the tunnel is slow, and the pallas paths
 # (BENCH_FUSED, gpt_long's flash) are the very thing under test.
+# ORDERED BY VALUE-PER-CHIP-MINUTE: a brief tunnel window must capture
+# the round's headline evidence first — the resnet baseline (the
+# comparison anchor), the norm-free candidate (the priced ~+30% win),
+# the flash-ASSERTED long-context number, and the gpt headline — before
+# the secondary ablations and load tests.
 QUEUE: list[tuple[str, str, dict, int]] = [
     ("baseline", "resnet", {}, 900),
+    ("nf", "resnet", {"BENCH_NF": "1"}, 1200),
+    ("gpt_long_flash", "gpt_long", {}, 1800),
+    ("gpt", "gpt", {}, 1200),
+    ("nf_s2d", "resnet", {"BENCH_NF": "1", "BENCH_S2D": "1"}, 1200),
     ("fused", "resnet", {"BENCH_FUSED": "1"}, 1800),
     ("s2d", "resnet", {"BENCH_S2D": "1"}, 1200),
     ("fused_s2d", "resnet", {"BENCH_FUSED": "1", "BENCH_S2D": "1"}, 1800),
-    ("nf", "resnet", {"BENCH_NF": "1"}, 1200),
-    ("nf_s2d", "resnet", {"BENCH_NF": "1", "BENCH_S2D": "1"}, 1200),
-    ("gpt", "gpt", {}, 1200),
     ("gpt_chunked", "gpt", {"BENCH_GPT_CHUNKED": "1"}, 1200),
     ("gpt_noremat", "gpt", {"BENCH_GPT_REMAT": "0"}, 1200),
     ("gpt_b32", "gpt", {"BENCH_GPT_BATCH": "32"}, 1200),
+    ("gpt_long_gqa4", "gpt_long", {"BENCH_GPT_LONG_KV_HEADS": "4"}, 1500),
+    ("gpt_long_b2", "gpt_long", {"BENCH_GPT_LONG_BATCH": "2"}, 1500),
+    ("gpt_long_b4", "gpt_long", {"BENCH_GPT_LONG_BATCH": "4"}, 1500),
     ("gpt_rope", "gpt", {"BENCH_GPT_POS": "rope"}, 1200),
     ("gpt_swiglu", "gpt", {"BENCH_GPT_MLP": "swiglu"}, 1200),
     ("gpt_gqa4", "gpt", {"BENCH_GPT_KV_HEADS": "4"}, 1200),
-    ("gpt_long_flash", "gpt_long", {}, 1800),
-    ("gpt_long_b2", "gpt_long", {"BENCH_GPT_LONG_BATCH": "2"}, 1500),
-    ("gpt_long_b4", "gpt_long", {"BENCH_GPT_LONG_BATCH": "4"}, 1500),
-    ("gpt_long_gqa4", "gpt_long", {"BENCH_GPT_LONG_KV_HEADS": "4"}, 1500),
-    ("unet", "unet", {}, 1200),
-    ("loader_thread", "loader", {}, 1200),
-    ("loader_process", "loader", {"BENCH_LOADER_MODE": "process"}, 1200),
     # serving: KV-cache decode tokens/s, MHA vs GQA cache width at
     # 1k/8k cache (bench.bench_decode; VERDICT r3 missing #4)
     ("decode", "decode", {}, 1800),
+    ("unet", "unet", {}, 1200),
+    ("loader_thread", "loader", {}, 1200),
+    ("loader_process", "loader", {"BENCH_LOADER_MODE": "process"}, 1200),
 ]
 
 # bench.py's gate-flip tables (_ab_best) re-run the recorded winner by
