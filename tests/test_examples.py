@@ -268,6 +268,29 @@ def test_gpt_pipeline_parallel_from_yaml(monkeypatch):
     assert abs(single["loss"] - piped["loss"]) < 1e-2
 
 
+def test_gpt_pipeline_with_nested_sp_from_yaml(monkeypatch):
+    """One-switch contract, maximal form: changing only the YAML mesh
+    line (`dp:2,pp:2,sp:2`) plus `pos: rope` (deliberately — rope is
+    the harder sp path, rotating by each shard's GLOBAL positions)
+    routes blocks through the GPipe schedule with ring attention
+    nested inside each stage — loss tracks the single-device run."""
+    gpt = load_example(monkeypatch, "lm", "gpt")
+    conf = gpt.Config.load("gpt.yml")
+    conf.n_iter, conf.log_every = 4, 4
+    conf.model.n_layers, conf.model.d_model = 4, 64
+    conf.model.seq_len, conf.model.vocab, conf.model.n_heads = 64, 256, 4
+    conf.model.pos = "rope"
+    conf.loader.batch_size = 8
+    conf.dataset.n_examples = 64
+    tiny_env(conf)
+    single = gpt.main(conf)
+
+    conf.env.distributed = True
+    conf.env.mesh = "dp:2,pp:2,sp:2"
+    nested = gpt.main(conf)
+    assert abs(single["loss"] - nested["loss"]) < 1e-2
+
+
 def test_gpt_moe_expert_parallel(monkeypatch):
     """MoE GPT on a dp:2,ep:2,tp:2 mesh runs and stays finite, with the
     load-balance aux metric reported."""

@@ -85,6 +85,10 @@ def pipeline_apply(
 
     ``batch_axes`` are the mesh axes the per-microbatch batch dimension
     shards over — default: whichever of ``dp``/``fsdp`` the mesh has.
+    Note the ZeRO-style interaction: when the rule table STORES stage
+    weights sharded over ``fsdp``, the kernel's in_specs (replicated
+    across the data axes) make shard_map gather them at use — sharded
+    at rest, whole during the step — without any extra machinery.
     Each data-parallel group then runs its own pp ring on its own batch
     slice, so dp×pp composes with no replicated compute; pass ``()`` to
     replicate instead. ``B / n_microbatches`` must divide by the product
