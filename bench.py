@@ -27,7 +27,10 @@ BENCH_SKIP_TORCH/GPT/GPT_LONG/LOADER/UNET; A/B variants (see
 scripts/run_ab.py, which drains them through `--sub` children):
 BENCH_FUSED, BENCH_S2D, BENCH_NF (ResNet), BENCH_GPT_CHUNKED,
 BENCH_GPT_REMAT=0, BENCH_GPT_POS=rope, BENCH_GPT_MLP=swiglu,
-BENCH_GPT_KV_HEADS, BENCH_GPT_LONG_KV_HEADS, BENCH_LOADER_MODE/WORKERS;
+BENCH_GPT_KV_HEADS, BENCH_GPT_LONG_KV_HEADS,
+BENCH_GPT_ATTN_IMPL=auto|flash|reference|flash_interpret (forces the
+attention path for both GPT benches — the flash-vs-XLA A/B control),
+BENCH_LOADER_MODE/WORKERS;
 the decode sub-bench (tokens/s through the jitted KV-cache loop;
 BENCH_DECODE_BATCH/NEW/CACHES shape it, BENCH_SKIP_DECODE skips);
 deadlines: BENCH_SUB_DEADLINE or BENCH_DEADLINE_<name>.
@@ -132,6 +135,36 @@ def bench_unet(steps: int) -> float:
     return batch / timed_steps(step, state, {"x": x}, steps)
 
 
+_ATTN_IMPLS = ("auto", "flash", "reference", "flash_interpret")
+
+
+def _attn_impl() -> str:
+    """The GPT benches' attention-impl override (single read point):
+    "auto" (the model's dispatch), "flash"/"reference"/"flash_interpret"
+    forced — exists so flash can be A/B'd against the XLA path at
+    identical settings. Validated here because ``attention()`` routes
+    unknown impl strings to the flash branch — a typo'd "control" run
+    would silently measure flash while reporting otherwise."""
+    impl = os.environ.get("BENCH_GPT_ATTN_IMPL", "auto")
+    if impl not in _ATTN_IMPLS:
+        raise SystemExit(
+            f"BENCH_GPT_ATTN_IMPL={impl!r}: expected one of {_ATTN_IMPLS}")
+    return impl
+
+
+def _attn_resolved(seq_len: int) -> str:
+    """The attention path that will actually execute at ``seq_len``
+    under the current override — what the ``*_flash_engaged`` JSON
+    flags report (the env string alone is not the truth: "auto" may
+    resolve either way, and "flash_interpret" is NOT the compiled
+    kernel)."""
+    impl = _attn_impl()
+    from torchbooster_tpu.ops.attention import flash_auto_engaged
+    if impl == "auto":
+        return "flash" if flash_auto_engaged(seq_len) else "reference"
+    return impl
+
+
 def bench_gpt(steps: int) -> tuple[float, float, bool]:
     """GPT-2 small (12L/768d/12H, vocab 50257, S=1024) train step —
     driver-captured version of the docs' LM claim. Returns
@@ -139,7 +172,6 @@ def bench_gpt(steps: int) -> tuple[float, float, bool]:
     seq_len this run used, not a lookalike constant (the r3 drift
     class)."""
     from torchbooster_tpu.models.gpt import GPT, GPTConfig
-    from torchbooster_tpu.ops.attention import flash_auto_engaged
 
     # BENCH_GPT_POS=rope / BENCH_GPT_MLP=swiglu / BENCH_GPT_KV_HEADS:
     # architecture A/B knobs
@@ -161,7 +193,7 @@ def bench_gpt(steps: int) -> tuple[float, float, bool]:
     dt = timed_steps(step, state, data, steps)
     tok_s = batch * cfg.seq_len / dt
     mfu = 6 * n_params * batch * cfg.seq_len / dt / (SUSTAINED_TFLOPS * 1e12)
-    return tok_s, mfu, flash_auto_engaged(cfg.seq_len)
+    return tok_s, mfu, _attn_resolved(cfg.seq_len) == "flash"
 
 
 def _gpt_loss_fn(cfg):
@@ -176,30 +208,32 @@ def _gpt_loss_fn(cfg):
 
     remat = os.environ.get("BENCH_GPT_REMAT", "1").strip() not in (
         "0", "false", "no")
+    attn_impl = _attn_impl()
 
     if env_flag("BENCH_GPT_CHUNKED"):
         def loss_fn(p, b, rng):
             del rng
             hidden = GPT.apply(p, b["ids"], cfg, remat=remat,
-                               return_hidden=True)
+                               attn_impl=attn_impl, return_hidden=True)
             return lm_head_cross_entropy(
                 hidden[:, :-1], GPT.head_table(p), b["ids"][:, 1:]), {}
         return loss_fn
 
     def loss_fn(p, b, rng):
         del rng
-        logits = GPT.apply(p, b["ids"], cfg, remat=remat)
+        logits = GPT.apply(p, b["ids"], cfg, remat=remat,
+                           attn_impl=attn_impl)
         return cross_entropy(logits[:, :-1].reshape(-1, cfg.vocab),
                              b["ids"][:, 1:].reshape(-1)), {}
     return loss_fn
 
 
-def bench_gpt_long(steps: int) -> tuple[float, float]:
+def bench_gpt_long(steps: int) -> tuple[float, float, bool]:
     """Long-context GPT (S=8192, 4L/768d/12H) train step — the driver-
     captured version of the flash-attention claim. Asserts the auto
     dispatch actually takes the pallas flash kernel at this length, so
     the recorded number exercises flash fwd AND bwd on the real chip.
-    Returns (tokens/s, mfu)."""
+    Returns (tokens/s, mfu, flash_engaged)."""
     from torchbooster_tpu.models.gpt import GPT, GPTConfig
     from torchbooster_tpu.ops.attention import flash_auto_engaged
 
@@ -208,9 +242,12 @@ def bench_gpt_long(steps: int) -> tuple[float, float]:
                         "BENCH_GPT_LONG_KV_HEADS", 0)))
     # assert the EXACT predicate the model's dispatch evaluates — a
     # lookalike check once passed here while the dispatch itself took
-    # the reference path (r3 finding)
-    assert flash_auto_engaged(cfg.seq_len), \
-        "flash auto-dispatch not engaged"
+    # the reference path (r3 finding). A BENCH_GPT_ATTN_IMPL override
+    # opts out: the knob exists to A/B flash against the XLA path at
+    # identical settings.
+    if _attn_impl() == "auto":
+        assert flash_auto_engaged(cfg.seq_len), \
+            "flash auto-dispatch not engaged"
 
     batch = int(os.environ.get("BENCH_GPT_LONG_BATCH", 1))
     params = GPT.init(jax.random.PRNGKey(0), cfg)
@@ -226,7 +263,7 @@ def bench_gpt_long(steps: int) -> tuple[float, float]:
     dt = timed_steps(step, state, data, steps)
     tok_s = batch * cfg.seq_len / dt
     mfu = 6 * n_params * batch * cfg.seq_len / dt / (SUSTAINED_TFLOPS * 1e12)
-    return tok_s, mfu
+    return tok_s, mfu, _attn_resolved(cfg.seq_len) == "flash"
 
 
 def bench_decode() -> dict:
@@ -507,12 +544,14 @@ def _sub_main(name: str) -> None:
                           "gpt_mfu": round(mfu, 4),
                           "gpt_flash_engaged": engaged}))
     elif name == "gpt_long":
-        tok_s, mfu = bench_gpt_long(max(4, steps // 4))
-        # bench_gpt_long asserts the dispatch predicate before running,
-        # so reaching this line means flash fwd+bwd actually executed
+        # the flag comes from the same resolution the loss fn uses
+        # (_attn_resolved), so a forced override — including
+        # flash_interpret, which is NOT the compiled kernel — is
+        # reported as what actually executed
+        tok_s, mfu, engaged = bench_gpt_long(max(4, steps // 4))
         print(json.dumps({"gpt_long_tokens_per_sec": round(tok_s, 1),
                           "gpt_long_mfu": round(mfu, 4),
-                          "gpt_long_flash_engaged": True}))
+                          "gpt_long_flash_engaged": engaged}))
     elif name == "unet":
         ips = bench_unet(max(6, steps // 3))
         print(json.dumps({"unet_img_per_sec": round(ips, 2)}))
@@ -726,7 +765,8 @@ def main() -> None:
             env_over, gpt_variant = _ab_best(
                 _AB_GPT_VARIANTS, "gpt", "gpt_tokens_per_sec",
                 manual_keys=("BENCH_GPT_POS", "BENCH_GPT_MLP",
-                             "BENCH_GPT_KV_HEADS"))
+                             "BENCH_GPT_KV_HEADS",
+                             "BENCH_GPT_ATTN_IMPL"))
             out["gpt_variant"] = gpt_variant
         frag = _run_sub(name, _deadline(name, default), env_over=env_over)
         if frag is not None:

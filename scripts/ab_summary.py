@@ -32,6 +32,7 @@ METRICS = {
     "gpt_swiglu": ("gpt tok/s", "gpt_tokens_per_sec"),
     "gpt_gqa4": ("gpt tok/s", "gpt_tokens_per_sec"),
     "gpt_long_flash": ("gpt-long tok/s", "gpt_long_tokens_per_sec"),
+    "gpt_long_ref": ("gpt-long tok/s", "gpt_long_tokens_per_sec"),
     "gpt_long_b2": ("gpt-long tok/s", "gpt_long_tokens_per_sec"),
     "gpt_long_b4": ("gpt-long tok/s", "gpt_long_tokens_per_sec"),
     "gpt_long_gqa4": ("gpt-long tok/s", "gpt_long_tokens_per_sec"),

@@ -59,6 +59,11 @@ QUEUE: list[tuple[str, str, dict, int]] = [
     ("gpt_long_gqa4", "gpt_long", {"BENCH_GPT_LONG_KV_HEADS": "4"}, 1500),
     ("gpt_long_b2", "gpt_long", {"BENCH_GPT_LONG_BATCH": "2"}, 1500),
     ("gpt_long_b4", "gpt_long", {"BENCH_GPT_LONG_BATCH": "4"}, 1500),
+    # same-settings XLA-reference control for the flash number: the r3
+    # reference-path capture (100.7k tok/s) predates the dispatch fix,
+    # so the flash claim needs an A/B measured in the same session
+    ("gpt_long_ref", "gpt_long",
+     {"BENCH_GPT_ATTN_IMPL": "reference"}, 1800),
     ("gpt_rope", "gpt", {"BENCH_GPT_POS": "rope"}, 1200),
     ("gpt_swiglu", "gpt", {"BENCH_GPT_MLP": "swiglu"}, 1200),
     ("gpt_gqa4", "gpt", {"BENCH_GPT_KV_HEADS": "4"}, 1200),

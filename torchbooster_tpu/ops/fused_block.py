@@ -43,6 +43,9 @@ from jax.experimental.pallas import tpu as pltpu
 # per-sample VMEM working set must fit comfortably; beyond this the
 # XLA path takes over (stem-sized spatial maps)
 _VMEM_BUDGET_BYTES = 12 * 2**20
+# scoped-vmem ceiling passed to Mosaic (default 16M): gives the fp32
+# stack temporaries ~2× headroom over the _cell_bytes model's budget
+_VMEM_LIMIT_BYTES = 32 * 2**20
 
 
 def _resolve_groups(groups: int, c: int) -> int:
@@ -91,12 +94,17 @@ def _fwd_kernel(x_ref, w_ref, scale_ref, bias_ref, avg_ref,
 
 def _cell_bytes(g: int, m: int, cin: int, cout: int, itemsize: int,
                 taps: int = 1, x_copies: int = 1) -> int:
-    """VMEM working set of one grid cell processing ``g`` samples:
-    ``x_copies`` x blocks (the 3×3 kernel keeps a padded copy) + fp32 y
-    + output, plus the resident weight (``taps``·Cin·Cout — 9 for 3×3)
-    and membership matrix."""
-    per_sample = x_copies * m * cin * itemsize + m * cout * 4 \
-        + m * cout * itemsize
+    """VMEM working set of one grid cell processing ``g`` samples.
+    Counts what Mosaic actually keeps live on the kernel stack (an
+    optimistic x+y+out model chose g=4 at the 56²/C=64 stage and OOMed
+    the 16M scoped-vmem limit at 21.9M on chip): the x block double-
+    buffered by the DMA pipeline (×2, plus the 3×3 kernel's padded
+    copy), three fp32 (M, Cout) temporaries (the accumulator, the
+    ``acc·acc`` moment square, the normalized out before the cast) and
+    the cast output + its DMA buffer, plus the resident weight
+    (``taps``·Cin·Cout — 9 for 3×3) and membership matrix."""
+    per_sample = (x_copies + 1) * m * cin * itemsize \
+        + 3 * m * cout * 4 + 2 * m * cout * itemsize
     return taps * cin * cout * itemsize + cout * cout * 4 + g * per_sample
 
 
@@ -149,9 +157,13 @@ def _fwd(x3, w, scale, bias, groups: int, eps: float, relu: bool,
             jax.ShapeDtypeStruct((b, 1, cout), jnp.float32),
             jax.ShapeDtypeStruct((b, 1, cout), jnp.float32),
         ],
-        # cells are independent: let Mosaic pipeline DMA across them
+        # cells are independent: let Mosaic pipeline DMA across them.
+        # vmem_limit raised over the 16M scoped default: the stack's
+        # fp32 temporaries run ~1.4× past the _cell_bytes model (the
+        # fused_s2d chip OOM), and headroom beats a mis-priced cell
         compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel",)),
+            dimension_semantics=("parallel",),
+            vmem_limit_bytes=_VMEM_LIMIT_BYTES),
         interpret=interpret,
     )(x3, w, scale.reshape(1, -1), bias.reshape(1, -1), avg)
 
@@ -239,8 +251,11 @@ def _fwd3_kernel(x_ref, w_ref, scale_ref, bias_ref, avg_ref,
     for dy in (-1, 0, 1):
         for dx in (-1, 0, 1):
             shift = dy * w_sp + dx
-            src = jax.lax.dynamic_slice_in_dim(
-                xp, w_sp + 1 + shift, m, axis=1)    # rows m+shift
+            # static python slice (shift is a trace-time constant):
+            # lowers to lax.slice — Mosaic has no dynamic_slice rule
+            # for TC kernels, so dynamic_slice_in_dim fails on chip
+            start = w_sp + 1 + shift
+            src = xp[:, start:start + m, :]         # rows m+shift
             if dx:
                 valid = ((col + dx) >= 0) & ((col + dx) < w_sp)
                 src = src * valid.astype(src.dtype)
@@ -312,7 +327,8 @@ def _conv3x3_gn(x4, w, scale, bias, groups, eps, relu, interpret):
         out_specs=pl.BlockSpec((g, m, cout), lambda i: (i, 0, 0)),
         out_shape=jax.ShapeDtypeStruct((b, m, cout), x4.dtype),
         compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel",)),
+            dimension_semantics=("parallel",),
+            vmem_limit_bytes=_VMEM_LIMIT_BYTES),
         interpret=interpret,
     )(x4.reshape(b, m, cin), w, scale.reshape(1, -1),
       bias.reshape(1, -1), avg)
