@@ -587,6 +587,10 @@ _AB_GPT_VARIANTS = {
     "gpt_chunked": {"BENCH_GPT_CHUNKED": "1"},
     "gpt_noremat": {"BENCH_GPT_REMAT": "0"},
     "gpt_b32": {"BENCH_GPT_BATCH": "32"},
+    # the chunked head's saved logits memory is what a bigger batch
+    # spends: the combo is the natural follow-up to a chunked win
+    "gpt_chunked_b32": {"BENCH_GPT_CHUNKED": "1",
+                        "BENCH_GPT_BATCH": "32"},
 }
 
 

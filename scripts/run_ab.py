@@ -56,6 +56,8 @@ QUEUE: list[tuple[str, str, dict, int]] = [
     ("gpt_chunked", "gpt", {"BENCH_GPT_CHUNKED": "1"}, 1200),
     ("gpt_noremat", "gpt", {"BENCH_GPT_REMAT": "0"}, 1200),
     ("gpt_b32", "gpt", {"BENCH_GPT_BATCH": "32"}, 1200),
+    ("gpt_chunked_b32", "gpt",
+     {"BENCH_GPT_CHUNKED": "1", "BENCH_GPT_BATCH": "32"}, 1200),
     ("gpt_long_gqa4", "gpt_long", {"BENCH_GPT_LONG_KV_HEADS": "4"}, 1500),
     ("gpt_long_b2", "gpt_long", {"BENCH_GPT_LONG_BATCH": "2"}, 1500),
     ("gpt_long_b4", "gpt_long", {"BENCH_GPT_LONG_BATCH": "4"}, 1500),
