@@ -30,7 +30,8 @@ BENCH_GPT_REMAT=0, BENCH_GPT_POS=rope, BENCH_GPT_MLP=swiglu,
 BENCH_GPT_KV_HEADS, BENCH_GPT_LONG_KV_HEADS,
 BENCH_GPT_ATTN_IMPL=auto|flash|reference|flash_interpret (forces the
 attention path for both GPT benches — the flash-vs-XLA A/B control),
-BENCH_LOADER_MODE/WORKERS;
+TB_FLASH_BLOCK_Q/TB_FLASH_BLOCK_K (flash tile-geometry sweep, read by
+ops/flash_attention itself), BENCH_LOADER_MODE/WORKERS;
 the decode sub-bench (tokens/s through the jitted KV-cache loop;
 BENCH_DECODE_BATCH/NEW/CACHES shape it, BENCH_SKIP_DECODE skips);
 deadlines: BENCH_SUB_DEADLINE or BENCH_DEADLINE_<name>.

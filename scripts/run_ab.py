@@ -54,6 +54,12 @@ QUEUE: list[tuple[str, str, dict, int]] = [
     ("s2d", "resnet", {"BENCH_S2D": "1"}, 1200),
     ("fused_s2d", "resnet", {"BENCH_FUSED": "1", "BENCH_S2D": "1"}, 1800),
     ("gpt_chunked", "gpt", {"BENCH_GPT_CHUNKED": "1"}, 1200),
+    # same-settings XLA-reference control for the flash number: the r3
+    # reference-path capture (100.7k tok/s) predates the dispatch fix,
+    # so the flash claim needs an A/B measured in the same session —
+    # high in the order because the headline claim hinges on it
+    ("gpt_long_ref", "gpt_long",
+     {"BENCH_GPT_ATTN_IMPL": "reference"}, 1800),
     ("gpt_noremat", "gpt", {"BENCH_GPT_REMAT": "0"}, 1200),
     ("gpt_b32", "gpt", {"BENCH_GPT_BATCH": "32"}, 1200),
     ("gpt_chunked_b32", "gpt",
@@ -61,11 +67,12 @@ QUEUE: list[tuple[str, str, dict, int]] = [
     ("gpt_long_gqa4", "gpt_long", {"BENCH_GPT_LONG_KV_HEADS": "4"}, 1500),
     ("gpt_long_b2", "gpt_long", {"BENCH_GPT_LONG_BATCH": "2"}, 1500),
     ("gpt_long_b4", "gpt_long", {"BENCH_GPT_LONG_BATCH": "4"}, 1500),
-    # same-settings XLA-reference control for the flash number: the r3
-    # reference-path capture (100.7k tok/s) predates the dispatch fix,
-    # so the flash claim needs an A/B measured in the same session
-    ("gpt_long_ref", "gpt_long",
-     {"BENCH_GPT_ATTN_IMPL": "reference"}, 1800),
+    # flash tile-geometry sweep (library default 1024x1024): candidate
+    # answers if the gpt_long_ref control shows flash losing end-to-end
+    ("gpt_long_blk512", "gpt_long",
+     {"TB_FLASH_BLOCK_Q": "512", "TB_FLASH_BLOCK_K": "512"}, 1500),
+    ("gpt_long_q2048k512", "gpt_long",
+     {"TB_FLASH_BLOCK_Q": "2048", "TB_FLASH_BLOCK_K": "512"}, 1500),
     ("gpt_rope", "gpt", {"BENCH_GPT_POS": "rope"}, 1200),
     ("gpt_swiglu", "gpt", {"BENCH_GPT_MLP": "swiglu"}, 1200),
     ("gpt_gqa4", "gpt", {"BENCH_GPT_KV_HEADS": "4"}, 1200),

@@ -40,6 +40,7 @@ from __future__ import annotations
 
 import functools
 import math
+import os
 
 import jax
 import jax.numpy as jnp
@@ -55,13 +56,21 @@ LANES = 8
 MIN_BLOCK = 8  # sublane width — smallest sane tile edge
 
 
-def tileable(seq: int, block: int = 1024) -> bool:
+def tileable(seq: int, block: int | None = None) -> bool:
     """True when :func:`flash_attention` can tile ``seq`` — the auto
     dispatcher checks this and falls back to the XLA reference instead
     of crashing on awkward lengths. Delegates to :func:`_pick_block` so
-    the predicate can never drift from the actual tiling policy."""
+    the predicate can never drift from the actual tiling policy —
+    including the ``TB_FLASH_BLOCK_*`` env defaults: with no explicit
+    ``block``, BOTH resolved defaults must tile (the caller doesn't say
+    whether ``seq`` is a q or kv length, and a predicate that passes on
+    one geometry while the kernel runs the other is the drift this
+    function exists to prevent)."""
+    blocks = ([block] if block is not None
+              else [_block_default("Q"), _block_default("K")])
     try:
-        _pick_block(block, seq, "seq")
+        for b in blocks:
+            _pick_block(b, seq, "seq")
         return True
     except ValueError:
         return False
@@ -385,17 +394,34 @@ def _flash_bwd(causal, sm_scale, block_q, block_k, interpret, res, do):
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
+def _block_default(name: str) -> int:
+    """Tile-size default, env-overridable (``TB_FLASH_BLOCK_Q`` /
+    ``TB_FLASH_BLOCK_K``) so on-chip A/Bs can sweep tile geometry
+    through callers that don't thread block sizes (the GPT train step);
+    an explicit ``block_q=``/``block_k=`` argument always wins.
+    Resolved OUTSIDE :func:`_flash_entry`'s jit so ITS cache keys on
+    the resolved ints. NOTE: a caller that wraps :func:`flash_attention`
+    in its own outer jit (the GPT train step) bakes the env read into
+    that outer trace — mid-process sweeps must re-jit or use fresh
+    processes (scripts/run_ab.py runs one process per config)."""
+    return int(os.environ.get(f"TB_FLASH_BLOCK_{name}", 1024))
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("causal", "sm_scale", "block_q", "block_k", "interpret"))
+def _flash_entry(q, k, v, causal, sm_scale, block_q, block_k, interpret):
+    return _flash(q, k, v, causal, sm_scale, block_q, block_k, interpret)
+
+
 def flash_attention(
     q: jax.Array,
     k: jax.Array,
     v: jax.Array,
     causal: bool = True,
     sm_scale: float | None = None,
-    block_q: int = 1024,
-    block_k: int = 1024,
+    block_q: int | None = None,
+    block_k: int | None = None,
     interpret: bool = False,
 ) -> jax.Array:
     """Blocked attention over (BH, S, D) tensors; differentiable (the
@@ -418,9 +444,12 @@ def flash_attention(
                          f"by grouped k/v rows ({bh_kv})")
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(head_dim)
-    block_q = _pick_block(block_q, seq_q, "seq_q")
-    block_k = _pick_block(block_k, seq_kv, "seq_kv")
-    return _flash(q, k, v, causal, sm_scale, block_q, block_k, interpret)
+    block_q = _pick_block(block_q if block_q is not None
+                          else _block_default("Q"), seq_q, "seq_q")
+    block_k = _pick_block(block_k if block_k is not None
+                          else _block_default("K"), seq_kv, "seq_kv")
+    return _flash_entry(q, k, v, causal, sm_scale, block_q, block_k,
+                        interpret)
 
 
 __all__ = ["flash_attention", "tileable"]
