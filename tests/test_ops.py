@@ -815,3 +815,87 @@ def test_flash_grouped_kv_multiblock_sweep(causal):
         np.testing.assert_allclose(np.asarray(g), np.asarray(r),
                                    rtol=2e-3, atol=2e-3,
                                    err_msg=f"d{name} (causal={causal})")
+
+
+def test_bench_attn_impl_knob(monkeypatch):
+    """BENCH_GPT_ATTN_IMPL is validated at the single read point (a
+    typo'd "control" run would silently measure flash: attention()
+    routes unknown impl strings to the flash branch), and the resolved
+    path — what the *_flash_engaged JSON flags report — reflects what
+    actually executes, incl. flash_interpret NOT counting as flash."""
+    import sys
+    from pathlib import Path
+
+    sys.path.insert(0, str(Path(__file__).parent.parent))
+    try:
+        from bench import _attn_impl, _attn_resolved
+    finally:
+        sys.path.pop(0)
+
+    monkeypatch.delenv("BENCH_GPT_ATTN_IMPL", raising=False)
+    assert _attn_impl() == "auto"
+    # on the CPU test backend the auto dispatch resolves to reference
+    assert _attn_resolved(8192) == "reference"
+    monkeypatch.setenv("BENCH_GPT_ATTN_IMPL", "reference")
+    assert _attn_resolved(8192) == "reference"
+    monkeypatch.setenv("BENCH_GPT_ATTN_IMPL", "flash_interpret")
+    assert _attn_resolved(8192) == "flash_interpret"  # not "flash"
+    monkeypatch.setenv("BENCH_GPT_ATTN_IMPL", "xla")
+    with pytest.raises(SystemExit):
+        _attn_impl()
+
+
+def test_flash_block_env_override(monkeypatch):
+    """TB_FLASH_BLOCK_Q/K sweep the tile geometry without threading
+    block sizes through callers: numerics are tile-invariant, an
+    explicit block argument beats the env, and tileable() — the auto
+    dispatch predicate — evaluates the SAME resolved defaults, so an
+    un-tileable override falls back to the reference path instead of
+    raising mid-step."""
+    from torchbooster_tpu.ops.flash_attention import (
+        _block_default, flash_attention, tileable)
+
+    monkeypatch.delenv("TB_FLASH_BLOCK_Q", raising=False)
+    monkeypatch.delenv("TB_FLASH_BLOCK_K", raising=False)
+    q = jax.random.normal(jax.random.PRNGKey(0), (2, 128, 16),
+                          jnp.float32)
+    base = flash_attention(q, q, q, interpret=True)
+    monkeypatch.setenv("TB_FLASH_BLOCK_Q", "64")
+    monkeypatch.setenv("TB_FLASH_BLOCK_K", "32")
+    assert (_block_default("Q"), _block_default("K")) == (64, 32)
+    np.testing.assert_allclose(
+        np.asarray(flash_attention(q, q, q, interpret=True)),
+        np.asarray(base), rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(flash_attention(q, q, q, block_q=128, block_k=128,
+                                   interpret=True)),
+        np.asarray(base), rtol=1e-5, atol=1e-5)
+    # predicate/policy anti-drift: 768 halves to 6 < MIN_BLOCK for 8192
+    monkeypatch.setenv("TB_FLASH_BLOCK_Q", "768")
+    assert not tileable(8192)
+    monkeypatch.delenv("TB_FLASH_BLOCK_Q")
+    assert tileable(8192)
+
+
+def test_ab_summary_renders_unknown_configs(tmp_path):
+    """ab_summary renders configs present in the log but missing from
+    its METRICS table (queue entries drift in faster than the table —
+    decode and gpt_chunked_b32 both did) instead of silently dropping
+    recorded evidence; failed decode attempts stay visible."""
+    import json as _json
+    import subprocess
+    import sys
+    from pathlib import Path
+
+    repo = Path(__file__).parent.parent
+    log = tmp_path / "ab.jsonl"
+    log.write_text("\n".join(_json.dumps(e) for e in [
+        {"config": "mystery", "status": "ok", "seconds": 1.0,
+         "result": {"x": 1}},
+        {"config": "decode", "status": "timeout", "seconds": 1800},
+    ]))
+    out = subprocess.run(
+        [sys.executable, str(repo / "scripts" / "ab_summary.py"),
+         str(log)], capture_output=True, text=True, check=True).stdout
+    assert "mystery" in out
+    assert "decode" in out and "failed attempt" in out
