@@ -468,6 +468,85 @@ def _first_json_line(text: str) -> str | None:
                 None)
 
 
+def _pid_alive(path: str) -> int | None:
+    """The pid recorded at ``path`` if that process is still running,
+    else None (missing file, unparsable, or dead pid — stale sentinels
+    from a killed process must not wedge anyone)."""
+    try:
+        with open(path) as f:
+            pid = int(f.read().strip())
+        os.kill(pid, 0)
+        return pid
+    except (OSError, ValueError):
+        return None
+
+
+def _sentinel_path(name: str) -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "logs", name)
+
+
+class _sentinel:
+    """Advisory pid-file marking who is driving the single chip. The
+    watcher (scripts/run_ab.py) and the driver's end-of-round bench
+    both shell chip work through bench.py children; unserialised they
+    contend for the one tunnel and both measure garbage.
+
+    Protocol (race-tolerant because both sides WRITE their own sentinel
+    before CHECKING the peer's): the driver takes ``driver_bench.pid``,
+    then waits out a live ``watcher_config.pid``; the watcher takes
+    ``watcher_config.pid`` per config, then aborts the config (removing
+    its sentinel) if a live driver appeared — simultaneous starts
+    resolve with the watcher backing off and the driver proceeding.
+
+    ``wait_free`` serializes same-name holders (two driver benches):
+    ``__enter__`` polls while a live foreign pid holds the file, then
+    proceeds regardless (advisory, never deadlocks). ``__exit__`` only
+    removes the file when it still holds OUR pid, so a foreign
+    overwrite is not clobbered."""
+
+    def __init__(self, name: str, wait_free: int = 0):
+        self.path = _sentinel_path(name)
+        self.wait_free = wait_free
+
+    def __enter__(self):
+        os.makedirs(os.path.dirname(self.path), exist_ok=True)
+        waited = 0
+        while waited < self.wait_free:
+            holder = _pid_alive(self.path)
+            if holder is None or holder == os.getpid():
+                break
+            time.sleep(10)
+            waited += 10
+        with open(self.path, "w") as f:
+            f.write(str(os.getpid()))
+        return self
+
+    def __exit__(self, *exc):
+        if _pid_alive(self.path) == os.getpid():
+            try:
+                os.remove(self.path)
+            except OSError:  # pragma: no cover - already gone
+                pass
+
+
+# How long the driver waits out a live watcher config before
+# proceeding anyway. MUST stay strictly above the watcher's largest
+# per-config deadline or the driver starts measuring while a wedged
+# config still owns the chip — scripts/run_ab.py asserts
+# max(QUEUE deadlines) < this at watcher start, so raising a deadline
+# there fails fast instead of silently re-opening the race.
+_DRIVER_MAX_WAIT = 2100
+
+
+def _wait_for(name: str, max_wait: int, poll: int = 15) -> None:
+    """Block until the ``name`` sentinel's process exits (or max_wait)."""
+    waited = 0
+    while waited < max_wait and _pid_alive(_sentinel_path(name)):
+        time.sleep(poll)
+        waited += poll
+
+
 def _run_group(cmd: list, deadline: int, env: dict | None = None):
     """Run ``cmd`` in its OWN SESSION under a hard deadline and, on
     expiry, SIGKILL the whole process group. ``subprocess.run(timeout=)``
@@ -685,6 +764,17 @@ def main() -> None:
     # the tunnel is down, the first device call never returns. Probe in
     # a child, then run each sub-bench in its own child under a
     # deadline.
+    #
+    # Serialization with the watcher starts BEFORE the probe (the probe
+    # matmul itself would contend with an in-flight watcher
+    # measurement): take the driver sentinel (waiting out another
+    # driver, if any), wait out a live watcher config, then probe.
+    with _sentinel("driver_bench.pid", wait_free=3600):
+        _wait_for("watcher_config.pid", max_wait=_DRIVER_MAX_WAIT)
+        _main_probe_and_orchestrate()
+
+
+def _main_probe_and_orchestrate() -> None:
     backend = _probe_tpu()
     if backend == "cpu":
         # a box without the TPU plugin: run the small-shape CPU bench
@@ -707,6 +797,10 @@ def main() -> None:
                        "recorded wins automatically (_ab_best)"}))
         return
 
+    _main_tpu_orchestrate()
+
+
+def _main_tpu_orchestrate() -> None:
     batch, image, steps = _shapes(True)
     out = {
         "metric": "ResNet-50 train images/sec/chip "

@@ -31,9 +31,13 @@ sys.path.insert(0, REPO)
 from bench import (  # noqa: E402
     _AB_GPT_VARIANTS,
     _AB_RESNET_VARIANTS,
+    _DRIVER_MAX_WAIT,
     _first_json_line,
+    _pid_alive,
     _probe_tpu,
     _run_group,
+    _sentinel,
+    _sentinel_path,
 )
 
 # name -> (sub-bench, env overrides, deadline seconds). Deadlines are
@@ -93,6 +97,17 @@ for _name, _env in {**_AB_RESNET_VARIANTS, **_AB_GPT_VARIANTS}.items():
         f"bench.py A/B variant {_name!r} ({_env}) out of sync with "
         f"run_ab.py QUEUE ({_QUEUE_ENV.get(_name)})")
 
+# the driver waits out a live watcher config for bench._DRIVER_MAX_WAIT
+# before proceeding anyway — the sentinel is held through the liveness
+# probe PLUS the config deadline, so the full worst-case hold must stay
+# below it or the race the handshake closes silently re-opens
+_PROBE_TIMEOUT = 150
+_MAX_DEADLINE = max(d for _, _, _, d in QUEUE)
+assert _MAX_DEADLINE + _PROBE_TIMEOUT < _DRIVER_MAX_WAIT, (
+    f"QUEUE deadline {_MAX_DEADLINE}s + probe {_PROBE_TIMEOUT}s >= "
+    f"bench._DRIVER_MAX_WAIT {_DRIVER_MAX_WAIT}s: raise "
+    f"_DRIVER_MAX_WAIT with it")
+
 def log(msg: str) -> None:
     print(f"[{time.strftime('%H:%M:%S')}] {msg}", flush=True)
 
@@ -118,14 +133,28 @@ def load_entries() -> list[dict]:
 
 
 def run_config(name: str, sub: str, env_over: dict, deadline: int) -> str:
+    """One config under the watcher sentinel. ALL chip traffic —
+    including the liveness probe — happens inside the sentinel:
+    handshake order matters (our sentinel is WRITTEN before the driver
+    check, so a driver starting concurrently either sees it and waits
+    us out, or we see the driver here and back off — no interleaving
+    where both measure; see bench._sentinel)."""
     env = {**os.environ, **env_over,
            # steps trimmed: enough for a stable mean, small enough that
            # a flaky tunnel window still fits a full config
            "BENCH_STEPS": os.environ.get("AB_STEPS", "12")}
-    t0 = time.time()
-    out, err, rc = _run_group(
-        [sys.executable, os.path.join(REPO, "bench.py"), "--sub", sub],
-        deadline, env=env)
+    # wait_free serializes concurrent watchers (a double-fired launch
+    # line): bounded by the peer's worst-case hold, probe + deadline
+    with _sentinel("watcher_config.pid",
+                   wait_free=_MAX_DEADLINE + _PROBE_TIMEOUT + 60):
+        if _pid_alive(_sentinel_path("driver_bench.pid")):
+            return "deferred"
+        if _probe_tpu(_PROBE_TIMEOUT) != "tpu":
+            return "down"
+        t0 = time.time()   # measurement time only — probe excluded
+        out, err, rc = _run_group(
+            [sys.executable, os.path.join(REPO, "bench.py"), "--sub", sub],
+            deadline, env=env)
     if rc is None:
         record({"config": name, "status": "timeout", "seconds": deadline})
         return "timeout"
@@ -145,14 +174,18 @@ def main() -> None:
     pending = [c for c in QUEUE if c[0] not in done]
     log(f"pending configs: {[c[0] for c in pending]}")
     while pending:
-        if _probe_tpu(150) != "tpu":
-            log("chip down; sleeping 300s")
-            time.sleep(300)
-            continue
         name, sub, env_over, deadline = pending.pop(0)
-        log(f"chip up; running {name} (deadline {deadline}s)")
+        log(f"running {name} (deadline {deadline}s)")
         status = run_config(name, sub, env_over, deadline)
         log(f"{name}: {status}")
+        if status in ("deferred", "down"):
+            # nothing ran (driver owns the chip / tunnel down): put the
+            # config back at the FRONT (no attempt consumed) and pace
+            # the retry — these sleeps are THE pacing, the handshake
+            # itself is instant
+            pending.insert(0, (name, sub, env_over, deadline))
+            time.sleep(60 if status == "deferred" else 300)
+            continue
         # keep a timed-out/errored config for ONE retry at the back of
         # the queue (tunnel may have dropped mid-config), then drop it
         if status != "ok":
