@@ -27,7 +27,8 @@ BENCH_SKIP_TORCH/GPT/GPT_LONG/LOADER/UNET; A/B variants (see
 scripts/run_ab.py, which drains them through `--sub` children):
 BENCH_FUSED, BENCH_S2D, BENCH_NF (ResNet), BENCH_GPT_CHUNKED,
 BENCH_GPT_REMAT=0, BENCH_GPT_POS=rope, BENCH_GPT_MLP=swiglu,
-BENCH_GPT_KV_HEADS, BENCH_GPT_LONG_KV_HEADS,
+BENCH_GPT_KV_HEADS, BENCH_GPT_LONG_KV_HEADS, BENCH_GPT_LONG_SEQ,
+BENCH_GPT_LONG_LAYERS (context-length scaling rows),
 BENCH_GPT_ATTN_IMPL=auto|flash|reference|flash_interpret (forces the
 attention path for both GPT benches — the flash-vs-XLA A/B control),
 TB_FLASH_BLOCK_Q/TB_FLASH_BLOCK_K (flash tile-geometry sweep, read by
@@ -230,15 +231,25 @@ def _gpt_loss_fn(cfg):
 
 
 def bench_gpt_long(steps: int) -> tuple[float, float, bool]:
-    """Long-context GPT (S=8192, 4L/768d/12H) train step — the driver-
-    captured version of the flash-attention claim. Asserts the auto
-    dispatch actually takes the pallas flash kernel at this length, so
-    the recorded number exercises flash fwd AND bwd on the real chip.
-    Returns (tokens/s, mfu, flash_engaged)."""
+    """Long-context GPT train step (default S=8192, 4L/768d/12H;
+    BENCH_GPT_LONG_SEQ / BENCH_GPT_LONG_LAYERS sweep the geometry) —
+    the driver-captured version of the flash-attention claim. Asserts
+    the auto dispatch actually takes the pallas flash kernel at the
+    configured length, so the recorded number exercises flash fwd AND
+    bwd on the real chip. Returns (tokens/s, mfu, flash_engaged);
+    unlike bench_gpt's standard 6·N·D convention, the MFU here counts
+    causal-attention FLOPs and excludes the wpe lookup table — see the
+    formula comment — because both scale with the swept S."""
     from torchbooster_tpu.models.gpt import GPT, GPTConfig
     from torchbooster_tpu.ops.attention import flash_auto_engaged
 
-    cfg = GPTConfig(n_layers=4, seq_len=8192,
+    # BENCH_GPT_LONG_SEQ sweeps the context length (the scaling table:
+    # at S=32k the reference path's score materialization is already
+    # multi-GB per head — flash is the only single-chip option)
+    cfg = GPTConfig(n_layers=int(os.environ.get(
+                        "BENCH_GPT_LONG_LAYERS", 4)),
+                    seq_len=int(os.environ.get(
+                        "BENCH_GPT_LONG_SEQ", 8192)),
                     n_kv_heads=int(os.environ.get(
                         "BENCH_GPT_LONG_KV_HEADS", 0)))
     # assert the EXACT predicate the model's dispatch evaluates — a
@@ -263,7 +274,18 @@ def bench_gpt_long(steps: int) -> tuple[float, float, bool]:
     data = {"ids": ids}
     dt = timed_steps(step, state, data, steps)
     tok_s = batch * cfg.seq_len / dt
-    mfu = 6 * n_params * batch * cfg.seq_len / dt / (SUSTAINED_TFLOPS * 1e12)
+    # FLOPs/token: 6·N over the MATMUL params only (wpe is a lookup
+    # and grows with the swept seq_len — counting it would inflate the
+    # long rows), plus causal attention's 6·L·S·d (QKᵀ+PV at average
+    # context S/2, fwd+bwd at the usual 3×fwd). At S=32k the attention
+    # term rivals the param term, so a 6N-only MFU is meaningless
+    # across the sweep.
+    n_matmul = n_params - (cfg.seq_len * cfg.d_model
+                           if cfg.pos == "learned" else 0)
+    flop_per_tok = (6 * n_matmul
+                    + 6 * cfg.n_layers * cfg.seq_len * cfg.d_model)
+    mfu = (flop_per_tok * batch * cfg.seq_len / dt
+           / (SUSTAINED_TFLOPS * 1e12))
     return tok_s, mfu, _attn_resolved(cfg.seq_len) == "flash"
 
 
@@ -674,6 +696,26 @@ _AB_GPT_VARIANTS = {
 }
 
 
+# same-math long-context variants (same model, same S=8192 workload;
+# tokens/s comparable): kernel choice, tile geometry, remat, batch.
+# gqa4 changes the MODEL and the s16k/s32k rows change the WORKLOAD
+# (tokens/s across different S is not a comparison) — never flipped.
+# gpt_long_ref is deliberately INCLUDED: the XLA reference computes
+# identical math, and if it wins end-to-end the headline should
+# honestly run it (the flash_engaged flag self-describes the pick).
+_AB_GPT_LONG_VARIANTS = {
+    "gpt_long_flash": {},
+    "gpt_long_ref": {"BENCH_GPT_ATTN_IMPL": "reference"},
+    "gpt_long_noremat": {"BENCH_GPT_REMAT": "0"},
+    "gpt_long_blk512": {"TB_FLASH_BLOCK_Q": "512",
+                        "TB_FLASH_BLOCK_K": "512"},
+    "gpt_long_q2048k512": {"TB_FLASH_BLOCK_Q": "2048",
+                           "TB_FLASH_BLOCK_K": "512"},
+    "gpt_long_b2": {"BENCH_GPT_LONG_BATCH": "2"},
+    "gpt_long_b4": {"BENCH_GPT_LONG_BATCH": "4"},
+}
+
+
 def _ab_best(variants: dict[str, dict], baseline: str,
              value_key: str, path: str | None = None,
              manual_keys: tuple = ()) -> tuple[dict, str]:
@@ -867,6 +909,15 @@ def _main_tpu_orchestrate() -> None:
                              "BENCH_GPT_KV_HEADS",
                              "BENCH_GPT_ATTN_IMPL"))
             out["gpt_variant"] = gpt_variant
+        elif name == "gpt_long":
+            env_over, long_variant = _ab_best(
+                _AB_GPT_LONG_VARIANTS, "gpt_long_flash",
+                "gpt_long_tokens_per_sec",
+                manual_keys=("BENCH_GPT_LONG_KV_HEADS",
+                             "BENCH_GPT_LONG_SEQ",
+                             "BENCH_GPT_LONG_LAYERS",
+                             "BENCH_GPT_CHUNKED"))
+            out["gpt_long_variant"] = long_variant
         frag = _run_sub(name, _deadline(name, default), env_over=env_over)
         if frag is not None:
             out.update(frag)

@@ -39,6 +39,9 @@ METRICS = {
     "gpt_long_gqa4": ("gpt-long tok/s", "gpt_long_tokens_per_sec"),
     "gpt_long_blk512": ("gpt-long tok/s", "gpt_long_tokens_per_sec"),
     "gpt_long_q2048k512": ("gpt-long tok/s", "gpt_long_tokens_per_sec"),
+    "gpt_long_noremat": ("gpt-long tok/s", "gpt_long_tokens_per_sec"),
+    "gpt_long_s16k": ("gpt-long tok/s", "gpt_long_tokens_per_sec"),
+    "gpt_long_s32k": ("gpt-long tok/s", "gpt_long_tokens_per_sec"),
     "unet": ("unet img/s", "unet_img_per_sec"),
     "loader_thread": ("loader img/s", "loader_img_per_sec"),
     "loader_process": ("loader img/s", "loader_img_per_sec"),

@@ -29,6 +29,7 @@ OUT = os.path.join(REPO, "logs", "ab_results.jsonl")
 
 sys.path.insert(0, REPO)
 from bench import (  # noqa: E402
+    _AB_GPT_LONG_VARIANTS,
     _AB_GPT_VARIANTS,
     _AB_RESNET_VARIANTS,
     _DRIVER_MAX_WAIT,
@@ -77,6 +78,21 @@ QUEUE: list[tuple[str, str, dict, int]] = [
      {"TB_FLASH_BLOCK_Q": "512", "TB_FLASH_BLOCK_K": "512"}, 1500),
     ("gpt_long_q2048k512", "gpt_long",
      {"TB_FLASH_BLOCK_Q": "2048", "TB_FLASH_BLOCK_K": "512"}, 1500),
+    # remat recomputes the flash FORWARD kernel during the backward,
+    # but flash already bounds activations at O(S/tile) residuals —
+    # at S=8192 the saved HBM may be worth nothing and the recompute
+    # a pure tax: the strongest single-knob candidate for the long bench
+    ("gpt_long_noremat", "gpt_long", {"BENCH_GPT_REMAT": "0"}, 1500),
+    # context-length scaling, flash-asserted: at S=32k the reference
+    # path's per-head score block is multi-GB — flash is the only
+    # single-chip option, so these rows ARE the long-context story.
+    # Chunked head required: the unchunked fp32 (S, vocab) logits are
+    # ~6.6 GB at S=32k — they'd OOM the chip and measure head memory
+    # pressure, not attention scaling
+    ("gpt_long_s16k", "gpt_long",
+     {"BENCH_GPT_LONG_SEQ": "16384", "BENCH_GPT_CHUNKED": "1"}, 1800),
+    ("gpt_long_s32k", "gpt_long",
+     {"BENCH_GPT_LONG_SEQ": "32768", "BENCH_GPT_CHUNKED": "1"}, 1800),
     ("gpt_rope", "gpt", {"BENCH_GPT_POS": "rope"}, 1200),
     ("gpt_swiglu", "gpt", {"BENCH_GPT_MLP": "swiglu"}, 1200),
     ("gpt_gqa4", "gpt", {"BENCH_GPT_KV_HEADS": "4"}, 1200),
@@ -92,7 +108,8 @@ QUEUE: list[tuple[str, str, dict, int]] = [
 # these names/knobs — any drift between the two silently breaks the
 # headline's variant pick, so fail fast at watcher start instead.
 _QUEUE_ENV = {name: env for name, _, env, _ in QUEUE}
-for _name, _env in {**_AB_RESNET_VARIANTS, **_AB_GPT_VARIANTS}.items():
+for _name, _env in {**_AB_RESNET_VARIANTS, **_AB_GPT_VARIANTS,
+                    **_AB_GPT_LONG_VARIANTS}.items():
     assert _QUEUE_ENV.get(_name) == _env, (
         f"bench.py A/B variant {_name!r} ({_env}) out of sync with "
         f"run_ab.py QUEUE ({_QUEUE_ENV.get(_name)})")
