@@ -899,3 +899,62 @@ def test_ab_summary_renders_unknown_configs(tmp_path):
          str(log)], capture_output=True, text=True, check=True).stdout
     assert "mystery" in out
     assert "decode" in out and "failed attempt" in out
+
+
+def test_chip_sentinel_protocol(tmp_path, monkeypatch):
+    """The single-chip serialization protocol (bench._sentinel):
+    own-pid files are cleaned up, foreign live holders are preserved
+    on exit, stale (dead-pid) files never block, wait_free polls out a
+    live foreign holder, and the watcher's run_config backs off —
+    recording NO attempt — when a driver sentinel is live or the chip
+    probe fails. This protocol guards the driver's end-of-round
+    capture; regressions here produce contended garbage measurements."""
+    import os
+    import sys
+    import time
+    from pathlib import Path
+
+    repo = Path(__file__).parent.parent
+    # syspath_prepend restores sys.path afterwards, including the REPO
+    # entry run_ab itself inserts at import (a manual insert/pop pair
+    # popped the wrong entries and leaked)
+    monkeypatch.syspath_prepend(str(repo / "scripts"))
+    monkeypatch.syspath_prepend(str(repo))
+    import bench
+    import run_ab as ab
+    # redirect sentinel + results paths into tmp (monkeypatch restores)
+    monkeypatch.setattr(
+        bench, "_sentinel_path", lambda name: str(tmp_path / name))
+    monkeypatch.setattr(ab, "_sentinel_path", bench._sentinel_path)
+    monkeypatch.setattr(ab, "OUT", str(tmp_path / "ab.jsonl"))
+    # a live pid that is NOT this process and survives the test,
+    # signalable by the test user (pid 1 needs root to signal, and
+    # _pid_alive treats PermissionError as dead)
+    live_pid = str(os.getppid())
+
+    # lifecycle: live while held, gone after
+    with bench._sentinel("watcher_config.pid") as s:
+        assert bench._pid_alive(s.path) == os.getpid()
+    assert bench._pid_alive(s.path) is None
+
+    # exit hygiene: a foreign live holder is not clobbered
+    s = bench._sentinel("driver_bench.pid").__enter__()
+    (tmp_path / "driver_bench.pid").write_text(live_pid)
+    s.__exit__()
+    assert bench._pid_alive(s.path) == int(live_pid)
+
+    # stale dead-pid file neither blocks _wait_for nor __enter__
+    (tmp_path / "driver_bench.pid").write_text("999999999")
+    t0 = time.time()
+    bench._wait_for("driver_bench.pid", max_wait=60)
+    assert time.time() - t0 < 5
+
+    # watcher defers to a live driver, recording no attempt
+    (tmp_path / "driver_bench.pid").write_text(live_pid)
+    assert ab.run_config("t", "resnet", {}, 5) == "deferred"
+    (tmp_path / "driver_bench.pid").write_text("999999999")
+    monkeypatch.setattr(ab, "_probe_tpu", lambda t: "down")
+    assert ab.run_config("t", "resnet", {}, 5) == "down"
+    assert not [e for e in ab.load_entries() if e.get("config") == "t"]
+    # in both cases the watcher sentinel was released
+    assert bench._pid_alive(str(tmp_path / "watcher_config.pid")) is None
