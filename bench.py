@@ -738,25 +738,44 @@ def _ab_best(variants: dict[str, dict], baseline: str,
     if manual:
         label = ",".join(f"{k}={os.environ[k]}" for k in manual)
         return {}, f"manual({label})"
-    if path is None:
-        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                            "logs", "ab_results.jsonl")
+    def collect(p: str, best: dict[str, float]) -> None:
+        try:
+            with open(p) as f:
+                for ln in f:
+                    try:
+                        e = json.loads(ln)
+                    except json.JSONDecodeError:
+                        continue
+                    if e.get("status") != "ok":
+                        continue
+                    name = e.get("config")
+                    value = (e.get("result") or {}).get(value_key)
+                    if name in variants and value:
+                        best[name] = max(best.get(name, 0.0),
+                                         float(value))
+        except OSError:
+            pass
+
     best: dict[str, float] = {}
-    try:
-        with open(path) as f:
-            for ln in f:
-                try:
-                    e = json.loads(ln)
-                except json.JSONDecodeError:
-                    continue
-                if e.get("status") != "ok":
-                    continue
-                name = e.get("config")
-                value = (e.get("result") or {}).get(value_key)
-                if name in variants and value:
-                    best[name] = max(best.get(name, 0.0), float(value))
-    except OSError:
-        return {}, baseline
+    if path is not None:
+        collect(path, best)
+    else:
+        # live watcher log first; the tracked bench_results/ snapshots
+        # are a COLD-START fallback only (logs/ is gitignored — a
+        # fresh clone must not forget recorded wins). Live entries
+        # take absolute precedence: snapshot numbers were measured
+        # under that round's code/workload and must not out-compete
+        # fresh measurements after a sub-bench changes. A round that
+        # changes a sub-bench workload should regenerate or delete the
+        # stale snapshot.
+        repo = os.path.dirname(os.path.abspath(__file__))
+        collect(os.path.join(repo, "logs", "ab_results.jsonl"), best)
+        if not best:
+            snap_dir = os.path.join(repo, "bench_results")
+            if os.path.isdir(snap_dir):
+                for f in sorted(os.listdir(snap_dir)):
+                    if f.endswith(".jsonl"):
+                        collect(os.path.join(snap_dir, f), best)
     if baseline not in best:
         return {}, baseline
     winner = max(best, key=lambda n: best[n])
