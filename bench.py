@@ -497,10 +497,25 @@ def _pid_alive(path: str) -> int | None:
     try:
         with open(path) as f:
             pid = int(f.read().strip())
-        os.kill(pid, 0)
-        return pid
     except (OSError, ValueError):
         return None
+    try:
+        os.kill(pid, 0)
+    except PermissionError:
+        # alive but owned by another user — still a holder. But a
+        # recycled pid landing on a foreign long-lived daemon would
+        # read as live FOREVER (no self-heal), so bound it by sentinel
+        # age: any legitimate hold refreshes/releases well inside the
+        # driver's worst-case budget (~3h); same-uid holders never hit
+        # this branch.
+        try:
+            age = time.time() - os.path.getmtime(path)
+        except OSError:
+            return None
+        return pid if age < 3 * 3600 else None
+    except OSError:
+        return None
+    return pid
 
 
 def _sentinel_path(name: str) -> str:
@@ -801,7 +816,13 @@ _RESNET_MANUAL_KEYS = ("BENCH_BATCH", "BENCH_IMAGE")
 _GPT_MANUAL_KEYS = ("BENCH_GPT_POS", "BENCH_GPT_MLP",
                     "BENCH_GPT_KV_HEADS", "BENCH_GPT_ATTN_IMPL")
 _GPT_LONG_MANUAL_KEYS = ("BENCH_GPT_LONG_KV_HEADS", "BENCH_GPT_LONG_SEQ",
-                         "BENCH_GPT_LONG_LAYERS", "BENCH_GPT_CHUNKED")
+                         "BENCH_GPT_LONG_LAYERS", "BENCH_GPT_CHUNKED",
+                         # redundant with the variant tables' own keys
+                         # (_ab_best unions those into knob_keys), listed
+                         # so manual-suppression survives if the ref/tile
+                         # variants are ever dropped from the table
+                         "BENCH_GPT_ATTN_IMPL", "TB_FLASH_BLOCK_Q",
+                         "TB_FLASH_BLOCK_K")
 
 
 def _probe_tpu(timeout: int = 180) -> str:
@@ -822,6 +843,25 @@ def _probe_tpu(timeout: int = 180) -> str:
 def _deadline(name: str, default: int) -> int:
     return int(os.environ.get(f"BENCH_DEADLINE_{name.upper()}",
                               os.environ.get("BENCH_SUB_DEADLINE", default)))
+
+
+# secondary sub-benches and their default deadlines, in run order
+_SECONDARY_BENCHES = (("gpt", 900), ("gpt_long", 1500), ("loader", 900),
+                      ("unet", 900), ("decode", 1500))
+
+
+def _driver_hold_budget() -> int:
+    """Upper bound on how long ONE driver orchestration holds the chip:
+    probe + two resnet attempts (retry) + every secondary deadline +
+    slack for tunnel-death probes and the torch baseline. Sizes the
+    wait a SECOND driver spends before proceeding (ADVICE r4: a fixed
+    3600 s was far below a realistic full orchestration, so two drivers
+    could overlap and measure contended garbage — the exact failure the
+    sentinel exists to prevent)."""
+    total = 180 + 2 * _deadline("resnet", 1500)
+    for name, default in _SECONDARY_BENCHES:
+        total += _deadline(name, default)
+    return total + 900
 
 
 def main() -> None:
@@ -850,7 +890,7 @@ def main() -> None:
     # matmul itself would contend with an in-flight watcher
     # measurement): take the driver sentinel (waiting out another
     # driver, if any), wait out a live watcher config, then probe.
-    with _sentinel("driver_bench.pid", wait_free=3600):
+    with _sentinel("driver_bench.pid", wait_free=_driver_hold_budget()):
         _wait_for("watcher_config.pid", max_wait=_DRIVER_MAX_WAIT)
         _main_probe_and_orchestrate()
 
@@ -960,9 +1000,7 @@ def _main_tpu_orchestrate() -> None:
 
     resnet_failed = frag is None
     aborted = None   # lazily probed: the answer gates only live work
-    secondary = [("gpt", 900), ("gpt_long", 1500), ("loader", 900),
-                 ("unet", 900), ("decode", 1500)]
-    for name, default in secondary:
+    for name, default in _SECONDARY_BENCHES:
         if env_flag(f"BENCH_SKIP_{name.upper()}"):
             continue
         if aborted is None and resnet_failed:

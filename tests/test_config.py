@@ -168,6 +168,79 @@ def test_optimizer_sgd_runs():
     assert float(new_params["w"][0]) < 1.0
 
 
+def test_optimizer_sgd_dampening_matches_torch():
+    """dampening is honored with torch.optim.SGD's exact semantics
+    (buffer init to raw grad, then buf ← μ·buf + (1−d)·g) — it was an
+    accepted-but-ignored parity field through r4 (VERDICT r4 #7)."""
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    import torch
+
+    momentum, dampening, lr = 0.9, 0.5, 0.1
+    w0 = np.array([1.0, -2.0, 3.0], np.float32)
+    g = np.array([0.5, 1.0, -0.25], np.float32)
+
+    tw = torch.nn.Parameter(torch.tensor(w0))
+    topt = torch.optim.SGD([tw], lr=lr, momentum=momentum,
+                           dampening=dampening)
+    params = {"w": jnp.asarray(w0)}
+    tx = OptimizerConfig(name="sgd", lr=lr, momentum=momentum,
+                         dampening=dampening).make()
+    state = tx.init(params)
+    for _ in range(4):
+        tw.grad = torch.tensor(g)
+        topt.step()
+        updates, state = tx.update({"w": jnp.asarray(g)}, state, params)
+        params = optax.apply_updates(params, updates)
+    np.testing.assert_allclose(np.asarray(params["w"]),
+                               tw.detach().numpy(), rtol=1e-5)
+
+    # torch rejects nesterov with dampening≠0 OR momentum=0 at
+    # construction; so do we
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError):
+        OptimizerConfig(name="sgd", momentum=0.9, dampening=0.5,
+                        nesterov=True).make()
+    with _pytest.raises(ValueError):
+        OptimizerConfig(name="sgd", momentum=0.0,
+                        nesterov=True).make()
+
+
+def test_optimizer_amsgrad_matches_torch():
+    """amsgrad=True engages the max-of-v̂ rule for adam AND adamw
+    (decoupled decay), matching torch step-for-step."""
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    import torch
+
+    w0 = np.array([1.0, -2.0, 3.0], np.float32)
+    lr, wd = 0.1, 0.1
+    for name, torch_cls, kwargs in (
+            ("adam", torch.optim.Adam, {}),
+            ("adamw", torch.optim.AdamW, {"weight_decay": wd})):
+        tw = torch.nn.Parameter(torch.tensor(w0))
+        topt = torch_cls([tw], lr=lr, amsgrad=True, **kwargs)
+        params = {"w": jnp.asarray(w0)}
+        tx = OptimizerConfig(name=name, lr=lr, amsgrad=True,
+                             weight_decay=kwargs.get("weight_decay",
+                                                     0.0)).make()
+        state = tx.init(params)
+        rng = np.random.default_rng(0)
+        for _ in range(5):
+            g = rng.standard_normal(3).astype(np.float32) * 3.0
+            tw.grad = torch.tensor(g)
+            topt.step()
+            updates, state = tx.update({"w": jnp.asarray(g)}, state,
+                                       params)
+            params = optax.apply_updates(params, updates)
+        np.testing.assert_allclose(np.asarray(params["w"]),
+                                   tw.detach().numpy(), rtol=2e-4,
+                                   atol=1e-6, err_msg=name)
+
+
 def test_optimizer_agc_clips():
     """agc: λ>0 wraps the optimizer in adaptive gradient clipping —
     a huge gradient on a small weight must produce a bounded update

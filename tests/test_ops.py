@@ -927,10 +927,25 @@ def test_chip_sentinel_protocol(tmp_path, monkeypatch):
         bench, "_sentinel_path", lambda name: str(tmp_path / name))
     monkeypatch.setattr(ab, "_sentinel_path", bench._sentinel_path)
     monkeypatch.setattr(ab, "OUT", str(tmp_path / "ab.jsonl"))
-    # a live pid that is NOT this process and survives the test,
-    # signalable by the test user (pid 1 needs root to signal, and
-    # _pid_alive treats PermissionError as dead)
+    # a live pid that is NOT this process and survives the test
     live_pid = str(os.getppid())
+
+    # a live foreign-user process (os.kill raises PermissionError) is a
+    # HOLDER, not a stale file — ADVICE r4: treating it as dead breaks
+    # the chip-serialization handshake in multi-user deployments
+    perm_path = tmp_path / "perm.pid"
+    perm_path.write_text(live_pid)
+
+    def _kill_permission_denied(pid, sig):
+        raise PermissionError
+
+    monkeypatch.setattr(bench.os, "kill", _kill_permission_denied)
+    assert bench._pid_alive(str(perm_path)) == int(live_pid)
+    monkeypatch.undo()
+    monkeypatch.setattr(
+        bench, "_sentinel_path", lambda name: str(tmp_path / name))
+    monkeypatch.setattr(ab, "_sentinel_path", bench._sentinel_path)
+    monkeypatch.setattr(ab, "OUT", str(tmp_path / "ab.jsonl"))
 
     # lifecycle: live while held, gone after
     with bench._sentinel("watcher_config.pid") as s:

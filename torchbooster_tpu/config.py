@@ -408,6 +408,70 @@ class LoaderConfig(BaseConfig):
         )
 
 
+def _sgd_momentum_dampened(momentum: float, dampening: float):
+    """torch.optim.SGD's momentum buffer with dampening: after the
+    first accumulation ``buf ← μ·buf + (1−d)·g``, but the buffer is
+    *initialized to the raw gradient* — the ``(1−d)`` factor does not
+    apply on the first step (torch sgd docs; ref config.py:389-396
+    forwarded this knob to torch, so parity means matching torch's
+    semantics exactly, not optax.trace's zeros-init which would scale
+    the very first update by ``1−d``)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    def init(params):
+        return {"count": jnp.zeros([], jnp.int32),
+                "trace": jax.tree.map(jnp.zeros_like, params)}
+
+    def update(updates, state, params=None):
+        del params
+        first = state["count"] == 0
+        trace = jax.tree.map(
+            lambda t, g: jnp.where(first, g,
+                                   momentum * t + (1.0 - dampening) * g),
+            state["trace"], updates)
+        return trace, {"count": state["count"] + 1, "trace": trace}
+
+    return optax.GradientTransformation(init, update)
+
+
+def _scale_by_amsgrad_torch(b1: float, b2: float, eps: float):
+    """AMSGrad second-moment rule with torch's exact semantics: the
+    running max is taken over the *uncorrected* ``v_t`` and the bias
+    correction divides the max afterwards, with eps added outside
+    (torch.optim.Adam(amsgrad=True) docs). optax.scale_by_amsgrad maxes
+    the bias-corrected v̂ and puts eps inside the sqrt — ~1% drift over
+    a handful of steps, enough to break checkpoint-level parity with
+    the reference's torch training runs (ref config.py:397-403)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    def init(params):
+        zeros = lambda: jax.tree.map(jnp.zeros_like, params)
+        return {"count": jnp.zeros([], jnp.int32), "mu": zeros(),
+                "nu": zeros(), "nu_max": zeros()}
+
+    def update(updates, state, params=None):
+        del params
+        count = state["count"] + 1
+        t = count.astype(jnp.float32)
+        mu = jax.tree.map(lambda m, g: b1 * m + (1 - b1) * g,
+                          state["mu"], updates)
+        nu = jax.tree.map(lambda v, g: b2 * v + (1 - b2) * g * g,
+                          state["nu"], updates)
+        nu_max = jax.tree.map(jnp.maximum, state["nu_max"], nu)
+        bc1, bc2 = 1 - b1 ** t, 1 - b2 ** t
+        out = jax.tree.map(
+            lambda m, v: (m / bc1) / (jnp.sqrt(v / bc2) + eps),
+            mu, nu_max)
+        return out, {"count": count, "mu": mu, "nu": nu,
+                     "nu_max": nu_max}
+
+    return optax.GradientTransformation(init, update)
+
+
 @dataclass
 class OptimizerConfig(BaseConfig):
     """Optimizer factory (ref config.py:382-438, names sgd/adamw there).
@@ -420,12 +484,12 @@ class OptimizerConfig(BaseConfig):
     name: str = "adamw"                # sgd | adam | adamw | lamb | lion | adafactor
     lr: float = 1e-3
     momentum: float = 0.0
-    dampening: float = 0.0             # parity field (torch SGD); unused
+    dampening: float = 0.0             # torch-SGD momentum dampening (honored)
     betas: tuple(float, float) = (0.9, 0.999)
     eps: float = 1e-8
     weight_decay: float = 0.0
     nesterov: bool = False
-    amsgrad: bool = False              # parity field; optax adam has no amsgrad
+    amsgrad: bool = False              # adam/adamw max-of-v̂ variant (honored)
     # adaptive gradient clipping λ (0 = off): clips each unit's grad to
     # λ·‖W‖ before the update — the published companion to norm-free
     # models (models/resnet.py norm="ws"), whose sharper loss surface
@@ -455,9 +519,22 @@ class OptimizerConfig(BaseConfig):
             mask = lambda params: jax.tree.map(lambda p: p.ndim > 1,
                                                params)
         if name == "sgd":
-            factory = lambda learning_rate: optax.sgd(
-                learning_rate, momentum=self.momentum or None,
-                nesterov=self.nesterov)
+            if self.nesterov and (self.dampening or not self.momentum):
+                # torch.optim.SGD rejects both combinations at
+                # construction (ref honored torch's knob set,
+                # ref config.py:389-396) — mirror it rather than
+                # silently dropping the knob
+                raise ValueError(
+                    "nesterov requires a momentum and zero dampening")
+            if self.momentum and self.dampening:
+                factory = lambda learning_rate: optax.chain(
+                    _sgd_momentum_dampened(self.momentum,
+                                           self.dampening),
+                    optax.scale_by_learning_rate(learning_rate))
+            else:
+                factory = lambda learning_rate: optax.sgd(
+                    learning_rate, momentum=self.momentum or None,
+                    nesterov=self.nesterov)
             if self.weight_decay:
                 factory_inner = factory
                 factory = lambda learning_rate: optax.chain(
@@ -465,12 +542,33 @@ class OptimizerConfig(BaseConfig):
                                               mask=mask),
                     factory_inner(learning_rate))
         elif name == "adam":
-            factory = lambda learning_rate: optax.adam(
-                learning_rate, b1=self.betas[0], b2=self.betas[1], eps=self.eps)
+            if self.amsgrad:
+                # ref config.py:397-403 passed amsgrad through to
+                # torch.optim.Adam; torch-exact rule, see helper
+                factory = lambda learning_rate: optax.chain(
+                    _scale_by_amsgrad_torch(
+                        self.betas[0], self.betas[1], self.eps),
+                    optax.scale_by_learning_rate(learning_rate))
+            else:
+                factory = lambda learning_rate: optax.adam(
+                    learning_rate, b1=self.betas[0], b2=self.betas[1],
+                    eps=self.eps)
         elif name == "adamw":
-            factory = lambda learning_rate: optax.adamw(
-                learning_rate, b1=self.betas[0], b2=self.betas[1],
-                eps=self.eps, weight_decay=self.weight_decay, mask=mask)
+            if self.amsgrad:
+                # optax.adamw has no amsgrad flag: rebuild its exact
+                # chain (scale_by_adam → decoupled decay → lr) with the
+                # torch-semantics max-of-v rule swapped in
+                factory = lambda learning_rate: optax.chain(
+                    _scale_by_amsgrad_torch(
+                        self.betas[0], self.betas[1], self.eps),
+                    optax.add_decayed_weights(self.weight_decay,
+                                              mask=mask),
+                    optax.scale_by_learning_rate(learning_rate))
+            else:
+                factory = lambda learning_rate: optax.adamw(
+                    learning_rate, b1=self.betas[0], b2=self.betas[1],
+                    eps=self.eps, weight_decay=self.weight_decay,
+                    mask=mask)
         elif name == "lamb":
             factory = lambda learning_rate: optax.lamb(
                 learning_rate, b1=self.betas[0], b2=self.betas[1],
