@@ -213,11 +213,17 @@ class GPT:
               attn_impl: str = "auto",
               return_aux: bool = False,
               return_hidden: bool = False,
-              dropout_rng: jax.Array | None = None) -> jax.Array:
+              dropout_rng: jax.Array | None = None,
+              qkv_tp_major: bool = False) -> jax.Array:
         """``dropout_rng``: pass the step's rng (make_step splits a
         fresh one per step and hands it to the loss fn) to activate
         ``cfg.dropout``; omit it (eval, generate) for the
-        deterministic forward."""
+        deterministic forward. ``qkv_tp_major``: the params' stacked
+        qkv columns are already rank-major for this mesh's tp axis
+        (``qkv_to_tp_major`` applied at placement) — skips the
+        per-step re-permute on the pp×tp path; only meaningful there,
+        and loud anywhere else (the canonical math would silently read
+        scrambled columns)."""
         b, s = ids.shape
         _check_pos(params, cfg)
         if s > cfg.seq_len:
@@ -248,10 +254,17 @@ class GPT:
                   and mesh.shape["sp"] > 1)
         use_pp = (mesh is not None and "pp" in mesh.axis_names
                   and mesh.shape["pp"] > 1)
+        if qkv_tp_major and not (
+                use_pp and mesh.shape.get("tp", 1) > 1):
+            raise ValueError(
+                "qkv_tp_major=True but the mesh has no active pp+tp "
+                "axes — these params' qkv columns are rank-major and "
+                "the canonical paths would read them scrambled; "
+                "restore with qkv_to_tp_major(..., inverse=True)")
         if use_pp:
             x, aux = _pipelined_blocks(params, x, cfg, mesh, remat,
                                        attn_impl, drop, layer_keys,
-                                       use_sp)
+                                       use_sp, qkv_tp_major)
             if return_hidden:
                 out = L.layer_norm(params["ln_f"], x)
             else:
@@ -349,10 +362,69 @@ def _rope(x: jax.Array, positions: jax.Array,
         [x1 * cos - x2 * sin, x1 * sin + x2 * cos], -1).astype(x.dtype)
 
 
+def qkv_tp_permutation(cfg: GPTConfig, tp_size: int):
+    """Rank-major column order for the stacked ``[q | k | v]`` qkv
+    kernel under tensor parallelism: rank ``i`` of a ``tp_size`` split
+    must hold ``[q_i | k_i | v_i]`` (its contiguous head subset of each
+    section), but the canonical layout concatenates whole sections — a
+    contiguous tp split of it would hand rank 0 all of q and part of k.
+    Returns the numpy index array ``perm`` with
+    ``tp_major[..., j] = canonical[..., perm[j]]``; invert with
+    ``argsort``."""
+    import numpy as onp
+
+    head_dim = cfg.d_model // cfg.n_heads
+    kv_dim = cfg.kv_heads * head_dim
+    sections = onp.split(
+        onp.arange(cfg.d_model + 2 * kv_dim),
+        [cfg.d_model, cfg.d_model + kv_dim])
+    return onp.concatenate([
+        onp.concatenate([s.reshape(tp_size, -1)[i] for s in sections])
+        for i in range(tp_size)])
+
+
+def qkv_to_tp_major(params: dict, cfg: GPTConfig, tp_size: int,
+                    inverse: bool = False) -> dict:
+    """One-time layout transform for pp×tp training: permute the
+    stacked qkv kernel/bias columns rank-major (``qkv_tp_permutation``)
+    so the rule table's contiguous tp sharding lands each rank's
+    ``[q_i | k_i | v_i]`` locally and the pipelined step needs NO
+    per-step cross-device re-permute. Apply to params at placement
+    time (before ``TrainState.create``/``shard_state``) and pass
+    ``qkv_tp_major=True`` to :meth:`GPT.apply`; ``inverse=True``
+    restores the canonical layout (e.g. before checkpointing a state
+    for a different topology). Grads/opt-state/EMA stay consistent
+    automatically — they follow whatever layout the params are in.
+
+    The caller must pass the SAME tp size the mesh will have — that
+    agreement cannot be checked here (no mesh yet) and a mismatch
+    scrambles the math, so it is part of the contract."""
+    import numpy as onp
+
+    if cfg.n_heads % tp_size or cfg.kv_heads % tp_size:
+        # same precondition the pipelined step enforces for the mesh's
+        # tp — without it the permutation would cross head boundaries
+        # and "succeed" into silently mis-sliced attention
+        raise ValueError(
+            f"qkv_to_tp_major needs n_heads ({cfg.n_heads}) and "
+            f"kv_heads ({cfg.kv_heads}) divisible by tp ({tp_size})")
+    perm = qkv_tp_permutation(cfg, tp_size)
+    if inverse:
+        perm = onp.argsort(perm)
+    qkv = params["blocks"]["attn_qkv"]
+    new_qkv = {"kernel": jnp.take(qkv["kernel"], perm, axis=2)}
+    if "bias" in qkv:
+        new_qkv["bias"] = jnp.take(qkv["bias"], perm, axis=1)
+    return {**params,
+            "blocks": {**params["blocks"], "attn_qkv": new_qkv}}
+
+
 def _pipelined_blocks(params: dict, x: jax.Array, cfg: GPTConfig,
                       mesh: Mesh, remat: bool, attn_impl: str,
                       drop: float, layer_keys: jax.Array,
-                      use_sp: bool) -> tuple[jax.Array, jax.Array]:
+                      use_sp: bool,
+                      qkv_tp_major: bool = False
+                      ) -> tuple[jax.Array, jax.Array]:
     """Route the layer-stacked block scan through the GPipe kernel when
     the mesh has ``pp > 1`` — the blocks were layer-stacked for exactly
     this (parallel/pipeline.py): each pp stage holds ``L/pp`` contiguous
@@ -378,12 +450,13 @@ def _pipelined_blocks(params: dict, x: jax.Array, cfg: GPTConfig,
     ``_block_core(tp=...)``). The qkv kernel's output columns are the
     concatenation [q | k | v], so a contiguous tp split would misalign
     with the per-rank [q_i | k_i | v_i] the local math slices — the
-    columns are permuted rank-major first. Params stay canonical
-    everywhere else, which costs a cross-device reshard of the stacked
-    qkv kernel per step when the rule table stored it tp-sharded
-    (weights-sized, once per step — acceptable at dryrun/test scale;
-    if pp x tp ships on real hardware, permute once at placement time
-    instead and skip this per-step gather).
+    columns must be rank-major. ``qkv_tp_major=True`` declares the
+    caller already stored them that way (``qkv_to_tp_major`` at
+    placement time — the fast path: zero per-step layout cost);
+    otherwise the canonical columns are permuted here, which costs a
+    weights-sized cross-device gather of the stacked qkv kernel per
+    step when the rule table stored it tp-sharded (fine at test
+    scale, the slow default for real pp×tp training).
 
     Sequence parallelism also composes: with ``sp > 1`` the microbatch
     spec shards the SEQUENCE dim over sp and the attend hook is the
@@ -412,21 +485,13 @@ def _pipelined_blocks(params: dict, x: jax.Array, cfg: GPTConfig,
             raise ValueError(
                 f"pp x tp needs n_heads ({cfg.n_heads}) and kv_heads "
                 f"({cfg.kv_heads}) divisible by tp ({tp_size})")
-        head_dim = cfg.d_model // cfg.n_heads
-        kv_dim = cfg.kv_heads * head_dim
-        import numpy as onp
-
-        sections = onp.split(
-            onp.arange(cfg.d_model + 2 * kv_dim),
-            [cfg.d_model, cfg.d_model + kv_dim])
-        perm = jnp.asarray(onp.concatenate([
-            onp.concatenate([s.reshape(tp_size, -1)[i] for s in sections])
-            for i in range(tp_size)]))
-        qkv = blocks["attn_qkv"]
-        blocks = {**blocks, "attn_qkv": {
-            "kernel": jnp.take(qkv["kernel"], perm, axis=2),
-            **({"bias": jnp.take(qkv["bias"], perm, axis=1)}
-               if "bias" in qkv else {})}}
+        if not qkv_tp_major:
+            perm = jnp.asarray(qkv_tp_permutation(cfg, tp_size))
+            qkv = blocks["attn_qkv"]
+            blocks = {**blocks, "attn_qkv": {
+                "kernel": jnp.take(qkv["kernel"], perm, axis=2),
+                **({"bias": jnp.take(qkv["bias"], perm, axis=1)}
+                   if "bias" in qkv else {})}}
 
         col = {"attn_qkv", "mlp_fc1", "mlp_fc3"}   # out dim over tp
         row = {"attn_proj", "mlp_fc2"}             # in dim over tp
@@ -499,7 +564,14 @@ def _pipelined_blocks(params: dict, x: jax.Array, cfg: GPTConfig,
     data = tuple(a for a in ("dp", "fsdp") if a in mesh.axis_names) \
         or None
     x_spec = P(None, data, "sp") if use_sp else None
+    # MoE keeps the shallow m = P schedule: capacity and token-drop
+    # decisions are per microbatch-slice, so deepening the default
+    # schedule would silently change which tokens overflow at tight
+    # capacity factors; dense blocks take the deeper default (less
+    # bubble, identical math up to reassociation)
+    n_mb = mesh.shape["pp"] if cfg.n_experts > 0 else None
     return pipeline_apply(layer, (blocks, layer_keys), x, mesh,
+                          n_microbatches=n_mb,
                           with_mb_index=True, with_aux=True,
                           param_specs=param_specs, x_spec=x_spec)
 
@@ -880,4 +952,5 @@ def _make_constrainer(mesh: Mesh | None):
 
 
 __all__ = ["GPT", "GPTConfig", "SHARDING_RULES", "batch_spec",
-           "jit_generate", "load_torch_gpt2"]
+           "jit_generate", "load_torch_gpt2", "qkv_to_tp_major",
+           "qkv_tp_permutation"]

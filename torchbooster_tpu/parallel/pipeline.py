@@ -20,9 +20,11 @@ Cost model (honest limits at scale):
 - **Inactive-tick compute**: every stage runs its layers on every tick
   and discards inactive results via ``jnp.where`` — SPMD has one
   program, so the bubble ticks still burn MXU. Overhead factor is
-  (m + P − 1)/m of the ideal schedule's FLOPs: ~2× at m = P (the
-  default), amortizing to +12.5% at m = 8P. Raise ``n_microbatches``
-  to buy efficiency with smaller per-microbatch matmuls.
+  (m + P − 1)/m of the ideal schedule's FLOPs: ~2× at m = P; at
+  m = 4P (the default when the batch divides) it is 1.25 − 1/(4P),
+  i.e. +18.75% at P = 4 approaching +25% for deep pipelines; m = 8P
+  approaches +12.5%. Raise ``n_microbatches`` to buy efficiency with
+  smaller per-microbatch matmuls.
 - **Epilogue broadcast**: finished microbatches live on the last
   stage; the mask + ``psum`` broadcasts the (B, ...) output across the
   pp axis — one all-reduce of the output activation per call. For
@@ -47,6 +49,21 @@ except ImportError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map
 
 
+def _default_microbatches(batch: int, n_stages: int,
+                          dp_size: int) -> int:
+    """Deepest default schedule the batch supports, up to 4 stages'
+    worth: the SPMD GPipe bubble burns (m + P − 1)/m of the ideal
+    FLOPs — ~2× at m = P but 1.25 − 1/(4P) (≤ +25%) at m = 4P — so
+    prefer 4P and degrade to the largest multiple of P the batch
+    actually divides (each microbatch must also split over the data
+    axes)."""
+    for mult in (4, 3, 2):
+        m = mult * n_stages
+        if batch % m == 0 and (batch // m) % dp_size == 0:
+            return m
+    return n_stages
+
+
 def pipeline_apply(
     layer_fn: Callable[..., jax.Array],
     stacked_params: Any,
@@ -65,9 +82,10 @@ def pipeline_apply(
 
     ``layer_fn(layer_params, x) -> x`` applies ONE layer (a pytree leaf
     slice of ``stacked_params``'s leading axis). ``x`` is the full batch
-    ``(B, ...)``; it is split into ``n_microbatches`` (default: the
-    pipeline depth) along axis 0. ``B`` must divide evenly and ``L``
-    must divide the ``axis`` size.
+    ``(B, ...)``; it is split into ``n_microbatches`` (default: up to
+    4× the pipeline depth, the deepest schedule the batch divides —
+    ``_default_microbatches``) along axis 0. ``B`` must divide evenly
+    and ``L`` must divide the ``axis`` size.
 
     ``with_mb_index=True`` calls ``layer_fn(layer_params, x, mb_index)``
     with the (traced) index of the microbatch being processed — for
@@ -108,15 +126,15 @@ def pipeline_apply(
     if n_layers % n_stages:
         raise ValueError(f"{n_layers} layers not divisible by "
                          f"{n_stages} pipeline stages")
-    m = n_microbatches or n_stages
     batch = x.shape[0]
-    if batch % m:
-        raise ValueError(f"batch {batch} not divisible by {m} microbatches")
     if batch_axes is None:
         batch_axes = tuple(a for a in ("dp", "fsdp")
                            if a in mesh.axis_names and a != axis)
     dp_size = int(np.prod([mesh.shape[a] for a in batch_axes])) \
         if batch_axes else 1
+    m = n_microbatches or _default_microbatches(batch, n_stages, dp_size)
+    if batch % m:
+        raise ValueError(f"batch {batch} not divisible by {m} microbatches")
     if (batch // m) % dp_size:
         raise ValueError(
             f"microbatch size {batch // m} not divisible by data-axes "
