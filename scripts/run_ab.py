@@ -73,6 +73,14 @@ QUEUE: list[tuple[str, str, dict, int]] = [
     # serving: KV-cache decode tokens/s, MHA vs GQA cache width at
     # 1k/8k cache (bench.bench_decode; VERDICT r3 missing #4)
     ("decode", "decode", {}, 1800),
+    # recipe accuracy on chip (VERDICT r4 #3): the shipped ResNet
+    # CIFAR recipe end to end, ref hyperparams, 20 epochs — real
+    # CIFAR-10 if a binary release is under the dataset root (none in
+    # this zero-egress image), else the synthetic twin, labeled.
+    # HF offline: without it the resolver burns minutes in
+    # huggingface_hub's 5-retry backoff before the local fallback
+    ("cifar_acc", "cifar_acc",
+     {"HF_HUB_OFFLINE": "1", "HF_DATASETS_OFFLINE": "1"}, 1800),
     ("gpt_chunked_b32", "gpt",
      {"BENCH_GPT_CHUNKED": "1", "BENCH_GPT_BATCH": "32"}, 1200),
     # remat recomputes the flash FORWARD kernel during the backward,

@@ -26,6 +26,7 @@ from __future__ import annotations
 import sys
 
 PORT, RANK, CKPT_DIR = sys.argv[1], int(sys.argv[2]), sys.argv[3]
+NPROC = int(sys.argv[4]) if len(sys.argv) > 4 else 2
 
 import jax
 
@@ -138,6 +139,113 @@ def job() -> None:
     print(f"MULTIHOST_OK rank={RANK}", flush=True)
 
 
+def job4() -> None:
+    """4 processes × 1 device: a dp:2,fsdp:2 mesh whose BOTH axes span
+    process boundaries (VERDICT r4 #6 — the 2-process test never splits
+    one mesh axis across processes). Rule-sharded weights live
+    fsdp-split across hosts, the loader feeds per-process quarter
+    batches assembled into one global dp×fsdp-sharded array, and a
+    coordinated orbax save round-trips onto the same spanning mesh AND
+    onto a plain dp:4 one (cross-topology restart)."""
+    from jax.sharding import PartitionSpec as P
+
+    from torchbooster_tpu.parallel import shard_state
+
+    assert jax.process_count() == 4, jax.process_count()
+    assert jax.device_count() == 4, jax.device_count()
+    assert dist.get_rank() == RANK and dist.get_world_size() == 4
+    dist.synchronize("start")
+
+    mesh = dist.make_mesh("dp:2,fsdp:2")
+    # 1 local device: every {dp, fsdp} group contains devices from
+    # DIFFERENT processes — the cross-host collective path
+    assert len(dist.local_devices(mesh)) == 1
+
+    n, d, global_batch = 32, 4, 8
+    rng0 = np.random.RandomState(0)
+    xs = rng0.randn(n, d).astype(np.float32)
+    w_true = np.arange(1, d + 1, dtype=np.float32).reshape(d, 1)
+    ys = xs @ w_true
+    dataset = [(xs[i], ys[i]) for i in range(n)]
+    loader = DataLoader(dataset, batch_size=global_batch, shuffle=False,
+                        distributed=True, drop_last=True)
+    assert loader.local_batch == global_batch // 4
+
+    def loss_fn(params, batch, rng):
+        x, y = batch
+        return jnp.mean((x @ params["w"] - y) ** 2), {}
+
+    # w (d, 1) ZeRO-shards its ROWS over fsdp (d % fsdp == 0 — the
+    # divisibility validator would silently replicate a (1,)-column
+    # split); each half of w lives on a different process pair
+    rules = [(r"w", P("fsdp", None)), (r".*", P())]
+    tx = optax.sgd(0.05)
+    state = TrainState.create({"w": jnp.zeros((d, 1), jnp.float32)}, tx)
+    state = shard_state(state, rules, mesh)
+    assert not state.params["w"].sharding.is_fully_replicated, \
+        "w must actually shard over the process-spanning fsdp axis"
+    step = make_step(loss_fn, tx, mesh=mesh, rules=rules)
+
+    losses = []
+    with mesh:
+        for _ in range(3):
+            for batch in prefetch_to_device(loader, mesh):
+                x = batch[0]
+                assert x.shape == (global_batch, d), x.shape
+                assert not x.sharding.is_fully_replicated
+                state, metrics = step(state, batch)
+                losses.append(float(metrics["loss"]))
+    assert losses[-1] < losses[0] * 0.2, (losses[0], losses[-1])
+
+    # w spans non-addressable devices (the point of this test), so a
+    # plain device_get cannot fetch it — replicate through jit first
+    # (an all-gather over the spanning fsdp axis), then compare across
+    # processes
+    from jax.sharding import NamedSharding
+
+    replicate = jax.jit(lambda a: a,
+                        out_shardings=NamedSharding(mesh, P()))
+    with mesh:
+        w_local = np.asarray(jax.device_get(replicate(state.params["w"])))
+    w_all = np.asarray(dist.gather(w_local))
+    for r in range(4):
+        np.testing.assert_allclose(w_all[r], w_all[0], rtol=0, atol=0)
+
+    # coordinated save from the spanning mesh; restore (a) onto the
+    # same topology and (b) onto a plain dp:4 mesh — training resumes
+    cb = SaveCallback(every=1, n_iter=100, root=CKPT_DIR)
+    cb.save(int(state.step), state=state)
+    cb.wait()
+    dist.synchronize("saved")
+    assert cb.latest_step() == int(state.step)
+
+    template = TrainState.create({"w": jnp.zeros((d, 1), jnp.float32)}, tx)
+    template = shard_state(template, rules, mesh)
+    restored = cb.restore(like={"state": template})["state"]
+    with mesh:
+        w_restored = np.asarray(
+            jax.device_get(replicate(restored.params["w"])))
+    np.testing.assert_allclose(w_restored, w_all[0])
+
+    mesh_dp = dist.make_mesh("dp")
+    template2 = TrainState.create({"w": jnp.zeros((d, 1), jnp.float32)},
+                                  tx)
+    # the whole state (scalars included) must live on the new mesh —
+    # params-only placement leaves state.step on one local device and
+    # the jitted step rejects the mixed layout
+    template2 = shard_state(template2, [(r".*", P())], mesh_dp)
+    resumed = cb.restore(like={"state": template2})["state"]
+    step_dp = make_step(loss_fn, tx, mesh=mesh_dp)
+    with mesh_dp:
+        batch = next(iter(prefetch_to_device(loader, mesh_dp)))
+        resumed, metrics = step_dp(resumed, batch)
+    assert np.isfinite(float(metrics["loss"]))
+    assert float(metrics["loss"]) < losses[0]
+
+    dist.synchronize("done")
+    print(f"MULTIHOST_OK rank={RANK}", flush=True)
+
+
 if __name__ == "__main__":
-    dist.launch(job, n_machine=2, machine_rank=RANK,
-                dist_url=f"localhost:{PORT}")
+    dist.launch(job4 if NPROC == 4 else job, n_machine=NPROC,
+                machine_rank=RANK, dist_url=f"localhost:{PORT}")

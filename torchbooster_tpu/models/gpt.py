@@ -447,7 +447,11 @@ def _pipelined_blocks(params: dict, x: jax.Array, cfg: GPTConfig,
     the mesh, block weights additionally shard Megatron-style across tp
     (qkv/fc1/fc3 column-parallel with each rank holding its head/hidden
     subset, proj/fc2 row-parallel with an explicit psum —
-    ``_block_core(tp=...)``). The qkv kernel's output columns are the
+    ``_block_core(tp=...)``). MoE blocks compose with tp the same way:
+    expert hidden splits across tp (fc1 column-, fc2 row-parallel with
+    the psum inside ``moe_apply``'s expert matmuls) while routing —
+    token-level math on the tp-replicated activations — is computed
+    identically on every tp rank. The qkv kernel's output columns are the
     concatenation [q | k | v], so a contiguous tp split would misalign
     with the per-rank [q_i | k_i | v_i] the local math slices — the
     columns must be rank-major. ``qkv_tp_major=True`` declares the
@@ -477,14 +481,16 @@ def _pipelined_blocks(params: dict, x: jax.Array, cfg: GPTConfig,
             "routing/capacity semantics undefined)")
     blocks = params["blocks"]
     if tp is not None:
-        if cfg.n_experts > 0:
-            raise NotImplementedError(
-                "pp x tp with MoE blocks is not wired (expert kernels "
-                "would need their own manual-collective dispatch)")
         if cfg.n_heads % tp_size or cfg.kv_heads % tp_size:
             raise ValueError(
                 f"pp x tp needs n_heads ({cfg.n_heads}) and kv_heads "
                 f"({cfg.kv_heads}) divisible by tp ({tp_size})")
+        if cfg.n_experts > 0:
+            hidden = blocks["moe_fc1"]["kernel"].shape[-1]
+            if hidden % tp_size:
+                raise ValueError(
+                    f"pp x tp MoE needs expert hidden ({hidden}) "
+                    f"divisible by tp ({tp_size})")
         if not qkv_tp_major:
             perm = jnp.asarray(qkv_tp_permutation(cfg, tp_size))
             qkv = blocks["attn_qkv"]
@@ -504,6 +510,14 @@ def _pipelined_blocks(params: dict, x: jax.Array, cfg: GPTConfig,
                     else P("pp", "tp")
             if layer in row and kind == "kernel":
                 return P("pp", "tp", None)
+            # expert weights (leading dims: layer, expert): hidden over
+            # tp — fc1 column-parallel, fc2 row-parallel (psum inside
+            # moe_apply's expert_mlps); gate and fc2 bias replicate
+            if layer == "moe_fc1":
+                return P("pp", None, None, "tp") if kind == "kernel" \
+                    else P("pp", None, "tp")
+            if layer == "moe_fc2" and kind == "kernel":
+                return P("pp", None, "tp", None)
             return P("pp")
 
         block_specs = jax.tree_util.tree_map_with_path(assign, blocks)
@@ -660,7 +674,8 @@ def _block_core(bp: dict, x: jax.Array, cfg: GPTConfig, attend,
         m, aux = moe_apply(
             bp, h, top_k=cfg.top_k,
             capacity_factor=cfg.capacity_factor
-            if capacity_factor is None else capacity_factor)
+            if capacity_factor is None else capacity_factor,
+            reduce=None if tp is None else reduce)
         x = constrain(x + _dropout(m, dropout, k_mlp))
     elif "mlp_fc3" in bp:   # swiglu: silu(xW1) ⊙ xW3 → W2
         h = jax.nn.silu(L.dense(bp["mlp_fc1"], h)) * L.dense(bp["mlp_fc3"], h)
@@ -699,13 +714,20 @@ def _cached_block(bp: dict, x: jax.Array, cache_k: jax.Array,
         kv_heads = ck.shape[2]
         rep = n_heads // kv_heads
         qg = q.reshape(b, s_q, kv_heads, rep, head_dim)
-        scores = jnp.einsum("bqgrd,bkgd->bgrqk", qg.astype(jnp.float32),
-                            ck.astype(jnp.float32)) / (head_dim ** 0.5)
+        # operands stay in cache dtype (bf16) with fp32 ACCUMULATION:
+        # an explicit fp32 astype here makes XLA either materialize an
+        # fp32 copy of the whole cache per step (2× the HBM traffic
+        # decode is roofed on) or run the MXU in fp32 mode — bf16
+        # inputs + preferred_element_type=f32 is the native MXU
+        # contract (softmax itself stays fp32)
+        scores = jnp.einsum(
+            "bqgrd,bkgd->bgrqk", qg.astype(ck.dtype), ck,
+            preferred_element_type=jnp.float32) / (head_dim ** 0.5)
         visible = jnp.arange(s_cache)[None, None, None, None, :] <= pos
         scores = jnp.where(visible, scores, -1e30)
         probs = jax.nn.softmax(scores, axis=-1)
-        o = jnp.einsum("bgrqk,bkgd->bqgrd", probs,
-                       cv.astype(jnp.float32)).astype(q.dtype)
+        o = jnp.einsum("bgrqk,bkgd->bqgrd", probs.astype(cv.dtype), cv,
+                       preferred_element_type=jnp.float32).astype(q.dtype)
         return o.reshape(b, s_q, n_heads, head_dim), (ck, cv)
 
     x, _, (cache_k, cache_v) = _block_core(

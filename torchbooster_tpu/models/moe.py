@@ -70,7 +70,8 @@ def moe_init(rng: jax.Array, n_experts: int, d_model: int, hidden: int,
 def moe_apply(params: dict, x: jax.Array, top_k: int = 2,
               capacity_factor: float = 1.25,
               activation=jax.nn.gelu,
-              impl: str = "scatter") -> tuple[jax.Array, jax.Array]:
+              impl: str = "scatter",
+              reduce=None) -> tuple[jax.Array, jax.Array]:
     """(B, S, d) → ((B, S, d), aux_loss). Top-``top_k`` routing with
     static per-expert capacity; dropped tokens pass through as zeros
     (the residual connection around the block carries them).
@@ -82,7 +83,16 @@ def moe_apply(params: dict, x: jax.Array, top_k: int = 2,
       long sequences (T=16k+) stay cheap.
     - ``"einsum"``: the GShard one-hot dispatch/combine einsums —
       O(T·E·C) memory. Kept as the parity oracle for the scatter path.
-    """
+
+    ``reduce``: MANUAL tensor parallelism over the expert hidden dim,
+    for shard_map callers (the pipeline): ``params`` then hold per-rank
+    slices — fc1 kernel/bias column-split over hidden, fc2 kernel
+    row-split — and ``reduce`` (a psum over the tp axis) runs between
+    the fc2 matmul and its bias, exactly like the dense blocks'
+    ``_row_dense``. Routing is token-level math on the (replicated)
+    activations, so every tp rank computes identical dispatch and only
+    the expert MLP hidden is split. The auto-SPMD paths leave this
+    None and let XLA place the collectives from SHARDING_RULES."""
     b, s, d = x.shape
     tokens = x.reshape(b * s, d)
     t = tokens.shape[0]
@@ -114,13 +124,17 @@ def moe_apply(params: dict, x: jax.Array, top_k: int = 2,
         remaining = remaining * (1.0 - onehot.astype(jnp.float32))
 
     def expert_mlps(expert_in: jax.Array) -> jax.Array:
-        # expert MLPs over the stacked weights — one batched matmul pair
+        # expert MLPs over the stacked weights — one batched matmul
+        # pair; under manual tp the hidden dim is a per-rank slice and
+        # ``reduce`` sums the partial fc2 products before the bias
         h = jnp.einsum("ecd,edh->ech", expert_in,
                        params["moe_fc1"]["kernel"].astype(x.dtype))
         h = activation(
             h + params["moe_fc1"]["bias"].astype(x.dtype)[:, None, :])
         expert_out = jnp.einsum("ech,ehd->ecd", h,
                                 params["moe_fc2"]["kernel"].astype(x.dtype))
+        if reduce is not None:
+            expert_out = reduce(expert_out)
         return expert_out + \
             params["moe_fc2"]["bias"].astype(x.dtype)[:, None, :]
 
