@@ -499,9 +499,15 @@ def bench_cifar_acc() -> dict:
     train_ds = resolve_dataset(conf.dataset, Split.TRAIN)
     test_ds = resolve_dataset(conf.dataset, Split.TEST)
     resolution = getattr(train_ds, "resolution", None) or "unknown"
-    # "synthetic:cifar10_bin-fallback" AND a directly-requested
-    # "registry:synthetic_cifar10" are both synthetic data
-    real = "synthetic" not in resolution
+    # "synthetic:*" AND a directly-requested "registry:synthetic_*"
+    # are synthetic; MISSING provenance must not fabricate real-data
+    # evidence — it reports itself as unknown
+    if resolution == "unknown":
+        data_label = "unknown"
+    elif "synthetic" in resolution:
+        data_label = "synthetic"
+    else:
+        data_label = "real"
     conf.dataset.make = lambda split, **kw: (
         train_ds if Split(split) == Split.TRAIN else test_ds)
 
@@ -523,7 +529,7 @@ def bench_cifar_acc() -> dict:
     with contextlib.redirect_stdout(sys.stderr):
         results = recipe.main(conf)
     return {"cifar_test_acc": round(float(results["test_acc"]), 4),
-            "cifar_data": "real" if real else "synthetic",
+            "cifar_data": data_label,
             "cifar_resolution": resolution,
             "cifar_epochs": conf.epochs,
             "cifar_steps": conf.scheduler.n_iter,

@@ -344,6 +344,82 @@ def test_resolve_cifar10_prefers_local_binary_over_fallback(tmp_path):
     assert image.shape == (32, 32, 3) and 0 <= int(label) < 10
 
 
+def _image_folder_fixture(root, per_class=10, size=8, splits=False):
+    """Tiny labeled image corpus: 2 classes of per_class PNGs each,
+    deterministic pixels, optionally under train/test split dirs."""
+    from PIL import Image
+
+    bases = [root / s for s in ("train", "test")] if splits else [root]
+    for b_i, base in enumerate(bases):
+        for cls in ("ants", "bees"):
+            (base / cls).mkdir(parents=True, exist_ok=True)
+            for i in range(per_class):
+                rs = np.random.RandomState(b_i * 1000 + i)
+                arr = rs.randint(0, 256, (size, size, 3)).astype(np.uint8)
+                Image.fromarray(arr).save(base / cls / f"img{i:03d}.png")
+
+
+def test_image_folder_dataset(tmp_path):
+    """data/folder.py: class subdirs → sorted class indices, PNGs
+    decode to float32 [0,1] HWC, STRATIFIED 90/5/5 split when the root
+    has no explicit split dirs (every split sees every class — a flat
+    positional cut would hand eval only the last class), resize
+    batches mixed sizes."""
+    pytest.importorskip("PIL")
+    from torchbooster_tpu.data.folder import ImageFolder
+
+    _image_folder_fixture(tmp_path, per_class=40)
+    train = ImageFolder(tmp_path, Split.TRAIN)
+    val = ImageFolder(tmp_path, Split.VALIDATION)
+    test = ImageFolder(tmp_path, Split.TEST)
+    assert train.classes == ["ants", "bees"]
+    assert len(train) == 72 and len(val) == 4 and len(test) == 4
+    # stratified: BOTH classes appear in every split
+    for ds in (train, val, test):
+        assert {lbl for _, lbl in ds.items} == {0, 1}
+    # disjoint splits over the deterministic sorted list
+    all_paths = {p for ds in (train, val, test) for p, _ in ds.items}
+    assert len(all_paths) == 80
+    image, label = train[0]
+    assert image.shape == (8, 8, 3) and image.dtype == np.float32
+    assert 0.0 <= image.min() and image.max() <= 1.0
+    assert int(label) in (0, 1)
+    resized = ImageFolder(tmp_path, Split.TRAIN, size=16)
+    assert resized[0][0].shape == (16, 16, 3)
+
+
+def test_image_folder_explicit_splits_and_errors(tmp_path):
+    """Explicit train/test layout wins over positional; a layout with
+    split dirs but no images for the asked split fails loudly, as does
+    a bogus root."""
+    pytest.importorskip("PIL")
+    from torchbooster_tpu.data.folder import ImageFolder
+
+    _image_folder_fixture(tmp_path, per_class=4, splits=True)
+    train = ImageFolder(tmp_path, Split.TRAIN)
+    test = ImageFolder(tmp_path, Split.TEST)
+    assert len(train) == 8 and len(test) == 8
+    with pytest.raises(FileNotFoundError, match="no images"):
+        ImageFolder(tmp_path, Split.VALIDATION)  # split dirs, no val
+    with pytest.raises(FileNotFoundError, match="not a directory"):
+        ImageFolder(tmp_path / "nope", Split.TRAIN)
+
+
+def test_image_folder_resolves_and_loads(tmp_path):
+    """name `image_folder` resolves through the chain (provenance
+    tagged) and batches through the DataLoader."""
+    pytest.importorskip("PIL")
+    from torchbooster_tpu.data import DataLoader
+
+    _image_folder_fixture(tmp_path, per_class=10)
+    conf = DatasetConfig(name="image_folder", root=str(tmp_path))
+    ds = resolve_dataset(conf, Split.TRAIN)
+    assert ds.resolution == "registry:image_folder"
+    loader = DataLoader(ds, batch_size=6, shuffle=True, drop_last=True)
+    images, labels = next(iter(loader))
+    assert images.shape == (6, 8, 8, 3) and labels.shape == (6,)
+
+
 def test_resolve_unknown_exits():
     conf = DatasetConfig(name="definitely_not_a_dataset_xyz", root="unused")
     with pytest.raises(SystemExit):

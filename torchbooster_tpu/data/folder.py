@@ -1,0 +1,122 @@
+"""Local image-folder dataset — any labeled image corpus, no network.
+
+The reference reached arbitrary image datasets through torchvision by
+name (ref config.py:571-576); the torchvision idiom users actually
+migrate with is ``ImageFolder`` — a directory of class subdirectories.
+This is its zero-egress analogue: point ``dataset.root`` at
+
+    root/                      or   root/train/<class>/*.png
+      <class_a>/*.png               root/test/<class>/*.png
+      <class_b>/*.jpg               (validation | val | valid)
+
+and every image under a class directory becomes one example. When the
+root has no explicit split directories, a deterministic 90/5/5
+positional split WITHIN each class serves train/validation/test
+(stratified — every split sees every class). Class indices follow sorted
+class-directory names (torchvision ImageFolder semantics), decoded
+lazily per item via PIL (gated import — the loader's worker pool
+parallelizes the decode exactly like torchvision's).
+"""
+from __future__ import annotations
+
+from pathlib import Path
+
+import numpy as np
+
+from torchbooster_tpu.dataset import Dataset, Split
+
+_EXTENSIONS = {".png", ".jpg", ".jpeg", ".bmp", ".ppm", ".pgm",
+               ".webp", ".tif", ".tiff"}
+_SPLIT_DIRS = {
+    Split.TRAIN: ("train",),
+    Split.VALIDATION: ("validation", "val", "valid"),
+    Split.TEST: ("test",),
+}
+
+
+def _split_base(root: Path, split: Split) -> Path | None:
+    """The explicit split directory when the layout has one."""
+    for cand in _SPLIT_DIRS[split]:
+        if (root / cand).is_dir():
+            return root / cand
+    # a root with ANY split dir uses the explicit layout — a missing
+    # eval split then means "no such data", not "reuse everything"
+    if any((root / d).is_dir()
+           for dirs in _SPLIT_DIRS.values() for d in dirs):
+        return None
+    return root
+
+
+def _scan(base: Path) -> tuple[list[tuple[Path, int]], list[str]]:
+    classes = sorted(d.name for d in base.iterdir() if d.is_dir())
+    items = []
+    for idx, name in enumerate(classes):
+        for path in sorted((base / name).rglob("*")):
+            if path.suffix.lower() in _EXTENSIONS and path.is_file():
+                items.append((path, idx))
+    return items, classes
+
+
+class ImageFolder(Dataset):
+    """``root/<class>/*.png`` → ``(image float32 [0,1] HWC, label)``.
+
+    ``size``: optional side length — images resize (PIL bilinear) so a
+    mixed-resolution corpus still batches; without it every image must
+    already share a shape (the collate stack fails loudly otherwise).
+    ``__getitems__`` is intentionally absent: per-item decode is the
+    work the loader's thread/process workers parallelize.
+    """
+
+    def __init__(self, root: str | Path, split: Split | str = Split.TRAIN,
+                 size: int | None = None):
+        split = Split(split) if isinstance(split, str) else split
+        root = Path(root)
+        if not root.is_dir():
+            raise FileNotFoundError(
+                f"image_folder dataset: root={str(root)!r} is not a "
+                "directory")
+        base = _split_base(root, split)
+        explicit = base is not None and base != root
+        items, self.classes = _scan(base) if base is not None else ([], [])
+        if base is not None and not explicit:
+            # positional 90/5/5 WITHIN each class (the scan is
+            # class-major, so a flat cut would hand validation/test
+            # almost entirely the alphabetically last class — a
+            # constant predictor would eval perfectly); per-class
+            # stratification keeps every split representative and
+            # disjoint by construction
+            chosen = []
+            for cls_idx in range(len(self.classes)):
+                cls_items = [it for it in items if it[1] == cls_idx]
+                cut1 = int(len(cls_items) * 0.90)
+                cut2 = int(len(cls_items) * 0.95)
+                chosen.extend({Split.TRAIN: cls_items[:cut1],
+                               Split.VALIDATION: cls_items[cut1:cut2],
+                               Split.TEST: cls_items[cut2:]}[split])
+            items = chosen
+        if not items:
+            raise FileNotFoundError(
+                f"image_folder dataset: no images for split "
+                f"{split.value!r} under {str(root)!r} (classes are "
+                "subdirectories; extensions "
+                f"{sorted(_EXTENSIONS)})")
+        self.items = items
+        self.size = size
+
+    def __len__(self) -> int:
+        return len(self.items)
+
+    def __getitem__(self, index: int):
+        from PIL import Image  # gated: decoded lazily, per worker
+
+        path, label = self.items[int(index)]
+        with Image.open(path) as img:
+            img = img.convert("RGB")
+            if self.size is not None:
+                img = img.resize((self.size, self.size),
+                                 Image.Resampling.BILINEAR)
+            array = np.asarray(img, np.float32) / 255.0
+        return array, np.int32(label)
+
+
+__all__ = ["ImageFolder"]

@@ -190,6 +190,18 @@ def _cifar10_bin(conf: Any, split: Split, **kw):
     return ArrayDataset(images, labels)
 
 
+@register_dataset("image_folder")
+def _image_folder(conf: Any, split: Split, size: int | None = None,
+                  **kw):
+    """Local labeled image corpus: ``root/<class>/*.png`` (or
+    ``root/{train,test,validation}/<class>/*``) — the zero-egress
+    analogue of the torchvision ImageFolder idiom the reference's
+    by-name resolution served (ref config.py:571-576); data/folder.py."""
+    from torchbooster_tpu.data.folder import ImageFolder
+
+    return ImageFolder(conf.root, split, size=size)
+
+
 @register_dataset("synthetic_lm")
 def _synthetic_lm(conf: Any, split: Split, seq_len: int = 256,
                   vocab: int = 1_024, **kw):
