@@ -795,6 +795,8 @@ _AB_GPT_VARIANTS = {
     # spends: the combo is the natural follow-up to a chunked win
     "gpt_chunked_b32": {"BENCH_GPT_CHUNKED": "1",
                         "BENCH_GPT_BATCH": "32"},
+    "gpt_chunked_noremat": {"BENCH_GPT_CHUNKED": "1",
+                            "BENCH_GPT_REMAT": "0"},
 }
 
 
@@ -809,6 +811,10 @@ _AB_GPT_LONG_VARIANTS = {
     "gpt_long_flash": {},
     "gpt_long_ref": {"BENCH_GPT_ATTN_IMPL": "reference"},
     "gpt_long_noremat": {"BENCH_GPT_REMAT": "0"},
+    # the S=1024 headline's chunked-LM-head win (+6.7%) should be
+    # LARGER at S=8192: the unchunked fp32 (S, vocab) logits are
+    # ~1.6 GB of HBM traffic the chunked loss never materializes
+    "gpt_long_chunked": {"BENCH_GPT_CHUNKED": "1"},
     "gpt_long_blk512": {"TB_FLASH_BLOCK_Q": "512",
                         "TB_FLASH_BLOCK_K": "512"},
     "gpt_long_q2048k512": {"TB_FLASH_BLOCK_Q": "2048",

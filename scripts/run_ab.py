@@ -83,6 +83,11 @@ QUEUE: list[tuple[str, str, dict, int]] = [
      {"HF_HUB_OFFLINE": "1", "HF_DATASETS_OFFLINE": "1"}, 1800),
     ("gpt_chunked_b32", "gpt",
      {"BENCH_GPT_CHUNKED": "1", "BENCH_GPT_BATCH": "32"}, 1200),
+    # the r4 chunked-head win, applied at the length where it should
+    # matter most (the fp32 8192x50257 logits it never materializes)
+    ("gpt_long_chunked", "gpt_long", {"BENCH_GPT_CHUNKED": "1"}, 1800),
+    ("gpt_chunked_noremat", "gpt",
+     {"BENCH_GPT_CHUNKED": "1", "BENCH_GPT_REMAT": "0"}, 1200),
     # remat recomputes the flash FORWARD kernel during the backward,
     # but flash already bounds activations at O(S/tile) residuals —
     # at S=8192 the saved HBM may be worth nothing and the recompute
