@@ -275,6 +275,65 @@ def test_gpt_pipeline_tp_major_layout_skips_per_step_permute():
         GPT.apply(tp_params, ids, cfg, qkv_tp_major=True)
 
 
+def test_gpt_pipeline_tp_major_resume_from_canonical_checkpoint():
+    """A canonical single-device checkpoint (params + adam mu/nu)
+    resumes onto a pp×tp mesh via qkv_state_to_tp_major: the optimizer
+    mirrors permute in lockstep with the params (params-only would
+    divide gradients by another column's second moments), and the
+    continued trajectory matches the canonical continuation exactly
+    (up to float reassociation)."""
+    import optax
+
+    from torchbooster_tpu import utils
+    from torchbooster_tpu.models.gpt import (GPT, GPTConfig,
+                                             qkv_state_to_tp_major)
+
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(2, 2, 2),
+                ("dp", "pp", "tp"))
+    cfg = GPTConfig(vocab=64, n_layers=4, d_model=32, n_heads=4,
+                    seq_len=16, n_kv_heads=2)
+    ids = jax.random.randint(jax.random.PRNGKey(1), (8, 16), 0, 64)
+    tx = optax.adam(1e-2)
+
+    def make_loss(use_mesh, tp_major):
+        def loss_fn(p, batch, rng):
+            del rng
+            lg = GPT.apply(p, batch["ids"],
+                           cfg, mesh=mesh if use_mesh else None,
+                           compute_dtype=jnp.float32,
+                           qkv_tp_major=tp_major)
+            return optax.softmax_cross_entropy_with_integer_labels(
+                lg[:, :-1], batch["labels"]).mean(), {}
+        return loss_fn
+
+    batch = {"ids": ids, "labels": ids[:, 1:]}
+    # "checkpoint": two canonical warmup steps accumulate real mu/nu
+    state = utils.TrainState.create(
+        GPT.init(jax.random.PRNGKey(0), cfg), tx, rng=0)
+    warm = utils.make_step(make_loss(False, False), tx)
+    for _ in range(2):
+        state, _ = warm(state, batch)
+
+    # canonical continuation (reference trajectory) — on COPIES:
+    # make_step donates its input state buffers
+    copy = jax.tree.map(jnp.array, state)
+    ref = copy
+    for _ in range(2):
+        ref, _ = warm(ref, batch)
+
+    # resume on the mesh in tp-major layout, then translate back
+    resumed = qkv_state_to_tp_major(state, cfg, tp_size=2)
+    with mesh:
+        step = utils.make_step(make_loss(True, True), tx, mesh=mesh)
+        for _ in range(2):
+            resumed, _ = step(resumed, batch)
+    back = qkv_state_to_tp_major(resumed, cfg, tp_size=2, inverse=True)
+    for a, b in zip(jax.tree.leaves(back.params),
+                    jax.tree.leaves(ref.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-3, atol=2e-4)
+
+
 def test_gpt_pipeline_sequence_parallel_matches_single_device():
     """sp INSIDE the pipeline: activations shard their sequence dim
     over sp within each pipeline stage and attention runs the ring

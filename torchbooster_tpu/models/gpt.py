@@ -393,8 +393,11 @@ def qkv_to_tp_major(params: dict, cfg: GPTConfig, tp_size: int,
     time (before ``TrainState.create``/``shard_state``) and pass
     ``qkv_tp_major=True`` to :meth:`GPT.apply`; ``inverse=True``
     restores the canonical layout (e.g. before checkpointing a state
-    for a different topology). Grads/opt-state/EMA stay consistent
-    automatically — they follow whatever layout the params are in.
+    for a different topology). For a FRESH state, grads/opt-state/EMA
+    stay consistent automatically — they follow whatever layout the
+    params start in. Resuming a CANONICAL checkpoint whose optimizer
+    mirrors are non-zero needs :func:`qkv_state_to_tp_major` instead:
+    permuting params alone would misalign adam mu/nu columns.
 
     The caller must pass the SAME tp size the mesh will have — that
     agreement cannot be checked here (no mesh yet) and a mismatch
@@ -417,6 +420,34 @@ def qkv_to_tp_major(params: dict, cfg: GPTConfig, tp_size: int,
         new_qkv["bias"] = jnp.take(qkv["bias"], perm, axis=1)
     return {**params,
             "blocks": {**params["blocks"], "attn_qkv": new_qkv}}
+
+
+def qkv_state_to_tp_major(state: Any, cfg: GPTConfig, tp_size: int,
+                          inverse: bool = False) -> Any:
+    """:func:`qkv_to_tp_major` for a FULL TrainState — a resumed
+    canonical checkpoint carries param-shaped optimizer mirrors (adam
+    mu/nu, EMA shadows, grad accumulators) whose qkv columns must
+    permute IN LOCKSTEP with the params: permuting only the params
+    would divide fresh gradients by another column's second moment,
+    silently corrupting the resumed run. Fresh states (zero mirrors)
+    are unaffected either way; use this whenever the state predates
+    the layout change. ``inverse=True`` restores the canonical layout
+    (e.g. before checkpointing for a different topology)."""
+    from torchbooster_tpu.parallel.sharding import is_param_shaped
+
+    tf = lambda tree: qkv_to_tp_major(tree, cfg, tp_size,
+                                      inverse=inverse)
+    is_mirror = lambda leaf: is_param_shaped(leaf, state.params)
+    out = state.replace(
+        params=tf(state.params),
+        opt_state=jax.tree.map(
+            lambda leaf: tf(leaf) if is_mirror(leaf) else leaf,
+            state.opt_state, is_leaf=is_mirror))
+    if getattr(state, "grad_acc", None) is not None:
+        out = out.replace(grad_acc=tf(state.grad_acc))
+    if getattr(state, "ema", None) is not None:
+        out = out.replace(ema=tf(state.ema))
+    return out
 
 
 def _pipelined_blocks(params: dict, x: jax.Array, cfg: GPTConfig,
@@ -974,5 +1005,5 @@ def _make_constrainer(mesh: Mesh | None):
 
 
 __all__ = ["GPT", "GPTConfig", "SHARDING_RULES", "batch_spec",
-           "jit_generate", "load_torch_gpt2", "qkv_to_tp_major",
-           "qkv_tp_permutation"]
+           "jit_generate", "load_torch_gpt2", "qkv_state_to_tp_major",
+           "qkv_to_tp_major", "qkv_tp_permutation"]

@@ -25,6 +25,18 @@ Cost model (honest limits at scale):
   i.e. +18.75% at P = 4 approaching +25% for deep pipelines; m = 8P
   approaches +12.5%. Raise ``n_microbatches`` to buy efficiency with
   smaller per-microbatch matmuls.
+- **Why not 1F1B**: in this SPMD one-program design every stage runs
+  its layers every tick regardless of schedule, so 1F1B's classic win
+  over GPipe — fewer in-flight microbatches, hence less LIVE
+  activation memory — is its only applicable benefit, and
+  ``jax.checkpoint`` over the stage body already bounds activations
+  at O(saved-dots) per microbatch. The bubble FLOPs are identical
+  under both schedules here; raising ``n_microbatches`` (default 4P)
+  is the lever that actually buys MXU back. A manually-scheduled
+  interleaved 1F1B with a hand-written backward would shrink the
+  bubble below (m + P − 1)/m only by interleaving *virtual stages*
+  (more layers-per-device splits) — worthwhile only on real multi-pod
+  topologies, and measurable there before building it.
 - **Epilogue broadcast**: finished microbatches live on the last
   stage; the mask + ``psum`` broadcasts the (B, ...) output across the
   pp axis — one all-reduce of the output activation per call. For

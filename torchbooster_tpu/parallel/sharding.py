@@ -110,7 +110,7 @@ def shard_params(params: Any, mesh: Mesh,
     return jax.tree.map(jax.device_put, params, shardings)
 
 
-def _is_param_shaped(leaf: Any, params: Any) -> bool:
+def is_param_shaped(leaf: Any, params: Any) -> bool:
     """True when an opt-state node is a pytree congruent with params
     (adam mu/nu, sgd momentum); those inherit the param shardings."""
     if not isinstance(leaf, dict) or not isinstance(params, dict):
@@ -129,9 +129,9 @@ def make_state_specs(state: Any, rules: Sequence[tuple[str, P]],
     specs = specs.replace(params=param_specs)
     specs = specs.replace(
         opt_state=jax.tree.map(
-            lambda leaf: param_specs if _is_param_shaped(leaf, state.params)
+            lambda leaf: param_specs if is_param_shaped(leaf, state.params)
             else P(), state.opt_state,
-            is_leaf=lambda x: _is_param_shaped(x, state.params)))
+            is_leaf=lambda x: is_param_shaped(x, state.params)))
     # grad_acc (set when accumulate_every > 1) is a param-shaped fp32
     # pytree — it must follow the param layout or every device holds a
     # full replicated copy, defeating fsdp/ZeRO sharding.
@@ -158,5 +158,6 @@ def shard_state(state: Any, rules: Sequence[tuple[str, P]],
         state, shardings, is_leaf=lambda x: x is None)
 
 
-__all__ = ["make_param_specs", "make_shardings", "make_state_specs",
+__all__ = ["is_param_shaped", "make_param_specs", "make_shardings",
+           "make_state_specs",
            "path_str", "shard_params", "shard_state"]
