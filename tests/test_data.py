@@ -415,6 +415,10 @@ def test_image_folder_resolves_and_loads(tmp_path):
     conf = DatasetConfig(name="image_folder", root=str(tmp_path))
     ds = resolve_dataset(conf, Split.TRAIN)
     assert ds.resolution == "registry:image_folder"
+    # process-mode loader workers pickle the dataset across spawn
+    import pickle
+
+    assert len(pickle.loads(pickle.dumps(ds))) == len(ds)
     loader = DataLoader(ds, batch_size=6, shuffle=True, drop_last=True)
     images, labels = next(iter(loader))
     assert images.shape == (6, 8, 8, 3) and labels.shape == (6,)

@@ -121,6 +121,42 @@ def test_resnet(monkeypatch):
     assert results["train_loss"] > 0.0
 
 
+def test_resnet_on_image_folder(monkeypatch, tmp_path):
+    """The shipped ResNet recipe trains on a LOCAL image-folder corpus
+    by changing only the dataset YAML lines (`name: image_folder`,
+    `root: ...`) — the custom-data path the reference served through
+    torchvision's ImageFolder (data/folder.py)."""
+    pytest.importorskip("PIL")
+    import numpy as np
+    from PIL import Image
+
+    import zlib
+
+    for cls in ("ants", "bees"):
+        (tmp_path / cls).mkdir(parents=True)
+        for i in range(40):
+            # crc32, not hash(): str hashes are salted per interpreter
+            # and would make a failing corpus unreproducible
+            rs = np.random.RandomState(zlib.crc32(cls.encode()) % 997 + i)
+            Image.fromarray(
+                rs.randint(0, 256, (32, 32, 3)).astype(np.uint8)
+            ).save(tmp_path / cls / f"{i:03d}.png")
+
+    resnet = load_example(monkeypatch, "img_cls", "resnet")
+    conf = resnet.Config.load("resnet.yml")
+    # batch 4: the stratified 90/5/5 split leaves a 4-image test
+    # split at this corpus size, and drop_last must still fill it
+    conf.epochs, conf.loader.batch_size = 1, 4
+    conf.num_classes = 2
+    conf.freeze_backbone = True
+    tiny_env(conf)
+    conf.dataset.name = "image_folder"
+    conf.dataset.root = str(tmp_path)
+    results = resnet.main(conf)
+    assert results["train_loss"] > 0.0
+    assert 0.0 <= results["test_acc"] <= 1.0
+
+
 def test_resnet_yaml_mesh_flip_shards_params(monkeypatch):
     """VERDICT #5's contract: change ONLY the YAML mesh line and params
     come back non-replicated — the config front door consumes the
