@@ -54,6 +54,8 @@ class ModelConfig(BaseConfig):
     seq_len: int = 256
     remat: bool = True
     n_experts: int = 0              # > 0: MoE blocks over the ep axis
+    top_k: int = 2                  # experts per token
+    capacity_factor: float = 1.25   # static per-expert buffer slack
     aux_weight: float = 1e-2        # load-balance loss weight
     # sequence-parallel attention on sp>1 meshes: auto | ring | ulysses
     sp_strategy: str = "auto"
@@ -66,6 +68,8 @@ class ModelConfig(BaseConfig):
                          d_model=self.d_model, n_heads=self.n_heads,
                          n_kv_heads=self.n_kv_heads,
                          seq_len=self.seq_len, n_experts=self.n_experts,
+                         top_k=self.top_k,
+                         capacity_factor=self.capacity_factor,
                          sp_strategy=self.sp_strategy, pos=self.pos,
                          mlp=self.mlp, dropout=self.dropout)
 

@@ -472,7 +472,13 @@ def _pipelined_blocks(params: dict, x: jax.Array, cfg: GPTConfig,
     capacity factors the drop decisions themselves are per-microbatch,
     so overflowing tokens may differ from the un-pipelined forward
     (pipeline_apply's docstring spells out the contract); with ample
-    capacity the logits match bitwise.
+    capacity the logits match bitwise. Under sp the same contract
+    tightens one more notch: routing is per SEQUENCE SHARD (each sp
+    rank routes its local S/sp tokens with locally-computed capacity —
+    tokens never cross sp ranks for expert compute, the standard
+    sequence-parallel MoE layout), and the aux is the pmean of the
+    per-shard estimators. Ample capacity again gives bitwise-matching
+    logits; tight capacity drops a per-(microbatch, shard) token set.
 
     Tensor parallelism composes INSIDE the pipeline: with ``tp > 1`` in
     the mesh, block weights additionally shard Megatron-style across tp
@@ -506,10 +512,6 @@ def _pipelined_blocks(params: dict, x: jax.Array, cfg: GPTConfig,
     tp_size = mesh.shape.get("tp", 1)
     tp = ("tp", tp_size) if tp_size > 1 else None
     sp_size = mesh.shape["sp"] if use_sp else 1
-    if use_sp and cfg.n_experts > 0:
-        raise NotImplementedError(
-            "pp x sp with MoE blocks is not wired (per-sequence-shard "
-            "routing/capacity semantics undefined)")
     blocks = params["blocks"]
     if tp is not None:
         if cfg.n_heads % tp_size or cfg.kv_heads % tp_size:
@@ -615,10 +617,16 @@ def _pipelined_blocks(params: dict, x: jax.Array, cfg: GPTConfig,
     # capacity factors; dense blocks take the deeper default (less
     # bubble, identical math up to reassociation)
     n_mb = mesh.shape["pp"] if cfg.n_experts > 0 else None
+    # per-sequence-shard MoE routing makes each sp rank's aux a LOCAL
+    # estimator (a different estimator than the global one — same
+    # class of deviation as the per-microbatch granularity above);
+    # aux_axes pmeans it once at the pipeline epilogue so the
+    # returned scalar is collective-uniform
     return pipeline_apply(layer, (blocks, layer_keys), x, mesh,
                           n_microbatches=n_mb,
                           with_mb_index=True, with_aux=True,
-                          param_specs=param_specs, x_spec=x_spec)
+                          param_specs=param_specs, x_spec=x_spec,
+                          aux_axes=("sp",) if use_sp else ())
 
 
 def _dropout(x: jax.Array, rate: float,

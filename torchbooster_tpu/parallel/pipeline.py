@@ -88,6 +88,7 @@ def pipeline_apply(
     with_aux: bool = False,
     param_specs: Any | None = None,
     x_spec: P | None = None,
+    aux_axes: tuple[str, ...] = (),
 ) -> jax.Array | tuple[jax.Array, jax.Array]:
     """Run ``layer_fn`` over ``L`` stacked layers, pipelined over the
     mesh's ``axis``.
@@ -111,7 +112,11 @@ def pipeline_apply(
     the microbatch-granular estimator of the full-batch aux (batch
     statistics like expert load fractions are computed per microbatch
     here, so the value is close to, not bitwise-equal to, the
-    un-pipelined one).
+    un-pipelined one). ``aux_axes``: extra MANUAL mesh axes the
+    layer_fn's aux varies over (a sequence-parallel axis with
+    per-shard routing) — the aux is pmean'd over them ONCE here, so
+    the returned scalar is collective-uniform; pmean is linear, so
+    grads are identical to reducing inside every layer.
 
     ``batch_axes`` are the mesh axes the per-microbatch batch dimension
     shards over — default: whichever of ``dp``/``fsdp`` the mesh has.
@@ -240,6 +245,8 @@ def pipeline_apply(
             aux = jax.lax.psum(aux_sum, axis) / m
             if batch_axes:
                 aux = jax.lax.pmean(aux, batch_axes)
+            if aux_axes:
+                aux = jax.lax.pmean(aux, aux_axes)
             return out, aux
         return out
 
