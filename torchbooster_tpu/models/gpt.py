@@ -464,9 +464,13 @@ def _pipelined_blocks(params: dict, x: jax.Array, cfg: GPTConfig,
     stay outside the pipeline (they are not layer-stacked). Returns
     (x, aux).
 
-    MoE blocks pipeline too: experts run replicated within each stage
-    (an ``ep`` axis is not sharded inside the pipeline's shard_map) and
-    the load-balance aux is the per-microbatch estimator — expert load
+    MoE blocks pipeline too: with an ``ep`` axis in the mesh the
+    experts shard across it INSIDE each stage (each rank holds E/ep
+    experts, routes its own tokens to them — no all-to-all, the
+    activations are ep-replicated — and one psum combines; global
+    capacity semantics exactly preserved, ``moe_apply(ep=...)``);
+    without ``ep`` experts run replicated within the stage. Either
+    way the load-balance aux is the per-microbatch estimator — expert load
     fractions and capacity are computed per microbatch, so aux tracks
     but does not bitwise-match the un-pipelined value. At TIGHT
     capacity factors the drop decisions themselves are per-microbatch,
@@ -511,6 +515,8 @@ def _pipelined_blocks(params: dict, x: jax.Array, cfg: GPTConfig,
 
     tp_size = mesh.shape.get("tp", 1)
     tp = ("tp", tp_size) if tp_size > 1 else None
+    ep_size = mesh.shape.get("ep", 1) if cfg.n_experts > 0 else 1
+    ep = ("ep", ep_size) if ep_size > 1 else None
     sp_size = mesh.shape["sp"] if use_sp else 1
     blocks = params["blocks"]
     if tp is not None:
@@ -531,7 +537,14 @@ def _pipelined_blocks(params: dict, x: jax.Array, cfg: GPTConfig,
                 "kernel": jnp.take(qkv["kernel"], perm, axis=2),
                 **({"bias": jnp.take(qkv["bias"], perm, axis=1)}
                    if "bias" in qkv else {})}}
+    if ep is not None and cfg.n_experts % ep_size:
+        raise ValueError(
+            f"pp x ep needs n_experts ({cfg.n_experts}) divisible "
+            f"by ep ({ep_size})")
 
+    if tp is not None or ep is not None:
+        t_ax = "tp" if tp is not None else None
+        e_ax = "ep" if ep is not None else None
         col = {"attn_qkv", "mlp_fc1", "mlp_fc3"}   # out dim over tp
         row = {"attn_proj", "mlp_fc2"}             # in dim over tp
 
@@ -539,18 +552,22 @@ def _pipelined_blocks(params: dict, x: jax.Array, cfg: GPTConfig,
             name = _path_str(path)
             layer, kind = name.split("/")[0], name.split("/")[-1]
             if layer in col:
-                return P("pp", None, "tp") if kind == "kernel" \
-                    else P("pp", "tp")
+                return P("pp", None, t_ax) if kind == "kernel" \
+                    else P("pp", t_ax)
             if layer in row and kind == "kernel":
-                return P("pp", "tp", None)
-            # expert weights (leading dims: layer, expert): hidden over
-            # tp — fc1 column-parallel, fc2 row-parallel (psum inside
-            # moe_apply's expert_mlps); gate and fc2 bias replicate
+                return P("pp", t_ax, None)
+            # expert weights (leading dims: layer, expert): experts
+            # over ep (each rank's local slice — moe_apply routes its
+            # own tokens, psum combines), hidden over tp — fc1
+            # column-parallel, fc2 row-parallel (psum inside
+            # moe_apply's expert_mlps); gate replicates (routing is
+            # global on every rank)
             if layer == "moe_fc1":
-                return P("pp", None, None, "tp") if kind == "kernel" \
-                    else P("pp", None, "tp")
-            if layer == "moe_fc2" and kind == "kernel":
-                return P("pp", None, "tp", None)
+                return P("pp", e_ax, None, t_ax) if kind == "kernel" \
+                    else P("pp", e_ax, t_ax)
+            if layer == "moe_fc2":
+                return P("pp", e_ax, t_ax, None) if kind == "kernel" \
+                    else P("pp", e_ax, None)
             return P("pp")
 
         block_specs = jax.tree_util.tree_map_with_path(assign, blocks)
@@ -601,7 +618,7 @@ def _pipelined_blocks(params: dict, x: jax.Array, cfg: GPTConfig,
         key = jax.random.fold_in(key, mb_idx) if drop else key
         h, layer_aux, _ = _block_core(
             bp, h, cfg, attend, positions=positions,
-            dropout=drop, dropout_key=key, tp=tp)
+            dropout=drop, dropout_key=key, tp=tp, ep=ep)
         return h, layer_aux
 
     layer = jax.checkpoint(
@@ -657,7 +674,8 @@ def _block_core(bp: dict, x: jax.Array, cfg: GPTConfig, attend,
                 positions: jax.Array | None = None,
                 dropout: float = 0.0,
                 dropout_key: jax.Array | None = None,
-                tp: tuple[str, int] | None = None
+                tp: tuple[str, int] | None = None,
+                ep: tuple[str, int] | None = None
                 ) -> tuple[jax.Array, jax.Array, Any]:
     """The transformer block math, shared by every path (training
     forward, prefill, cached decode) so they cannot drift apart.
@@ -671,8 +689,10 @@ def _block_core(bp: dict, x: jax.Array, cfg: GPTConfig, attend,
     ``tp=(axis, size)``: MANUAL tensor parallelism for shard_map
     callers (the pipeline): bp holds per-rank Megatron slices —
     column-parallel qkv/fc1/fc3 (local head/hidden subset), row-
-    parallel proj/fc2 (psum over ``axis`` before the bias). The
-    auto-SPMD paths leave this None and let XLA place the collectives.
+    parallel proj/fc2 (psum over ``axis`` before the bias).
+    ``ep=(axis, size)``: MANUAL expert parallelism — bp's expert
+    tensors hold this rank's slice (``moe_apply(ep=...)``). The
+    auto-SPMD paths leave both None and let XLA place the collectives.
     Returns (x, aux_loss, extras)."""
     b, s, d = x.shape
     n_heads, kv_heads = cfg.n_heads, cfg.kv_heads
@@ -714,7 +734,7 @@ def _block_core(bp: dict, x: jax.Array, cfg: GPTConfig, attend,
             bp, h, top_k=cfg.top_k,
             capacity_factor=cfg.capacity_factor
             if capacity_factor is None else capacity_factor,
-            reduce=None if tp is None else reduce)
+            reduce=None if tp is None else reduce, ep=ep)
         x = constrain(x + _dropout(m, dropout, k_mlp))
     elif "mlp_fc3" in bp:   # swiglu: silu(xW1) ⊙ xW3 → W2
         h = jax.nn.silu(L.dense(bp["mlp_fc1"], h)) * L.dense(bp["mlp_fc3"], h)

@@ -71,7 +71,9 @@ def moe_apply(params: dict, x: jax.Array, top_k: int = 2,
               capacity_factor: float = 1.25,
               activation=jax.nn.gelu,
               impl: str = "scatter",
-              reduce=None) -> tuple[jax.Array, jax.Array]:
+              reduce=None,
+              ep: tuple[str, int] | None = None
+              ) -> tuple[jax.Array, jax.Array]:
     """(B, S, d) → ((B, S, d), aux_loss). Top-``top_k`` routing with
     static per-expert capacity; dropped tokens pass through as zeros
     (the residual connection around the block carries them).
@@ -92,7 +94,19 @@ def moe_apply(params: dict, x: jax.Array, top_k: int = 2,
     ``_row_dense``. Routing is token-level math on the (replicated)
     activations, so every tp rank computes identical dispatch and only
     the expert MLP hidden is split. The auto-SPMD paths leave this
-    None and let XLA place the collectives from SHARDING_RULES."""
+    None and let XLA place the collectives from SHARDING_RULES.
+
+    ``ep=(axis, size)``: MANUAL expert parallelism for shard_map
+    callers — ``params``' expert tensors hold this rank's ``E/size``
+    expert slice (the gate stays global/replicated). Because the
+    activations are replicated across ep within a stage, NO all-to-all
+    is needed: every rank computes the identical GLOBAL routing
+    (capacity stays ``k·T/E_global·cf`` — exactly the unsharded
+    semantics), scatters only the tokens destined to ITS experts, runs
+    its expert slice, and one psum over ``axis`` combines each token's
+    top-k contributions (each expert lives on exactly one rank).
+    Scatter impl only. Composes with ``reduce`` (tp splits each local
+    expert's hidden)."""
     b, s, d = x.shape
     tokens = x.reshape(b * s, d)
     t = tokens.shape[0]
@@ -143,21 +157,46 @@ def moe_apply(params: dict, x: jax.Array, top_k: int = 2,
         # slot and no two tokens share one, so scatter-add never
         # collides. Dropped tokens get an out-of-range id and vanish
         # via mode="drop" / gather fill — the transposes (gather /
-        # scatter-add) make the whole path differentiable.
-        flat = jnp.zeros((n_experts * capacity, d), x.dtype)
+        # scatter-add) make the whole path differentiable. Under
+        # manual ep, slots index the LOCAL expert slice and routes to
+        # other ranks' experts are out-of-range here (they land on
+        # their own rank; the psum below re-assembles every token).
+        if ep is not None:
+            ep_axis, ep_size = ep
+            local_e = params["moe_fc1"]["kernel"].shape[0]
+            if local_e * ep_size != n_experts:
+                # a full-E (or differently factored) expert tree with
+                # ep set would silently mis-route tokens via a wrong
+                # rank offset — fail loudly instead
+                raise ValueError(
+                    f"moe_apply(ep=({ep_axis!r}, {ep_size})): local "
+                    f"expert slice {local_e} x {ep_size} != gate's "
+                    f"{n_experts} experts")
+            lo = jax.lax.axis_index(ep_axis) * local_e
+        else:
+            local_e, lo = n_experts, 0
+        flat = jnp.zeros((local_e * capacity, d), x.dtype)
         dsts = []
         for expert, weight, pos, keep in rounds:
-            dst = jnp.where(keep, expert * capacity + pos,
-                            n_experts * capacity)
+            local_idx = expert - lo
+            ok = keep & (local_idx >= 0) & (local_idx < local_e)
+            dst = jnp.where(ok, local_idx * capacity + pos,
+                            local_e * capacity)
             dsts.append(dst)
             flat = flat.at[dst].add(tokens, mode="drop")
-        expert_out = expert_mlps(flat.reshape(n_experts, capacity, d))
-        flat_out = expert_out.reshape(n_experts * capacity, d)
+        expert_out = expert_mlps(flat.reshape(local_e, capacity, d))
+        flat_out = expert_out.reshape(local_e * capacity, d)
         out = jnp.zeros((t, d), x.dtype)
         for (expert, weight, pos, keep), dst in zip(rounds, dsts):
             gathered = flat_out.at[dst].get(mode="fill", fill_value=0)
             out = out + weight.astype(x.dtype)[:, None] * gathered
+        if ep is not None:
+            out = jax.lax.psum(out, ep_axis)
     elif impl == "einsum":
+        if ep is not None:
+            raise ValueError(
+                "manual ep is wired for the scatter impl only (the "
+                "einsum oracle is a global-dispatch parity check)")
         combine = jnp.zeros((t, n_experts, capacity), jnp.float32)
         dispatch = jnp.zeros((t, n_experts, capacity), jnp.bool_)
         for expert, weight, pos, keep in rounds:
