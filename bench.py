@@ -302,6 +302,10 @@ def bench_decode() -> dict:
     n_new = int(os.environ.get("BENCH_DECODE_NEW", 128))
     caches = [int(s) for s in os.environ.get(
         "BENCH_DECODE_CACHES", "1024,8192").split(",")]
+    # "int8": quantized KV cache (symmetric per-token-head + scales) —
+    # ~half the cache bytes the decode loop is roofed on reading
+    cache_dtype = os.environ.get("BENCH_DECODE_CACHE_DTYPE") or None
+    suffix = f"_{cache_dtype}" if cache_dtype else ""
     out = {}
     for s_cache in caches:
         if s_cache <= n_new:
@@ -319,8 +323,10 @@ def bench_decode() -> dict:
             # K/V expand before the matmul) — subtract an n_new=1 run
             # (same prompt, prefill + one pick, no decode scan) so the
             # reported number is the per-token decode loop alone
-            gen = jit_generate(cfg, n_new=n_new, temperature=0.0)
-            gen1 = jit_generate(cfg, n_new=1, temperature=0.0)
+            gen = jit_generate(cfg, n_new=n_new, temperature=0.0,
+                               cache_dtype=cache_dtype)
+            gen1 = jit_generate(cfg, n_new=1, temperature=0.0,
+                                cache_dtype=cache_dtype)
             np.asarray(gen(params, prompt, rng))       # compile + warmup
             np.asarray(gen1(params, prompt, rng))
             t0 = time.perf_counter()
@@ -330,7 +336,7 @@ def bench_decode() -> dict:
             np.asarray(gen1(params, prompt, rng))
             dt_prefill = time.perf_counter() - t0
             dt = max(dt_full - dt_prefill, 1e-9)
-            key = f"decode_tok_s_c{s_cache}_kv{kv or 'full'}"
+            key = f"decode_tok_s_c{s_cache}_kv{kv or 'full'}{suffix}"
             out[key] = round(b * (n_new - 1) / dt, 1)
     return out
 

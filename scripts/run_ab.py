@@ -73,6 +73,10 @@ QUEUE: list[tuple[str, str, dict, int]] = [
     # serving: KV-cache decode tokens/s, MHA vs GQA cache width at
     # 1k/8k cache (bench.bench_decode; VERDICT r3 missing #4)
     ("decode", "decode", {}, 1800),
+    # int8 KV cache: ~half the cache bytes decode is roofed on; the
+    # A/B against the bf16 rows above prices the quantized read path
+    ("decode_int8", "decode",
+     {"BENCH_DECODE_CACHE_DTYPE": "int8"}, 1800),
     # recipe accuracy on chip (VERDICT r4 #3): the shipped ResNet
     # CIFAR recipe end to end, ref hyperparams, 20 epochs — real
     # CIFAR-10 if a binary release is under the dataset root (none in
