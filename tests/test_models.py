@@ -267,11 +267,11 @@ def test_gpt_generate_matches_full_forward_greedy():
 
 def test_gpt_generate_int8_cache():
     """cache_dtype="int8": the quantized KV cache (symmetric
-    per-token-head int8 + fp32 scales — ~half the decode HBM traffic)
-    decodes valid ids and, on a model with a DECISIVE head (scaled-up
-    logits so ~0.5% attention error cannot flip the argmax), greedily
-    matches the fp32-cache decode token for token. Bad dtypes are
-    loud."""
+    per-token-head int8 + bf16 scales) decodes valid ids and, on a
+    model with a DECISIVE head (scaled-up logits so ~0.5% attention
+    error cannot flip the argmax), greedily matches the plain-cache
+    decode token for token — in BOTH fp32 and the shipped bf16
+    compute mode. Bad dtypes are loud."""
     from torchbooster_tpu.models.gpt import GPT, jit_generate
 
     cfg = GPTConfig(vocab=97, n_layers=2, d_model=32, n_heads=4,
@@ -283,15 +283,16 @@ def test_gpt_generate_int8_cache():
     params = {**params, "wte": {"table": table * 4.0}}
     ids = jax.random.randint(jax.random.PRNGKey(1), (2, 5), 0, cfg.vocab)
 
-    ref = GPT.generate(params, ids, cfg, n_new=6, temperature=0.0,
-                       compute_dtype=jnp.float32)
-    got = GPT.generate(params, ids, cfg, n_new=6, temperature=0.0,
-                       compute_dtype=jnp.float32, cache_dtype="int8")
-    np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+    for dtype in (jnp.float32, jnp.bfloat16):
+        ref = GPT.generate(params, ids, cfg, n_new=6, temperature=0.0,
+                           compute_dtype=dtype)
+        got = GPT.generate(params, ids, cfg, n_new=6, temperature=0.0,
+                           compute_dtype=dtype, cache_dtype="int8")
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
 
     # one-compile entry carries the knob too
     fn = jit_generate(cfg, n_new=6, temperature=0.0,
-                      compute_dtype=jnp.float32, cache_dtype="int8")
+                      compute_dtype=jnp.bfloat16, cache_dtype="int8")
     got2 = fn(params, ids, jax.random.PRNGKey(0))
     np.testing.assert_array_equal(np.asarray(got2), np.asarray(ref))
 

@@ -754,8 +754,10 @@ def _quantize_kv(x: jax.Array) -> tuple[jax.Array, jax.Array]:
     int8 cache bytes at Dh=64 — fp32 scales would cost 4/Dh ≈ 6%), and
     the QUANTIZATION divides by the rounded bf16 scale so the stored
     pair is exactly self-consistent. Decode HBM reads drop to ~half of
-    bf16. Returns (int8 values, bf16 scales, head dim kept for
-    broadcasting)."""
+    bf16 *if* XLA folds the widening convert into the dot reads (the
+    queued decode_int8 bench row is the proof either way). Returns a
+    2-tuple ``(int8 values, bf16 scales)`` — scales keep the head dim
+    as a trailing 1 for broadcasting."""
     scale = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1,
                     keepdims=True) / 127.0
     scale = jnp.maximum(scale, 1e-8).astype(jnp.bfloat16)
@@ -815,8 +817,14 @@ def _cached_block(bp: dict, x: jax.Array, cache_k, cache_v,
         # contract (softmax itself stays fp32). For the int8 cache the
         # per-token scales FACTOR OUT of the dots: scores scale by
         # s_k[token] after the QK dot, and s_v folds into the (small)
-        # probs tensor before the PV dot — the big reads stay int8.
-        dot_t = jnp.bfloat16 if quantized else ck.dtype
+        # probs tensor before the PV dot. The int8→dot-dtype convert is
+        # written to fuse into the dot's operand read (keeping the HBM
+        # stream at 1 byte/elem); whether XLA actually folds it — vs
+        # materializing a widened copy — is exactly what the queued
+        # decode_int8 A/B row measures. Dot precision follows the
+        # caller's compute dtype (q.dtype), so fp32 callers keep fp32
+        # dots over the dequantized values.
+        dot_t = q.dtype if quantized else ck.dtype
         scores = jnp.einsum(
             "bqgrd,bkgd->bgrqk", qg.astype(dot_t), ck.astype(dot_t),
             preferred_element_type=jnp.float32) / (head_dim ** 0.5)
