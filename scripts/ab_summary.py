@@ -29,6 +29,7 @@ METRICS = {
     "gpt_noremat": ("gpt tok/s", "gpt_tokens_per_sec"),
     "gpt_b32": ("gpt tok/s", "gpt_tokens_per_sec"),
     "gpt_chunked_b32": ("gpt tok/s", "gpt_tokens_per_sec"),
+    "gpt_chunked_noremat": ("gpt tok/s", "gpt_tokens_per_sec"),
     "gpt_rope": ("gpt tok/s", "gpt_tokens_per_sec"),
     "gpt_swiglu": ("gpt tok/s", "gpt_tokens_per_sec"),
     "gpt_gqa4": ("gpt tok/s", "gpt_tokens_per_sec"),
@@ -40,6 +41,7 @@ METRICS = {
     "gpt_long_blk512": ("gpt-long tok/s", "gpt_long_tokens_per_sec"),
     "gpt_long_q2048k512": ("gpt-long tok/s", "gpt_long_tokens_per_sec"),
     "gpt_long_noremat": ("gpt-long tok/s", "gpt_long_tokens_per_sec"),
+    "gpt_long_chunked": ("gpt-long tok/s", "gpt_long_tokens_per_sec"),
     "gpt_long_s16k": ("gpt-long tok/s", "gpt_long_tokens_per_sec"),
     "gpt_long_s32k": ("gpt-long tok/s", "gpt_long_tokens_per_sec"),
     "unet": ("unet img/s", "unet_img_per_sec"),
@@ -94,9 +96,10 @@ def main() -> None:
     # configs in the log but absent from METRICS (queue entries drift
     # in faster than this table — decode and gpt_chunked_b32 both did):
     # render them raw rather than silently dropping recorded evidence
+    multi_key = ("decode", "decode_int8", "cifar_acc")
     for name in sorted(attempts):
-        if name in METRICS or (name == "decode" and name in latest):
-            continue  # decode's ok row prints below; failures fall through
+        if name in METRICS or (name in multi_key and name in latest):
+            continue  # multi-key ok rows print below; failures fall through
         e = latest.get(name)
         if e is None:
             print(f"| {name} | ? | — | — | "
@@ -104,10 +107,11 @@ def main() -> None:
         else:
             print(f"| {name} | ? | {json.dumps(e.get('result', {}))} "
                   f"| — | ok ({e.get('seconds', '?')}s) |")
-    decode = latest.get("decode")
-    if decode:
-        print("\ndecode (tokens/s):",
-              json.dumps(decode.get("result", {}), indent=None))
+    for name in ("decode", "decode_int8", "cifar_acc"):
+        e = latest.get(name)
+        if e:
+            print(f"\n{name}:",
+                  json.dumps(e.get("result", {}), indent=None))
 
 
 if __name__ == "__main__":
