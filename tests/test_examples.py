@@ -515,6 +515,9 @@ def test_gpt_long_yaml_resolves_and_trains_tiny(monkeypatch, tmp_path):
     assert conf.model.pos == "rope" and conf.model.n_kv_heads == 8
     assert conf.model.seq_len == 8192 and conf.env.mesh == "sp:8"
     assert conf.optim.decay_matrices_only
+    # the recorded chunked-LM-head win is reachable from the YAML (and
+    # exercised by this shrunk run — no (T, vocab) logits materialize)
+    assert conf.model.chunked_head
 
     corpus = "sphinx of black quartz judge my vow. " * 400
     path = tmp_path / "corpus.txt"
