@@ -55,12 +55,27 @@ class ContentDatasetConfig(DatasetConfig):
     palette: float = 0.0
 
     def make(self, split: Split, **kwargs):
+        import operator
+
+        from torchbooster_tpu.data.folder import ImageFolder
         from torchbooster_tpu.data.sources import StoreDataset
+        from torchbooster_tpu.dataset import TransformDataset
 
         if StoreDataset.store_path(self.root, split).exists():
             return resolve_dataset(self, split, **kwargs)
-        logging.warning("no %r store (offline?); procedural images",
-                        self.name)
+        try:
+            # real photos/paintings dropped under root (flat or
+            # class-nested — data/folder.py) beat the procedural
+            # stand-in; labels are dropped, pixels resized
+            folder = ImageFolder(self.root, split, size=self.image_size)
+            logging.info("resolved %d real images under %r for %s "
+                         "(image folder)", len(folder), self.root,
+                         split.value)
+            return TransformDataset(folder, operator.itemgetter(0))
+        except FileNotFoundError:
+            pass
+        logging.warning("no %r store or image folder (offline?); "
+                        "procedural images", self.name)
         import zlib
 
         return ProceduralImages(self.n_images, self.image_size,
@@ -157,7 +172,7 @@ def main(conf: Config) -> dict:
                  conf.env.shard_batch(style_batch))
         state, step_metrics = step(state, batch)
         metrics.update(step_metrics)
-        if (it + 1) % conf.sample_every == 0:
+        if conf.sample_every and (it + 1) % conf.sample_every == 0:
             results = {"iter": it + 1, "epoch": epoch, **metrics.compute()}
             metrics.reset()
             if dist.is_primary():

@@ -56,13 +56,31 @@ class CocoDatasetConfig(DatasetConfig):
     n_images: int = 2_048
 
     def make(self, split: Split, **kwargs):
+        import operator
+
+        from torchbooster_tpu.data.folder import ImageFolder
         from torchbooster_tpu.data.sources import StoreDataset
+        from torchbooster_tpu.dataset import TransformDataset
 
         if StoreDataset.store_path(self.root, split).exists():
             return resolve_dataset(self, split, **kwargs)
+        try:
+            # a directory of real photos under root (flat or nested —
+            # data/folder.py; labels are dropped, the recipe consumes
+            # pixels only) beats the procedural stand-in: the real
+            # COCO-style route without a network (the reference
+            # downloaded the zip here, ref online.py:73-82)
+            folder = ImageFolder(self.root, split,
+                                 size=self.image_size)
+            logging.info("resolved %d real images under %r for %s "
+                         "(image folder)", len(folder), self.root,
+                         split.value)
+            return TransformDataset(folder, operator.itemgetter(0))
+        except FileNotFoundError:
+            pass
         logging.warning(
-            "no COCO store under %r (offline?); using procedural images",
-            self.root)
+            "no COCO store or image folder under %r (offline?); using "
+            "procedural images", self.root)
         return ProceduralImages(self.n_images, self.image_size,
                                 seed={"train": 0, "validation": 1,
                                       "test": 2}[split.value])
@@ -165,7 +183,7 @@ def main(conf: Config) -> dict:
         batch = conf.env.shard_batch(batch)
         state, step_metrics = step(state, batch)
         metrics.update(step_metrics)
-        if (it + 1) % conf.sample_every == 0:
+        if conf.sample_every and (it + 1) % conf.sample_every == 0:
             results = {"iter": it + 1, "epoch": epoch, **metrics.compute()}
             metrics.reset()
             if dist.is_primary():

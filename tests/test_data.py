@@ -388,6 +388,35 @@ def test_image_folder_dataset(tmp_path):
     assert resized[0][0].shape == (16, 16, 3)
 
 
+def test_image_folder_flat_unlabeled_corpus(tmp_path):
+    """A flat directory of images (no class subdirs) is one implicit
+    class — the unlabeled-corpus shape the style recipes consume —
+    and zip junk (a __MACOSX dir of AppleDouble files, a hidden
+    checkpoint dir) neither masks the flat corpus nor becomes a
+    label."""
+    pytest.importorskip("PIL")
+    from PIL import Image
+
+    from torchbooster_tpu.data.folder import ImageFolder
+
+    for i in range(40):
+        rs = np.random.RandomState(i)
+        Image.fromarray(rs.randint(0, 256, (8, 8, 3)).astype(np.uint8)
+                        ).save(tmp_path / f"photo{i:03d}.png")
+    # macOS zip-extraction artifacts: image-suffixed resource forks
+    # inside __MACOSX, plus a hidden dir — all must be ignored
+    (tmp_path / "__MACOSX").mkdir()
+    (tmp_path / "__MACOSX" / "._photo000.png").write_bytes(b"junk")
+    (tmp_path / ".ipynb_checkpoints").mkdir()
+    (tmp_path / "._photo999.png").write_bytes(b"junk")
+    train = ImageFolder(tmp_path, Split.TRAIN)
+    test = ImageFolder(tmp_path, Split.TEST)
+    assert train.classes == ["."]
+    assert len(train) == 36 and len(test) == 2
+    image, label = train[0]
+    assert image.shape == (8, 8, 3) and int(label) == 0
+
+
 def test_image_folder_explicit_splits_and_errors(tmp_path):
     """Explicit train/test layout wins over positional; a layout with
     split dirs but no images for the asked split fails loudly, as does

@@ -121,6 +121,35 @@ def test_resnet(monkeypatch):
     assert results["train_loss"] > 0.0
 
 
+def test_online_dataset_prefers_real_photo_folder(monkeypatch, tmp_path):
+    """The style-transfer recipe's COCO config resolves a LOCAL photo
+    folder (flat, unlabeled) ahead of the procedural stand-in — the
+    zero-egress real-data route for the reference's download-COCO
+    path (ref online.py:73-82)."""
+    pytest.importorskip("PIL")
+    import numpy as np
+    from PIL import Image
+
+    from torchbooster_tpu.dataset import Split
+
+    for i in range(12):
+        rs = np.random.RandomState(i)
+        Image.fromarray(rs.randint(0, 256, (24, 20, 3)).astype(np.uint8)
+                        ).save(tmp_path / f"photo{i:02d}.png")
+    online = load_example(monkeypatch, "img_stt", "online")
+    conf_ds = online.CocoDatasetConfig(name="coco", root=str(tmp_path),
+                                       image_size=32)
+    ds = conf_ds.make(Split.TRAIN)
+    assert len(ds) == 10            # 90% of the flat corpus
+    image = ds[0]                   # label dropped, resized to size
+    assert image.shape == (32, 32, 3)
+    # no folder → procedural fallback keeps recipes runnable
+    fallback = online.CocoDatasetConfig(name="coco",
+                                        root=str(tmp_path / "missing"),
+                                        image_size=32, n_images=8)
+    assert len(fallback.make(Split.TRAIN)) == 8
+
+
 def test_resnet_on_image_folder(monkeypatch, tmp_path):
     """The shipped ResNet recipe trains on a LOCAL image-folder corpus
     by changing only the dataset YAML lines (`name: image_folder`,

@@ -9,11 +9,16 @@ This is its zero-egress analogue: point ``dataset.root`` at
       <class_a>/*.png               root/test/<class>/*.png
       <class_b>/*.jpg               (validation | val | valid)
 
-and every image under a class directory becomes one example. When the
+and every image under a class directory becomes one example (a FLAT
+directory of images with no class subdirs is one implicit class —
+unlabeled corpora for the style recipes). When the
 root has no explicit split directories, a deterministic 90/5/5
 positional split WITHIN each class serves train/validation/test
 (stratified — every split sees every class). Class indices follow sorted
-class-directory names (torchvision ImageFolder semantics), decoded
+class-directory names — counting only directories that actually contain
+images, so zip-artifact junk (``__MACOSX/``, ``.ipynb_checkpoints/``,
+AppleDouble ``._*.png`` files) neither becomes a label nor masks a flat
+corpus (torchvision ImageFolder semantics otherwise), decoded
 lazily per item via PIL (gated import — the loader's worker pool
 parallelizes the decode exactly like torchvision's).
 """
@@ -47,14 +52,33 @@ def _split_base(root: Path, split: Split) -> Path | None:
     return root
 
 
+def _is_image(path: Path) -> bool:
+    # skip hidden/AppleDouble files ("._photo.png" from a macOS zip
+    # carries a matching suffix but is resource-fork junk, not pixels)
+    return (path.suffix.lower() in _EXTENSIONS and path.is_file()
+            and not path.name.startswith("."))
+
+
 def _scan(base: Path) -> tuple[list[tuple[Path, int]], list[str]]:
-    classes = sorted(d.name for d in base.iterdir() if d.is_dir())
-    items = []
-    for idx, name in enumerate(classes):
-        for path in sorted((base / name).rglob("*")):
-            if path.suffix.lower() in _EXTENSIONS and path.is_file():
-                items.append((path, idx))
-    return items, classes
+    # classes = subdirectories that actually CONTAIN images: a stray
+    # __MACOSX/ or .ipynb_checkpoints/ next to real photos must not
+    # become a label (or mask the flat-corpus fallback below)
+    by_class = [(d.name, [p for p in sorted(d.rglob("*"))
+                          if _is_image(p)])
+                for d in sorted(base.iterdir())
+                if d.is_dir() and not d.name.startswith(".")]
+    by_class = [(name, files) for name, files in by_class if files]
+    if by_class:
+        classes = [name for name, _ in by_class]
+        items = [(p, idx) for idx, (_, files) in enumerate(by_class)
+                 for p in files]
+        return items, classes
+    # flat unlabeled corpus (photos straight under base): one implicit
+    # class — the style-transfer recipes consume images only, and a
+    # labels-free folder should not force users to invent a class
+    # directory
+    flat = [p for p in sorted(base.iterdir()) if _is_image(p)]
+    return [(p, 0) for p in flat], (["."] if flat else [])
 
 
 class ImageFolder(Dataset):
