@@ -1011,10 +1011,12 @@ def _main_probe_and_orchestrate() -> None:
                      "out); no LIVE measurement possible",
             "watcher": "scripts/run_ab.py keeps probing and drains the "
                        "full A/B queue (resnet variants, gpt, gpt_long "
-                       "flash-asserted, loader, decode) the moment the "
-                       "chip answers; results land in "
-                       "logs/ab_results.jsonl and the headline engages "
-                       "recorded wins automatically (_ab_best)"}
+                       "incl. the flash-vs-reference control, decode "
+                       "bf16+int8, the cifar_acc recipe-accuracy run, "
+                       "loader, unet) the moment the chip answers; "
+                       "results land in logs/ab_results.jsonl and the "
+                       "headline engages recorded wins automatically "
+                       "(_ab_best)"}
         # an end-of-round outage must not erase the round's evidence:
         # surface the best A/B-recorded numbers (same chip, same
         # workloads, captured by the watcher earlier) in the JSON line
