@@ -382,6 +382,15 @@ def test_gpt_jit_generate_with_sharded_params():
         got = gen(placed, ids, jax.random.PRNGKey(0))
     np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
 
+    # the quantized cache composes with the sharded serving layout:
+    # same tokens again (decisive-head trick not needed — fp32 compute
+    # on this tiny model decodes identically through the int8 cache)
+    gen8 = jit_generate(cfg, n_new=6, temperature=0.0,
+                        compute_dtype=jnp.float32, cache_dtype="int8")
+    with mesh:
+        got8 = gen8(placed, ids, jax.random.PRNGKey(0))
+    np.testing.assert_array_equal(np.asarray(got8), np.asarray(want))
+
 
 def test_gpt_generate_moe_smoke():
     """MoE decode: capacity floors at n_experts so a (B, 1) decode
