@@ -35,6 +35,9 @@ TB_FLASH_BLOCK_Q/TB_FLASH_BLOCK_K (flash tile-geometry sweep, read by
 ops/flash_attention itself), BENCH_LOADER_MODE/WORKERS;
 the decode sub-bench (tokens/s through the jitted KV-cache loop;
 BENCH_DECODE_BATCH/NEW/CACHES shape it, BENCH_SKIP_DECODE skips);
+the serve sub-bench (continuous batching through the paged-KV engine
+vs its dense-geometry control; BENCH_SERVE_REQUESTS/RATE/SLOTS/PAGE/
+PAGES/SEQ/CACHE_DTYPE shape it, BENCH_SKIP_SERVE skips);
 deadlines: BENCH_SUB_DEADLINE or BENCH_DEADLINE_<name>.
 """
 from __future__ import annotations
@@ -338,6 +341,97 @@ def bench_decode() -> dict:
             dt = max(dt_full - dt_prefill, 1e-9)
             key = f"decode_tok_s_c{s_cache}_kv{kv or 'full'}{suffix}"
             out[key] = round(b * (n_new - 1) / dt, 1)
+    return out
+
+
+def bench_serve() -> dict:
+    """Continuous-batching serving throughput through the paged-KV
+    engine (torchbooster_tpu/serving), with the DENSE-GEOMETRY control
+    run on the identical compiled step and request trace — the A/B
+    that measures the occupancy-proportional decode-read claim instead
+    of asserting it.
+
+    Workload: ``BENCH_SERVE_REQUESTS`` requests with Poisson arrivals
+    (rate ``BENCH_SERVE_RATE`` req/s), prompt lengths drawn from
+    page-aligned buckets (64..448 — buckets bound prefill compiles)
+    and output lengths uniform in [16, 128), over GPT-2 small geometry
+    at ``BENCH_SERVE_SEQ`` (default 2048) × n_kv_heads ∈ {MHA, 4}.
+    Paged geometry: ``BENCH_SERVE_SLOTS`` slots ×
+    ``BENCH_SERVE_PAGES`` pages of ``BENCH_SERVE_PAGE`` tokens —
+    default 65×64 ≈ 4.1k pooled tokens vs the dense control's
+    8 slots × 2048 = 16.4k, a 4× read-byte gap the decode_tok_s ratio
+    should track on an HBM-bound loop. ``BENCH_SERVE_CACHE_DTYPE=
+    int8`` quantizes the pages (the serve twin of decode_int8).
+
+    Emits per (kv, layout): decode tokens/s (step-time only — the
+    roofline number) and p95 request latency; plus the pool-size
+    ratio so the recorded line is self-describing."""
+    from torchbooster_tpu.models.gpt import GPT, GPTConfig
+    from torchbooster_tpu.serving import (ContinuousBatcher,
+                                          PagedEngine, Request)
+
+    n_req = int(os.environ.get("BENCH_SERVE_REQUESTS", 24))
+    rate = float(os.environ.get("BENCH_SERVE_RATE", 16.0))
+    slots = int(os.environ.get("BENCH_SERVE_SLOTS", 8))
+    page = int(os.environ.get("BENCH_SERVE_PAGE", 64))
+    n_pages = int(os.environ.get("BENCH_SERVE_PAGES", 65))
+    seq = int(os.environ.get("BENCH_SERVE_SEQ", 2048))
+    n_layers = int(os.environ.get("BENCH_SERVE_LAYERS", 12))
+    cache_dtype = os.environ.get("BENCH_SERVE_CACHE_DTYPE") or None
+    suffix = f"_{cache_dtype}" if cache_dtype else ""
+    buckets = [b for b in (64, 128, 192, 256, 320, 384, 448)
+               if b < seq // 2] or [max(1, min(seq // 2, seq - 8))]
+    # outputs capped so prompt + output always fits the cache horizon
+    # (short-seq runs via BENCH_SERVE_SEQ stay valid)
+    out_hi = max(2, min(129, seq - max(buckets)))
+    rs = np.random.RandomState(0)
+    arrivals = np.cumsum(rs.exponential(1.0 / rate, n_req))
+    prompt_lens = rs.choice(buckets, n_req)
+    out_lens = rs.randint(min(16, out_hi - 1), out_hi, n_req)
+    prompts = [rs.randint(0, 50257, n, dtype=np.int32)
+               for n in prompt_lens]
+    # the LARGEST re-prefill a preemption can produce: a request only
+    # preempts mid-generation, so at most max_new - 1 = out_hi - 2
+    # tokens fold into the prompt; warmup requests (max_new 2) must
+    # fit the horizon themselves
+    warm_max = min(max(buckets) + out_hi - 2, seq - 2)
+    warm_ids = rs.randint(0, 50257, warm_max, dtype=np.int32)
+
+    def trace():
+        return [Request(prompt=p, max_new_tokens=int(o),
+                        arrival=float(a))
+                for p, o, a in zip(prompts, out_lens, arrivals)]
+
+    def warmup_trace():
+        # prefill compiles per page COUNT (engine pads to pages);
+        # warm every count a measured prompt OR a preemption
+        # re-prefill (prompt + generated-so-far) can reach, plus the
+        # decode step, before the measured run
+        counts = range(1, -(-warm_max // page) + 1)
+        return [Request(prompt=warm_ids[:min(c * page, warm_max)],
+                        max_new_tokens=2) for c in counts]
+
+    out = {}
+    for kv in (0, 4):
+        cfg = GPTConfig(n_layers=n_layers, seq_len=seq, n_kv_heads=kv)
+        params = GPT.init(jax.random.PRNGKey(0), cfg)
+        for label, make_engine in (
+                ("", lambda: PagedEngine(
+                    params, cfg, page_size=page, n_pages=n_pages,
+                    max_slots=slots, cache_dtype=cache_dtype)),
+                ("dense_", lambda: PagedEngine.dense_control(
+                    params, cfg, max_slots=slots,
+                    cache_dtype=cache_dtype))):
+            engine = make_engine()
+            batcher = ContinuousBatcher(engine)
+            batcher.run(warmup_trace())
+            m = batcher.run(trace())
+            key = f"serve_{label}tok_s_c{seq}_kv{kv or 'full'}{suffix}"
+            out[key] = m["decode_tok_s"]
+            out[f"serve_{label}p95_s_c{seq}_kv{kv or 'full'}{suffix}"] \
+                = m["latency_p95_s"]
+    out[f"serve_pool_ratio{suffix}"] = round(
+        slots * seq / ((n_pages - 1) * page), 2)
     return out
 
 
@@ -772,6 +866,8 @@ def _sub_main(name: str) -> None:
                           "loader_mode": f"{mode}:{workers}"}))
     elif name == "decode":
         print(json.dumps(bench_decode()))
+    elif name == "serve":
+        print(json.dumps(bench_serve()))
     elif name == "cifar_acc":
         print(json.dumps(bench_cifar_acc()))
     else:
@@ -946,7 +1042,7 @@ def _deadline(name: str, default: int) -> int:
 
 # secondary sub-benches and their default deadlines, in run order
 _SECONDARY_BENCHES = (("gpt", 900), ("gpt_long", 1500), ("loader", 900),
-                      ("unet", 900), ("decode", 1500))
+                      ("unet", 900), ("decode", 1500), ("serve", 1800))
 
 
 def _driver_hold_budget() -> int:

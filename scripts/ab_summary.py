@@ -47,6 +47,11 @@ METRICS = {
     "unet": ("unet img/s", "unet_img_per_sec"),
     "loader_thread": ("loader img/s", "loader_img_per_sec"),
     "loader_process": ("loader img/s", "loader_img_per_sec"),
+    # serving rows: the in-process dense-geometry control lives in the
+    # SAME result dict (serve_dense_* keys), so the paged number is
+    # shown with its A/B partner rendered by the generic fallback
+    "serve": ("serve tok/s", "serve_tok_s_c2048_kvfull"),
+    "serve_int8": ("serve tok/s", "serve_tok_s_c2048_kvfull_int8"),
 }
 BASELINES = {"resnet img/s": "baseline", "gpt tok/s": "gpt",
              "gpt-long tok/s": "gpt_long_flash",

@@ -77,6 +77,17 @@ QUEUE: list[tuple[str, str, dict, int]] = [
     # A/B against the bf16 rows above prices the quantized read path
     ("decode_int8", "decode",
      {"BENCH_DECODE_CACHE_DTYPE": "int8"}, 1800),
+    # serving: continuous batching through the paged-KV engine at
+    # Poisson arrivals / mixed lengths, with the dense-geometry
+    # control run in the SAME process on the same trace — the row
+    # measures the occupancy-proportional decode-read claim
+    # (bench.bench_serve; MHA + GQA rows in one run)
+    ("serve", "serve", {}, 1800),
+    # int8 pages: the quantized-read question again, now on the pool
+    # sweep (same "does XLA fold the widening convert" bet as
+    # decode_int8 — the pair prices it in both cache layouts)
+    ("serve_int8", "serve",
+     {"BENCH_SERVE_CACHE_DTYPE": "int8"}, 1800),
     # recipe accuracy on chip (VERDICT r4 #3): the shipped ResNet
     # CIFAR recipe end to end, ref hyperparams, 20 epochs — real
     # CIFAR-10 if a binary release is under the dataset root (none in

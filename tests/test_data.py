@@ -158,7 +158,11 @@ def test_prefetch_to_device_shards():
     batches = list(prefetch_to_device(loader, mesh))
     assert len(batches) == 2
     assert batches[0].shape == (8, 4)
-    assert str(batches[0].sharding.spec[0]) == "dp"
+    # older jax keeps the 1-tuple axis un-normalized — compare the
+    # normalized axis set, not its repr
+    lead = batches[0].sharding.spec[0]
+    lead = (lead,) if isinstance(lead, str) else tuple(lead)
+    assert lead == ("dp",)
 
 
 def test_prefetch_propagates_errors():
@@ -386,6 +390,39 @@ def test_image_folder_dataset(tmp_path):
     assert int(label) in (0, 1)
     resized = ImageFolder(tmp_path, Split.TRAIN, size=16)
     assert resized[0][0].shape == (16, 16, 3)
+
+
+def test_image_folder_small_class_split_floor(tmp_path):
+    """Small-class guarantee for the implicit 90/5/5 split: a class
+    with >= 3 images puts >= 1 item in EVERY split (int(n*0.95) ==
+    int(n*0.90) up to n=19, which used to hand validation zero items
+    of the class — a constant predictor would then eval 'perfectly'
+    on it); splits stay disjoint and exhaustive."""
+    pytest.importorskip("PIL")
+    from PIL import Image
+
+    from torchbooster_tpu.data.folder import ImageFolder
+
+    sizes = {"tiny": 3, "small": 10, "big": 40}
+    for cls, n in sizes.items():
+        (tmp_path / cls).mkdir()
+        for i in range(n):
+            rs = np.random.RandomState(hash(cls) % 1000 + i)
+            arr = rs.randint(0, 256, (8, 8, 3)).astype(np.uint8)
+            Image.fromarray(arr).save(tmp_path / cls / f"i{i:02d}.png")
+
+    train = ImageFolder(tmp_path, Split.TRAIN)
+    val = ImageFolder(tmp_path, Split.VALIDATION)
+    test = ImageFolder(tmp_path, Split.TEST)
+    n_classes = len(sizes)
+    for ds in (train, val, test):
+        assert {lbl for _, lbl in ds.items} == set(range(n_classes)), (
+            "a class is missing from a split")
+    all_paths = [p for ds in (train, val, test) for p, _ in ds.items]
+    assert len(all_paths) == len(set(all_paths)) == sum(sizes.values())
+    # the 40-image class keeps the plain 90/5/5 cuts (36/2/2)
+    big_idx = sorted(sizes).index("big")
+    assert sum(1 for _, l in train.items if l == big_idx) == 36
 
 
 def test_image_folder_flat_unlabeled_corpus(tmp_path):

@@ -42,8 +42,13 @@ def test_make_mesh_and_batch_sharding():
     assert mesh.shape == {"dp": 2, "tp": 4}
     batch = {"x": np.ones((16, 3), np.float32), "y": np.ones((16,), np.int32)}
     sharded = dist.shard_batch(batch, mesh)
-    # leading axis split over dp only (tp is not a data axis)
-    assert sharded["x"].sharding.spec == P("dp", None)
+    # leading axis split over dp only (tp is not a data axis). Older
+    # jax keeps the spec's 1-tuple axis un-normalized (P(('dp',), ...)
+    # != P('dp', ...)), so compare the normalized axis set
+    lead = sharded["x"].sharding.spec[0]
+    lead = (lead,) if isinstance(lead, str) else tuple(lead)
+    assert lead == ("dp",)
+    assert all(p is None for p in tuple(sharded["x"].sharding.spec)[1:])
     assert sharded["x"].shape == (16, 3)
     np.testing.assert_array_equal(np.asarray(sharded["y"]), batch["y"])
 

@@ -265,6 +265,38 @@ def test_gpt_generate_matches_full_forward_greedy():
     np.testing.assert_array_equal(np.asarray(got), np.asarray(cur))
 
 
+def test_gpt_generate_bf16_cache_decisive_head_parity():
+    """The NON-quantized bf16 cache path pins its numerics the same
+    way the int8 path does (ADVICE r5): on a decisive-head model,
+    bf16-compute cached decode matches the fp32 full-forward re-run
+    token for token. The cached path keeps softmax probs fp32 through
+    masking and casts them to the cache dtype only at the PV einsum
+    (an fp32 PV operand would make XLA materialize an fp32 copy of
+    the whole cache per step — the exact HBM tax decode is roofed
+    on), so this decisive-head parity is the guard that the bf16
+    probs cast cannot drift greedy decode."""
+    from torchbooster_tpu.models.gpt import GPT, GPTConfig
+
+    cfg = GPTConfig(vocab=97, n_layers=2, d_model=32, n_heads=4,
+                    seq_len=24, n_kv_heads=2)
+    params = GPT.init(jax.random.PRNGKey(0), cfg)
+    table = params["wte"]["table"]
+    params = {**params, "wte": {"table": table * 4.0}}
+    ids = jax.random.randint(jax.random.PRNGKey(1), (2, 5), 0,
+                             cfg.vocab)
+
+    got = GPT.generate(params, ids, cfg, n_new=6, temperature=0.0,
+                       compute_dtype=jnp.bfloat16)
+    # reference: full fp32 forward re-run each step (no cache at all)
+    cur = ids
+    for _ in range(6):
+        logits = GPT.apply(params, cur, cfg,
+                           compute_dtype=jnp.float32, remat=False)
+        nxt = jnp.argmax(logits[:, -1], axis=-1).astype(cur.dtype)
+        cur = jnp.concatenate([cur, nxt[:, None]], axis=1)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(cur))
+
+
 def test_gpt_generate_int8_cache():
     """cache_dtype="int8": the quantized KV cache (symmetric
     per-token-head int8 + bf16 scales) decodes valid ids and, on a

@@ -17,7 +17,18 @@ import sys
 import time
 from pathlib import Path
 
+import jax
+import pytest
+
 from torchbooster_tpu.distributed import find_free_port
+
+# this jax's CPU backend has no cross-process collectives (workers die
+# with XlaRuntimeError "Multiprocess computations aren't implemented
+# on the CPU backend"); jax >= 0.8 (which exports jax.shard_map) ships
+# the CPU multiprocess runtime these tests exercise
+pytestmark = pytest.mark.skipif(
+    not hasattr(jax, "shard_map"),
+    reason="no CPU multiprocess collectives on this jaxlib")
 
 WORKER = Path(__file__).parent / "_multihost_worker.py"
 REPO = Path(__file__).parent.parent

@@ -56,7 +56,8 @@ def test_ring_attention_matches_reference(causal):
                                rtol=2e-3, atol=2e-3)
 
 
-@pytest.mark.parametrize("causal", [True, False])
+@pytest.mark.parametrize("causal", [
+    pytest.param(True, marks=pytest.mark.slow), False])
 def test_ring_attention_grads_match_reference(causal):
     """jax.grad through the ring (ppermute + online softmax + causal
     block-skip cond, differentiated by XLA) vs autodiff through
@@ -88,7 +89,9 @@ def test_ring_attention_sp8():
                                rtol=2e-3, atol=2e-3)
 
 
-@pytest.mark.parametrize("causal", [True, False])
+@pytest.mark.parametrize("causal", [
+    pytest.param(True, marks=pytest.mark.slow),
+    pytest.param(False, marks=pytest.mark.slow)])
 def test_ring_attention_blocked_inner_loop(causal):
     """block_k smaller than the local chunk forces the multi-block
     flash-style inner recurrence (incl. the per-block causal column
@@ -131,7 +134,9 @@ def test_ring_flash_matches_reference(causal):
                                rtol=2e-3, atol=2e-3)
 
 
-@pytest.mark.parametrize("causal", [True, False])
+@pytest.mark.parametrize("causal", [
+    pytest.param(True, marks=pytest.mark.slow),
+    pytest.param(False, marks=pytest.mark.slow)])
 def test_ring_flash_grads_match_reference(causal):
     """The ring-flash backward: each chunk's pallas backward consumes
     the GLOBAL (out, lse) and dK/dV accumulators rotate home with
@@ -154,6 +159,7 @@ def test_ring_flash_grads_match_reference(causal):
             err_msg=f"d{name} (causal={causal})")
 
 
+@pytest.mark.slow
 def test_ring_flash_grouped_kv():
     """GQA through ring-flash: grouped K/V circulate the ring at their
     own width and the kernel indexes grouped tiles — fwd + grouped-
@@ -224,7 +230,9 @@ def test_ulysses_attention_matches_reference(causal):
                                rtol=2e-3, atol=2e-3)
 
 
-@pytest.mark.parametrize("causal", [True, False])
+@pytest.mark.parametrize("causal", [
+    pytest.param(True, marks=pytest.mark.slow),
+    pytest.param(False, marks=pytest.mark.slow)])
 def test_ulysses_attention_grads_match_reference(causal):
     from torchbooster_tpu.parallel.ulysses import ulysses_attention
 
@@ -279,8 +287,11 @@ def test_sequence_attention_auto_strategy():
                                rtol=2e-3, atol=2e-3)
 
 
-@pytest.mark.parametrize("c,relu", [(64, True), (256, True), (96, False),
-                                    (32, False)])
+@pytest.mark.parametrize("c,relu", [
+    pytest.param(64, True, marks=pytest.mark.slow),
+    pytest.param(256, True, marks=pytest.mark.slow),
+    pytest.param(96, False, marks=pytest.mark.slow),
+    (32, False)])
 def test_group_norm_pallas_matches_xla(c, relu):
     """Fused pallas GroupNorm (ops/group_norm.py) vs the XLA
     formulation — forward and grads, including the lane-folded layouts
@@ -343,10 +354,11 @@ def test_mse():
 
 
 @pytest.mark.parametrize("causal,s_q,s_kv", [
-    (True, 128, 128),
+    pytest.param(True, 128, 128, marks=pytest.mark.slow),
     (False, 128, 128),
-    (True, 128, 256),   # kv-cache alignment (queries align to last keys)
-    (False, 64, 128),
+    # kv-cache alignment (queries align to last keys)
+    pytest.param(True, 128, 256, marks=pytest.mark.slow),
+    pytest.param(False, 64, 128, marks=pytest.mark.slow),
     (True, 256, 256),   # multi-block accumulation in both bwd sweeps
 ])
 def test_flash_grads_match_reference(causal, s_q, s_kv):
@@ -440,6 +452,7 @@ def test_fused_conv1x1_gn_matches_xla(cin, cout, groups, relu, stride):
             err_msg=f"d{name} ({cin},{cout},g{groups},relu={relu},s{stride})")
 
 
+@pytest.mark.slow
 def test_resnet50_fused_blocks_match_unfused():
     """Whole-model gate: ResNet-50 forward with the fused 1x1+GN path
     equals the plain XLA path (CIFAR stem keeps interpret-mode fast)."""
@@ -455,7 +468,7 @@ def test_resnet50_fused_blocks_match_unfused():
 
 
 @pytest.mark.parametrize("cin,cout,groups,relu,hw", [
-    (32, 64, 32, True, (8, 8)),
+    pytest.param(*(32, 64, 32, True, (8, 8)), marks=pytest.mark.slow),
     (64, 32, 32, False, (7, 9)),   # non-square: column-wrap masking
     (48, 96, 16, True, (6, 6)),    # non-pow2 channels
 ])
@@ -594,6 +607,7 @@ def test_bench_ab_gate_flip_policy(tmp_path, monkeypatch):
         == ({}, "manual(BENCH_GPT_POS=rope)")
 
 
+@pytest.mark.slow
 def test_resnet18_fused_blocks_match_unfused():
     """Basic blocks (ResNet-18) through the fused 3x3+GN path equal the
     plain XLA path."""
@@ -717,7 +731,9 @@ def test_sequence_attention_grouped_fallback():
                                rtol=2e-3, atol=2e-3)
 
 
-@pytest.mark.parametrize("strategy", ["ring", "ulysses"])
+@pytest.mark.parametrize("strategy", [
+    pytest.param("ring", marks=pytest.mark.slow),
+    pytest.param("ulysses", marks=pytest.mark.slow)])
 def test_sequence_attention_grouped_kv_grads(strategy):
     """Grads through the grouped-KV SP paths (repeat inside the
     ring/all-to-all bodies) vs autodiff through the expanded
@@ -901,6 +917,7 @@ def test_ab_summary_renders_unknown_configs(tmp_path):
     assert "decode" in out and "failed attempt" in out
 
 
+@pytest.mark.slow
 def test_bench_cifar_acc_sub_protocol():
     """bench.py --sub cifar_acc drives the shipped ResNet CIFAR recipe
     end to end in a child and emits exactly one JSON line (the watcher

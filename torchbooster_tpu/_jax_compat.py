@@ -1,0 +1,41 @@
+"""Single point of contact with jax API renames.
+
+The code targets the jax >= 0.8 spellings; this image ships an older
+jax. Every version fallback lives HERE — call sites import from this
+module instead of copy-pasting try/excepts (and instead of
+monkeypatching third-party modules, which every other importer would
+see)."""
+from __future__ import annotations
+
+try:
+    from jax import shard_map as _shard_map  # jax >= 0.8
+except ImportError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+try:
+    from jax.experimental.pallas import tpu as _pltpu
+    # jax >= 0.8 spells it CompilerParams; older TPUCompilerParams
+    CompilerParams = getattr(_pltpu, "CompilerParams",
+                             getattr(_pltpu, "TPUCompilerParams", None))
+except ImportError:  # pragma: no cover - pallas-free builds
+    CompilerParams = None
+
+
+def shard_map(f, mesh, in_specs, out_specs, check_vma: bool = True):
+    """``jax.shard_map`` with the replication-check flag under its
+    jax >= 0.8 name (``check_vma``); older jax spells it
+    ``check_rep``. The TypeError fires at wrapper construction, so the
+    fallback costs nothing per call."""
+    try:
+        return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_vma=check_vma)
+    except TypeError as e:  # pragma: no cover - older jax
+        if "check_vma" not in str(e):
+            # an unrelated TypeError (bad specs, wrong arity) must
+            # surface as itself, not as a confusing check_rep retry
+            raise
+        return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_rep=check_vma)
+
+
+__all__ = ["CompilerParams", "shard_map"]

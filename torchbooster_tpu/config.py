@@ -621,6 +621,52 @@ class SchedulerConfig(BaseConfig):
 
 
 @dataclass
+class ServingConfig(BaseConfig):
+    """Serving-engine settings (torchbooster_tpu/serving): the paged
+    KV cache's geometry and the sampling knobs of the continuous-
+    batching decode loop. No reference analogue — the reference has no
+    inference story; this is the serving half of the north star.
+
+    Geometry sizes HBM and the per-step read: the pool holds
+    ``(n_pages - 1) * page_size`` live tokens (page 0 is the reserved
+    null page) and every decode step streams the whole pool once —
+    size ``n_pages`` to expected total occupancy across ``max_slots``
+    concurrent sequences, NOT to the worst case ``max_slots *
+    seq_len`` (that is exactly the dense-cache behavior the pager
+    exists to avoid; docs/performance.md "Serving" has the roofline).
+    """
+
+    page_size: int = 64
+    n_pages: int = 256
+    max_slots: int = 8
+    cache_dtype: str = ""              # "" (compute dtype) | "int8"
+    temperature: float = 0.0           # 0 = greedy
+    top_k: int = 0                     # 0 = off
+    top_p: float = 0.0                 # 0 = off
+
+    def make(self, params: Any, model_cfg: Any,
+             compute_dtype: Any = None) -> Any:
+        """Build the engine + batcher for ``params``/``model_cfg`` (a
+        :class:`~torchbooster_tpu.models.gpt.GPTConfig`). Returns the
+        :class:`~torchbooster_tpu.serving.ContinuousBatcher`; its
+        ``.engine`` exposes admit/step/retire for custom drivers."""
+        import jax.numpy as jnp
+
+        from torchbooster_tpu.serving import ContinuousBatcher, PagedEngine
+
+        engine = PagedEngine(
+            params, model_cfg,
+            page_size=self.page_size, n_pages=self.n_pages,
+            max_slots=self.max_slots,
+            cache_dtype=self.cache_dtype or None,
+            compute_dtype=(jnp.bfloat16 if compute_dtype is None
+                           else compute_dtype),
+            temperature=self.temperature,
+            top_k=self.top_k or None, top_p=self.top_p or None)
+        return ContinuousBatcher(engine)
+
+
+@dataclass
 class DatasetConfig(BaseConfig):
     """Dataset resolution (ref config.py:528-617).
 
@@ -659,6 +705,7 @@ __all__ = [
     "LoaderConfig",
     "OptimizerConfig",
     "SchedulerConfig",
+    "ServingConfig",
     "do_include",
     "parse_sweep",
     "read_lines",

@@ -112,8 +112,18 @@ class ImageFolder(Dataset):
             chosen = []
             for cls_idx in range(len(self.classes)):
                 cls_items = [it for it in items if it[1] == cls_idx]
-                cut1 = int(len(cls_items) * 0.90)
-                cut2 = int(len(cls_items) * 0.95)
+                n = len(cls_items)
+                cut1 = int(n * 0.90)
+                cut2 = int(n * 0.95)
+                if n >= 3:
+                    # small-class floor: int(n*0.95) == int(n*0.90) up
+                    # to n=19, which would hand validation ZERO items
+                    # of the class (and 90% rounding can starve test
+                    # too) — guarantee >= 1 val and >= 1 test item
+                    # whenever the class has >= 3 images, shrinking
+                    # train (which keeps >= 1 by construction)
+                    cut2 = min(max(cut2, cut1 + 1), n - 1)
+                    cut1 = min(cut1, cut2 - 1)
                 chosen.extend({Split.TRAIN: cls_items[:cut1],
                                Split.VALIDATION: cls_items[cut1:cut2],
                                Split.TEST: cls_items[cut2:]}[split])

@@ -349,13 +349,20 @@ def _check_pos(params: dict, cfg: GPTConfig) -> None:
 def _rope(x: jax.Array, positions: jax.Array,
           base: float = 10_000.0) -> jax.Array:
     """Rotary position embedding (rotate-half form) over (B, S, H, D);
-    ``positions`` is (S,) absolute indices. Angles in fp32 — bf16
-    position·frequency products alias at long context."""
+    ``positions`` is (S,) absolute indices shared across the batch, or
+    (B, S) per-example indices (continuous batching: every serving
+    slot decodes at its OWN depth, so one shared index would rotate
+    most slots wrong). Angles in fp32 — bf16 position·frequency
+    products alias at long context."""
     half = x.shape[-1] // 2
     freqs = base ** (-jnp.arange(half, dtype=jnp.float32) / half)
-    angles = positions.astype(jnp.float32)[:, None] * freqs   # (S, half)
-    cos = jnp.cos(angles)[None, :, None, :]
-    sin = jnp.sin(angles)[None, :, None, :]
+    angles = positions.astype(jnp.float32)[..., None] * freqs
+    if positions.ndim == 1:        # (S, half): broadcast over batch
+        cos = jnp.cos(angles)[None, :, None, :]
+        sin = jnp.sin(angles)[None, :, None, :]
+    else:                          # (B, S, half): per-slot positions
+        cos = jnp.cos(angles)[:, :, None, :]
+        sin = jnp.sin(angles)[:, :, None, :]
     x1 = x[..., :half].astype(jnp.float32)
     x2 = x[..., half:].astype(jnp.float32)
     return jnp.concatenate(
@@ -767,6 +774,80 @@ def _quantize_kv(x: jax.Array) -> tuple[jax.Array, jax.Array]:
     return q, scale
 
 
+def _grouped_cache_attention(q: jax.Array, cache_k, cache_v,
+                             visible: jax.Array, *,
+                             state: bool = False):
+    """THE cached-attention numerics core, shared by the dense decode
+    path (``_cached_block`` → ``jit_generate``, the A/B control) and
+    the paged serving engine (serving/engine.py) so the two cannot
+    drift. q is (B, S_q, H, Dh); caches are (B, T, H_kv, Dh) token
+    axes — either plain arrays (bf16/fp32) or ``(int8 values, bf16
+    scales)`` pairs; ``visible`` broadcasts against the (B, g, rep,
+    S_q, T) score tensor (False → masked).
+
+    The cache stores only kv_heads (the GQA memory win) and is read
+    GROUPED: q folds to (B, S, groups, rep, D) and the einsums
+    contract against the grouped cache directly — the decode hot loop
+    never materializes the rep-times expansion (its HBM reads dominate
+    each step).
+
+    Operands stay in cache dtype with fp32 ACCUMULATION: an explicit
+    fp32 astype here makes XLA either materialize an fp32 copy of the
+    whole cache per step (2× the HBM traffic decode is roofed on) or
+    run the MXU in fp32 mode — narrow inputs +
+    preferred_element_type=f32 is the native MXU contract (softmax
+    itself stays fp32). For the int8 cache the per-token scales FACTOR
+    OUT of the dots: scores scale by s_k[token] after the QK dot, and
+    s_v folds into the (small) probs tensor before the PV dot. The
+    int8→dot-dtype convert is written to fuse into the dot's operand
+    read (keeping the HBM stream at 1 byte/elem); whether XLA actually
+    folds it — vs materializing a widened copy — is exactly what the
+    queued decode_int8 A/B row measures. Dot precision follows the
+    caller's compute dtype (q.dtype), so fp32 callers keep fp32 dots
+    over the dequantized values.
+
+    ``state=False`` returns the normalized (B, S_q, H, Dh) output.
+    ``state=True`` returns the flash-style partial-softmax triple
+    ``(o_unnorm fp32 (B, S_q, g, rep, Dh), m (B, g, rep, S_q),
+    l (B, g, rep, S_q))`` — the paged engine computes one such triple
+    per page and combines across each slot's pages with the standard
+    online-softmax merge, which is exactly how the same math spreads
+    over a token axis that is not contiguous in memory."""
+    b, s_q, n_heads, head_dim = q.shape
+    quantized = isinstance(cache_k, tuple)
+    if quantized:
+        ck, ck_s = cache_k
+        cv, cv_s = cache_v
+    else:
+        ck, cv = cache_k, cache_v
+    kv_heads = ck.shape[2]
+    rep = n_heads // kv_heads
+    qg = q.reshape(b, s_q, kv_heads, rep, head_dim)
+    dot_t = q.dtype if quantized else ck.dtype
+    scores = jnp.einsum(
+        "bqgrd,bkgd->bgrqk", qg.astype(dot_t), ck.astype(dot_t),
+        preferred_element_type=jnp.float32) / (head_dim ** 0.5)
+    if quantized:
+        scores = scores * jnp.transpose(
+            ck_s[..., 0], (0, 2, 1))[:, :, None, None, :]
+    scores = jnp.where(visible, scores, -1e30)
+    if state:
+        m = jnp.max(scores, axis=-1)                  # (B, g, rep, S_q)
+        probs = jnp.exp(scores - m[..., None])
+        l = jnp.sum(probs, axis=-1)
+    else:
+        probs = jax.nn.softmax(scores, axis=-1)
+    if quantized:
+        probs = probs * jnp.transpose(
+            cv_s[..., 0], (0, 2, 1))[:, :, None, None, :]
+    o = jnp.einsum("bgrqk,bkgd->bqgrd", probs.astype(dot_t),
+                   cv.astype(dot_t),
+                   preferred_element_type=jnp.float32)
+    if state:
+        return o, m, l
+    return o.astype(q.dtype).reshape(b, s_q, n_heads, head_dim)
+
+
 def _cached_block(bp: dict, x: jax.Array, cache_k, cache_v,
                   pos: jax.Array, cfg: GPTConfig
                   ) -> tuple[jax.Array, Any, Any]:
@@ -778,17 +859,10 @@ def _cached_block(bp: dict, x: jax.Array, cache_k, cache_v,
     written at ``pos``. MoE capacity floors at n_experts so a decode
     micro-batch never drops tokens (full-sequence drop behavior cannot
     be replicated incrementally anyway)."""
-    head_dim = cfg.d_model // cfg.n_heads
     quantized = isinstance(cache_k, tuple)
     s_cache = (cache_k[0] if quantized else cache_k).shape[1]
 
     def attend(q, k, v):
-        # the cache stores only kv_heads (the GQA memory win) and is
-        # read GROUPED: q folds to (B, S, groups, rep, D) and the
-        # einsums contract against the grouped cache directly — the
-        # decode hot loop never materializes the rep-times expansion
-        # (its HBM reads dominate each step)
-        b, s_q, n_heads, _ = q.shape
         if quantized:
             (ck, ck_s), (cv, cv_s) = cache_k, cache_v
             k_q, k_s = _quantize_kv(k)
@@ -806,41 +880,9 @@ def _cached_block(bp: dict, x: jax.Array, cache_k, cache_v,
             cv = jax.lax.dynamic_update_slice(
                 cache_v, v.astype(cache_v.dtype), (0, pos, 0, 0))
             new_k, new_v = ck, cv
-        kv_heads = ck.shape[2]
-        rep = n_heads // kv_heads
-        qg = q.reshape(b, s_q, kv_heads, rep, head_dim)
-        # operands stay in cache dtype with fp32 ACCUMULATION: an
-        # explicit fp32 astype here makes XLA either materialize an
-        # fp32 copy of the whole cache per step (2× the HBM traffic
-        # decode is roofed on) or run the MXU in fp32 mode — narrow
-        # inputs + preferred_element_type=f32 is the native MXU
-        # contract (softmax itself stays fp32). For the int8 cache the
-        # per-token scales FACTOR OUT of the dots: scores scale by
-        # s_k[token] after the QK dot, and s_v folds into the (small)
-        # probs tensor before the PV dot. The int8→dot-dtype convert is
-        # written to fuse into the dot's operand read (keeping the HBM
-        # stream at 1 byte/elem); whether XLA actually folds it — vs
-        # materializing a widened copy — is exactly what the queued
-        # decode_int8 A/B row measures. Dot precision follows the
-        # caller's compute dtype (q.dtype), so fp32 callers keep fp32
-        # dots over the dequantized values.
-        dot_t = q.dtype if quantized else ck.dtype
-        scores = jnp.einsum(
-            "bqgrd,bkgd->bgrqk", qg.astype(dot_t), ck.astype(dot_t),
-            preferred_element_type=jnp.float32) / (head_dim ** 0.5)
-        if quantized:
-            scores = scores * jnp.transpose(
-                ck_s[..., 0], (0, 2, 1))[:, :, None, None, :]
         visible = jnp.arange(s_cache)[None, None, None, None, :] <= pos
-        scores = jnp.where(visible, scores, -1e30)
-        probs = jax.nn.softmax(scores, axis=-1)
-        if quantized:
-            probs = probs * jnp.transpose(
-                cv_s[..., 0], (0, 2, 1))[:, :, None, None, :]
-        o = jnp.einsum("bgrqk,bkgd->bqgrd", probs.astype(dot_t),
-                       cv.astype(dot_t),
-                       preferred_element_type=jnp.float32).astype(q.dtype)
-        return o.reshape(b, s_q, n_heads, head_dim), (new_k, new_v)
+        o = _grouped_cache_attention(q, new_k, new_v, visible)
+        return o, (new_k, new_v)
 
     x, _, (cache_k, cache_v) = _block_core(
         bp, x, cfg, attend,
@@ -854,6 +896,71 @@ def _lm_head(params: dict, x: jax.Array) -> jax.Array:
     if "head" in params:
         return L.dense(params["head"], x)
     return x @ params["wte"]["table"].astype(x.dtype).T
+
+
+def _make_pick(temperature: float, top_k: int | None,
+               top_p: float | None, dtype: Any):
+    """``pick(rng_step, logits) -> ids`` — the next-token rule, shared
+    by :func:`generate`'s decode scan and the serving engine's paged
+    step (serving/engine.py) so filtering semantics cannot drift.
+    Greedy at ``temperature=0``; otherwise categorical over the
+    temperature-scaled logits with optional top-k and/or top-p
+    (nucleus) filtering — top_p keeps the smallest set of tokens whose
+    probability mass reaches p (always at least the top token)."""
+
+    def pick(rng_step: jax.Array, logits: jax.Array) -> jax.Array:
+        if temperature == 0:
+            return jnp.argmax(logits, axis=-1).astype(dtype)
+        logits = logits.astype(jnp.float32) / temperature
+        if top_k is not None or top_p is not None:
+            # ONE descending sort serves both filters (this runs per
+            # token inside the decode scan)
+            desc = jnp.sort(logits, axis=-1)[:, ::-1]
+            if top_k is not None:
+                logits = jnp.where(logits < desc[:, top_k - 1][:, None],
+                                   -jnp.inf, logits)
+                desc = jnp.where(
+                    jnp.arange(desc.shape[-1])[None] < top_k,
+                    desc, -jnp.inf)
+            if top_p is not None:
+                probs = jax.nn.softmax(desc, axis=-1)
+                # keep while the mass BEFORE a token is < p (top-1
+                # always in)
+                keep = jnp.cumsum(probs, axis=-1) - probs < top_p
+                thresh = jnp.min(jnp.where(keep, desc, jnp.inf),
+                                 axis=-1, keepdims=True)
+                logits = jnp.where(logits >= thresh, logits, -jnp.inf)
+        return jax.random.categorical(rng_step, logits).astype(dtype)
+
+    return pick
+
+
+def _prefill_forward(params: dict, ids: jax.Array, cfg: GPTConfig,
+                     compute_dtype: Any
+                     ) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Full prompt forward with per-layer K/V collected as scan
+    outputs — the prefill half of every decode flavor (dense
+    :func:`generate` and the paged serving engine admit requests
+    through this same pass). Returns ``(x, ks, vs)`` with x the final
+    hidden states (B, S, d) and ks/vs the GROUPED caches
+    (L, B, S, kv_heads, Dh)."""
+    s0 = ids.shape[1]
+    x = L.embedding(params["wte"], ids, dtype=compute_dtype)
+    if "wpe" in params:
+        x = x + L.embedding(params["wpe"], jnp.arange(s0),
+                            dtype=compute_dtype)
+
+    def prefill_block(x, bp):
+        def attend(q, k, v):
+            # cache keeps the grouped kv_heads; the dispatcher handles
+            # grouped widths natively
+            return attention(q, k, v, causal=True), (k, v)
+
+        x, _, kv = _block_core(bp, x, cfg, attend)
+        return x, kv
+
+    x, (ks, vs) = jax.lax.scan(prefill_block, x, params["blocks"])
+    return x, ks, vs
 
 
 def generate(params: dict, ids: jax.Array,
@@ -908,21 +1015,7 @@ def generate(params: dict, ids: jax.Array,
     _check_pos(params, cfg)
 
     # --- prefill: full prompt forward, K/V collected per layer ---
-    x = L.embedding(params["wte"], ids, dtype=compute_dtype)
-    if "wpe" in params:
-        x = x + L.embedding(params["wpe"], jnp.arange(s0),
-                            dtype=compute_dtype)
-
-    def prefill_block(x, bp):
-        def attend(q, k, v):
-            # cache keeps the grouped kv_heads; the dispatcher handles
-            # grouped widths natively
-            return attention(q, k, v, causal=True), (k, v)
-
-        x, _, kv = _block_core(bp, x, cfg, attend)
-        return x, kv
-
-    x, (ks, vs) = jax.lax.scan(prefill_block, x, params["blocks"])
+    x, ks, vs = _prefill_forward(params, ids, cfg, compute_dtype)
     pad = ((0, 0), (0, 0), (0, n_new), (0, 0), (0, 0))
     if cache_dtype in ("int8", jnp.int8):
         kq, ks_sc = _quantize_kv(ks)
@@ -935,30 +1028,7 @@ def generate(params: dict, ids: jax.Array,
 
     first_logits = _lm_head(params, x[:, -1:, :])[:, 0]    # (B, vocab)
 
-    def pick(rng_step: jax.Array, logits: jax.Array) -> jax.Array:
-        if temperature == 0:
-            return jnp.argmax(logits, axis=-1).astype(ids.dtype)
-        logits = logits.astype(jnp.float32) / temperature
-        if top_k is not None or top_p is not None:
-            # ONE descending sort serves both filters (this runs per
-            # token inside the decode scan)
-            desc = jnp.sort(logits, axis=-1)[:, ::-1]
-            if top_k is not None:
-                logits = jnp.where(logits < desc[:, top_k - 1][:, None],
-                                   -jnp.inf, logits)
-                desc = jnp.where(
-                    jnp.arange(desc.shape[-1])[None] < top_k,
-                    desc, -jnp.inf)
-            if top_p is not None:
-                probs = jax.nn.softmax(desc, axis=-1)
-                # keep while the mass BEFORE a token is < p (top-1
-                # always in)
-                keep = jnp.cumsum(probs, axis=-1) - probs < top_p
-                thresh = jnp.min(jnp.where(keep, desc, jnp.inf),
-                                 axis=-1, keepdims=True)
-                logits = jnp.where(logits >= thresh, logits, -jnp.inf)
-        return jax.random.categorical(rng_step, logits).astype(ids.dtype)
-
+    pick = _make_pick(temperature, top_k, top_p, ids.dtype)
     rng = jax.random.PRNGKey(0) if rng is None else rng
 
     def step(carry, _):

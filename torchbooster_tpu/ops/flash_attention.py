@@ -47,6 +47,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from torchbooster_tpu._jax_compat import CompilerParams as _CompilerParams
+
 NEG_INF = -1e30
 # Per-row residual (lse) lane padding. Mosaic requires a block's minor
 # dim be a multiple of 128 OR equal to the full array dim — so a (bh,
@@ -192,7 +194,7 @@ def _fwd_pallas(q, k, v, *, causal, sm_scale, block_q, block_k, interpret,
             pltpu.VMEM((block_q, 1), jnp.float32),       # running sum
             pltpu.VMEM((block_q, head_dim), jnp.float32),  # accumulator
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(q, k, v)
@@ -325,7 +327,7 @@ def _bwd_pallas(q, k, v, out, lse, do, *, causal, sm_scale, block_q,
         out_shape=jax.ShapeDtypeStruct((bh, seq_q, head_dim), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, head_dim), jnp.float32),
                         pltpu.VMEM((block_q, 1), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(q, k, v, out, do, lse)
@@ -358,7 +360,7 @@ def _bwd_pallas(q, k, v, out, lse, do, *, causal, sm_scale, block_q,
         ],
         scratch_shapes=[pltpu.VMEM((block_k, head_dim), jnp.float32),
                         pltpu.VMEM((block_k, head_dim), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(q, k, v, out, do, lse)

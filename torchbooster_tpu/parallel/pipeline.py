@@ -55,10 +55,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
-try:
-    from jax import shard_map  # jax >= 0.8
-except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map
+from torchbooster_tpu._jax_compat import shard_map
 
 
 def _default_microbatches(batch: int, n_stages: int,
@@ -251,14 +248,9 @@ def pipeline_apply(
         return out
 
     out_specs = (mb_spec, P()) if with_aux else mb_spec
-    try:        # jax >= 0.8 spells the replication-check flag check_vma
-        mapped = shard_map(kernel, mesh=mesh,
-                           in_specs=(param_specs, mb_spec),
-                           out_specs=out_specs, check_vma=False)
-    except TypeError:  # pragma: no cover - older jax
-        mapped = shard_map(kernel, mesh=mesh,
-                           in_specs=(param_specs, mb_spec),
-                           out_specs=out_specs, check_rep=False)
+    mapped = shard_map(kernel, mesh=mesh,
+                       in_specs=(param_specs, mb_spec),
+                       out_specs=out_specs, check_vma=False)
     if with_aux:
         out_mb, aux = mapped(stacked_params, x_mb)
         return out_mb.reshape(batch, *x.shape[1:]), aux

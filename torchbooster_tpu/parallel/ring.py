@@ -23,7 +23,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
+
+from torchbooster_tpu._jax_compat import shard_map
 
 NEG_INF = -1e30
 

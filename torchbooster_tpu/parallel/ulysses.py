@@ -42,7 +42,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+
+from torchbooster_tpu._jax_compat import shard_map
 
 
 def _ulysses_local(q: jax.Array, k: jax.Array, v: jax.Array, *, axis: str,

@@ -1,0 +1,30 @@
+"""Serving subsystem: continuous batching over a paged KV cache.
+
+The north star serves heavy traffic; training-side throughput was
+already measured and tuned (docs/performance.md), and the decode
+roofline says the step time IS the cache bytes it streams. This
+package stops streaming dead bytes:
+
+- :mod:`kv_pages` — the fixed page pool + host-side block tables
+  (alloc/free without recompiles);
+- :mod:`engine` — prefill/decode split; ONE compiled decode step whose
+  signature depends only on pool geometry, with attention reading the
+  pool once per step (length-masked pages, online-softmax combine);
+- :mod:`batcher` — FCFS admission, preemption under pool pressure,
+  latency/tokens-per-second metrics.
+
+Entry points: build a :class:`~torchbooster_tpu.serving.engine.
+PagedEngine` (or via ``ServingConfig.make`` from YAML), wrap it in a
+:class:`~torchbooster_tpu.serving.batcher.ContinuousBatcher`, and feed
+it :class:`~torchbooster_tpu.serving.batcher.Request`s.
+"""
+from torchbooster_tpu.serving.batcher import ContinuousBatcher, Request
+from torchbooster_tpu.serving.engine import PagedEngine
+from torchbooster_tpu.serving.kv_pages import (
+    BlockTables,
+    NULL_PAGE,
+    make_pool,
+)
+
+__all__ = ["BlockTables", "ContinuousBatcher", "NULL_PAGE",
+           "PagedEngine", "Request", "make_pool"]

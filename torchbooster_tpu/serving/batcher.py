@@ -1,0 +1,189 @@
+"""Host-side continuous batching: FCFS admission over the paged engine.
+
+The reference framework has no serving story at all (DDP training
+only); this is the front door of the serving subsystem. Requests queue
+FCFS; whenever a slot AND enough pages are free, the next ARRIVED
+request prefills into a slot; every loop iteration runs one compiled
+decode step over all live slots; sequences retire on EOS, on their
+``max_new_tokens``, or at the ``seq_len`` cache horizon — all without
+touching the compiled step (kv_pages.py fixed-shape tables).
+
+Pool pressure is handled by PREEMPTION, not failure: when a growing
+sequence cannot get its next page, the youngest live request is pushed
+back to the FRONT of the queue with its generated tokens folded into
+its prompt (it re-prefills later and keeps going); requests too big
+for the whole pool fail loudly at submit.
+
+Metrics mirror the training A/B machinery's spirit — every number a
+JSON-serializable scalar so serving rows land in the same logs:
+per-request latency (arrival → completion) and time-to-first-token,
+plus aggregate decode tokens/s over the busy window.
+"""
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from torchbooster_tpu.serving.engine import PagedEngine
+
+
+@dataclass
+class Request:
+    """One generation request. ``arrival`` is an offset (seconds) from
+    the batcher's clock start — 0 means "already waiting"; the bench's
+    Poisson trace sets real offsets. ``eos_id=None`` never stops early."""
+    prompt: np.ndarray
+    max_new_tokens: int = 32
+    eos_id: int | None = None
+    arrival: float = 0.0
+    # filled by the batcher
+    tokens: list = field(default_factory=list)
+    admitted_at: float | None = None
+    first_token_at: float | None = None
+    finished_at: float | None = None
+
+    def __post_init__(self):
+        self.prompt = np.asarray(self.prompt, np.int32).reshape(-1)
+        if self.prompt.size == 0:
+            raise ValueError("empty prompt")
+        if self.max_new_tokens < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {self.max_new_tokens}")
+        # the ORIGINAL prompt length: preemption folds generated tokens
+        # into ``prompt`` for the re-prefill, so the true context length
+        # is base_len + len(tokens) — counting from the grown prompt
+        # would double-count and truncate the request at the horizon
+        self.base_len = int(self.prompt.size)
+
+
+class ContinuousBatcher:
+    """FCFS admission queue driving a :class:`PagedEngine`.
+
+    ``run(requests)`` processes the whole trace and returns a metrics
+    dict; finished requests carry their generated ``tokens`` and
+    timing fields. ``clock`` is injectable for deterministic tests —
+    it MUST advance on its own (the batcher real-sleeps up to 50 ms
+    while idle before an arrival; a frozen clock with a future arrival
+    would wait forever)."""
+
+    def __init__(self, engine: PagedEngine, clock=time.perf_counter):
+        self.engine = engine
+        self.clock = clock
+        # usable pool capacity in tokens (page 0 is the reserved null)
+        self._capacity = (engine.n_pages - 1) * engine.page_size
+
+    def _check_fits(self, req: Request) -> None:
+        worst = req.base_len + req.max_new_tokens
+        if worst > self.engine.cfg.seq_len:
+            raise ValueError(
+                f"prompt ({req.base_len}) + max_new_tokens "
+                f"({req.max_new_tokens}) exceeds cfg.seq_len "
+                f"({self.engine.cfg.seq_len})")
+        if self.engine.tables.pages_for(worst) > \
+                (self.engine.n_pages - 1):
+            raise ValueError(
+                f"request needs {worst} tokens of pages but the pool "
+                f"holds {self._capacity}; grow serving.n_pages")
+
+    def run(self, requests: list[Request]) -> dict:
+        if not requests:
+            return {"n_requests": 0, "new_tokens": 0, "elapsed_s": 0.0,
+                    "decode_tok_s": 0.0, "total_tok_s": 0.0,
+                    "latency_mean_s": 0.0, "latency_p95_s": 0.0,
+                    "ttft_mean_s": 0.0}
+        for r in requests:
+            self._check_fits(r)
+        queue = sorted(requests, key=lambda r: r.arrival)
+        slots: dict[int, Request] = {}
+        admit_order: list[int] = []          # oldest-first live slots
+        t0 = self.clock()
+        now = lambda: self.clock() - t0
+        decoded = 0
+        decode_time = 0.0
+
+        def finish(slot: int) -> None:
+            req = slots.pop(slot)
+            admit_order.remove(slot)
+            req.finished_at = now()
+            self.engine.retire(slot)
+
+        def maybe_stop(slot: int, token: int) -> None:
+            req = slots[slot]
+            req.tokens.append(int(token))
+            if req.first_token_at is None:
+                req.first_token_at = now()
+            hit_eos = req.eos_id is not None and token == req.eos_id
+            full = (req.base_len + len(req.tokens)
+                    >= self.engine.cfg.seq_len)
+            if hit_eos or len(req.tokens) >= req.max_new_tokens or full:
+                finish(slot)
+
+        while queue or slots:
+            # --- admit every ARRIVED request that fits, FCFS ---
+            while queue and queue[0].arrival <= now():
+                req = queue[0]
+                seated = self.engine.admit(req.prompt)
+                if seated is None:
+                    break                     # no slot/pages: keep FCFS
+                queue.pop(0)
+                slot, first = seated
+                slots[slot] = req
+                admit_order.append(slot)
+                if req.admitted_at is None:
+                    req.admitted_at = now()
+                maybe_stop(slot, first)       # prefill's token is #1
+            if not slots:
+                if queue:                     # idle until next arrival
+                    wait = queue[0].arrival - now()
+                    if wait > 0:
+                        time.sleep(min(wait, 0.05))
+                continue
+            # --- grow: every live slot's next write page must exist;
+            # starved slots preempt the YOUNGEST live request ---
+            starved = self.engine.grow_slots()
+            while starved:
+                victim = admit_order[-1]
+                req = slots.pop(victim)
+                admit_order.remove(victim)
+                self.engine.retire(victim)
+                # fold generated tokens into the prompt so it resumes
+                # from its full context on re-admission — only the
+                # NOT-yet-folded suffix: a second preemption would
+                # otherwise re-append tokens already in the prompt,
+                # duplicating context (prompt always holds base_len +
+                # folded tokens, so the folded count is its excess)
+                folded = len(req.prompt) - req.base_len
+                req.prompt = np.concatenate(
+                    [req.prompt,
+                     np.asarray(req.tokens[folded:], np.int32)])
+                queue.insert(0, req)
+                starved = self.engine.grow_slots() if slots else []
+            if not slots:
+                continue
+            # --- one compiled step over every slot ---
+            t_step = self.clock()
+            tokens = self.engine.step()
+            decode_time += self.clock() - t_step
+            decoded += len(slots)
+            for slot in list(slots):
+                maybe_stop(slot, int(tokens[slot]))
+
+        elapsed = now()
+        lat = [r.finished_at - r.arrival for r in requests]
+        ttft = [r.first_token_at - r.arrival for r in requests]
+        new_tokens = sum(len(r.tokens) for r in requests)
+        return {
+            "n_requests": len(requests),
+            "new_tokens": new_tokens,
+            "elapsed_s": round(elapsed, 4),
+            "decode_tok_s": round(decoded / max(decode_time, 1e-9), 1),
+            "total_tok_s": round(new_tokens / max(elapsed, 1e-9), 1),
+            "latency_mean_s": round(float(np.mean(lat)), 4),
+            "latency_p95_s": round(float(np.percentile(lat, 95)), 4),
+            "ttft_mean_s": round(float(np.mean(ttft)), 4),
+        }
+
+
+__all__ = ["ContinuousBatcher", "Request"]

@@ -1,0 +1,338 @@
+"""Continuous-batching decode engine over the paged KV cache.
+
+Prefill/decode split:
+
+- **prefill** runs once per admitted request through the SAME
+  block path training uses (``models/gpt.py _prefill_forward`` —
+  ``_block_core`` + the attention dispatcher), produces the request's
+  first token, and scatters its K/V into the pages the block table
+  assigned;
+- **decode** is ONE jitted step over all ``max_slots`` slots: embed
+  each slot's last token at its own depth, write this step's K/V into
+  each slot's current page, then attend by sweeping the page pool
+  once — every page computes a flash-style partial softmax of its
+  ``page_size`` tokens against its OWNING slot's query
+  (``_grouped_cache_attention(state=True)``, the same numerics core
+  the dense ``jit_generate`` control runs), and per-slot results
+  combine across pages with the online-softmax merge
+  (``segment_max``/``segment_sum`` keyed by page owner).
+
+Why the pool sweep is the length-aware read: the dense decode step
+streams ``max_slots × S_cache`` cache rows regardless of how many
+tokens each slot holds; the sweep streams ``(n_pages - 1) ×
+page_size`` rows — the pool's USABLE capacity (the reserved null page
+is statically sliced out of the read), which the operator sizes to
+expected total occupancy — and free/partial pages contribute nothing
+but masked lanes. On an HBM-bound loop the read bytes ARE the step time, so
+tokens/s scales with pool-vs-dense bytes (the ``serve`` bench rows
+measure exactly this ratio; a dense-geometry control —
+``page_size=seq_len``, one page per slot — runs the SAME code at dense
+bytes).
+
+The compiled step's signature depends only on pool geometry
+``(n_pages, page_size, max_slots)`` and the model config — admission
+and retirement change VALUES in fixed-shape tables (kv_pages.py), so
+slot churn after warmup causes ZERO recompiles (asserted in
+tests/test_serving.py via the jit cache size). Prefill pads prompts
+to whole pages and reads the last real token's logits at a traced
+offset, so it compiles once per page COUNT — at most
+``seq_len / page_size`` executables, whatever lengths arrive.
+"""
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from torchbooster_tpu.models import layers as L
+from torchbooster_tpu.models.gpt import (
+    GPTConfig,
+    _block_core,
+    _check_pos,
+    _grouped_cache_attention,
+    _lm_head,
+    _make_pick,
+    _prefill_forward,
+    _quantize_kv,
+)
+from torchbooster_tpu.serving.kv_pages import BlockTables, make_pool
+
+
+class PagedEngine:
+    """Single-compile continuous-batching decode over a paged KV pool.
+
+    ``admit``/``step``/``retire`` are the whole lifecycle; the
+    host-side batcher (serving/batcher.py) drives them. ``cache_dtype
+    ="int8"`` stores quantized pages (``_quantize_kv`` — the same
+    per-(token, head) scheme as the dense cache). ``temperature=0``
+    decodes greedily; otherwise sampling follows ``_make_pick`` (the
+    same filtering the dense path uses).
+
+    ``dense_control=True`` is the A/B geometry: one ``seq_len``-wide
+    page per slot, so the identical compiled step streams the dense
+    cache's bytes — the control row for the occupancy-proportional
+    serving claim.
+    """
+
+    def __init__(self, params: dict, cfg: GPTConfig, *,
+                 page_size: int = 64, n_pages: int = 128,
+                 max_slots: int = 8, cache_dtype: Any = None,
+                 compute_dtype: Any = jnp.bfloat16,
+                 temperature: float = 0.0, top_k: int | None = None,
+                 top_p: float | None = None,
+                 rng: jax.Array | None = None):
+        if cfg.seq_len % page_size:
+            # a last partial page per slot would shift page_pos math;
+            # geometry is static, so fail loudly at construction
+            raise ValueError(
+                f"page_size ({page_size}) must divide cfg.seq_len "
+                f"({cfg.seq_len})")
+        # same params/config positional-encoding guard the dense
+        # generate() applies — a rope checkpoint served with
+        # pos="learned" (or vice versa) must fail here, not decode
+        # garbage quietly
+        _check_pos(params, cfg)
+        self.params = params
+        self.cfg = cfg
+        self.page_size = page_size
+        self.n_pages = n_pages
+        self.max_slots = max_slots
+        self.compute_dtype = compute_dtype
+        self.quantized = cache_dtype in ("int8", jnp.int8)
+        if not self.quantized and cache_dtype is not None:
+            raise ValueError(
+                f"cache_dtype must be None or 'int8', got {cache_dtype!r}")
+        self.tables = BlockTables(cfg, page_size, n_pages, max_slots)
+        self.pool = make_pool(cfg, page_size, n_pages,
+                              cache_dtype=cache_dtype,
+                              compute_dtype=compute_dtype)
+        self._pick = _make_pick(temperature, top_k, top_p, jnp.int32)
+        self._rng = jax.random.PRNGKey(0) if rng is None else rng
+        self._prefill_jit = jax.jit(self._prefill_fn)
+        # the pool crosses the jit boundary EVERY step — donate it so
+        # XLA updates the pages in place; an undonated pool would copy
+        # pool-sized bytes per step, re-taxing exactly the HBM traffic
+        # the pager removes (CPU backends ignore donation — harmless)
+        self._write_jit = jax.jit(self._write_fn, donate_argnums=(0, 1))
+        self._decode_jit = jax.jit(self._decode_fn,
+                                   donate_argnums=(1, 2))
+
+    @classmethod
+    def dense_control(cls, params: dict, cfg: GPTConfig, *,
+                      max_slots: int = 8, **kw) -> "PagedEngine":
+        """The dense-bytes A/B control: identical engine, one
+        ``seq_len``-wide page per slot (+ the null page), so each step
+        streams exactly what the dense per-slot cache would."""
+        return cls(params, cfg, page_size=cfg.seq_len,
+                   n_pages=max_slots + 1, max_slots=max_slots, **kw)
+
+    # ---- compiled pieces -----------------------------------------
+    def _prefill_fn(self, params, ids, s0, rng):
+        """Prompt forward over PAGE-ALIGNED ids (right-padded to a
+        whole page count; ``s0`` is the real length). Causal attention
+        makes right-padding a no-op for the first s0 tokens' K/V and
+        logits, so prefill compiles once per page COUNT — a bounded
+        set — instead of once per raw prompt length (preemption
+        re-prefills at arbitrary lengths; per-length compiles would
+        land in measured request latency). Pad-token K/V is written to
+        the pages but sits at positions >= lengths and the sweep's
+        mask never reads it."""
+        x, ks, vs = _prefill_forward(params, ids, self.cfg,
+                                     self.compute_dtype)
+        last = jax.lax.dynamic_slice_in_dim(x, s0 - 1, 1, axis=1)
+        logits = _lm_head(params, last)[:, 0]
+        return self._pick(rng, logits), ks, vs
+
+    def _write_fn(self, pool_k, pool_v, ks, vs, page_ids):
+        """Scatter a request's prefill K/V (L, 1, s0, g, Dh) into its
+        ``page_ids`` — padded to whole pages; the pad tokens sit at
+        positions >= length and the sweep's mask never reads them."""
+        n_layers, _, s0, g, d = ks.shape
+        n_p = page_ids.shape[0]
+        pad = ((0, 0), (0, n_p * self.page_size - s0), (0, 0), (0, 0))
+        kp = jnp.pad(ks[:, 0], pad).reshape(
+            n_layers, n_p, self.page_size, g, d)
+        vp = jnp.pad(vs[:, 0], pad).reshape(
+            n_layers, n_p, self.page_size, g, d)
+        if self.quantized:
+            kq, k_s = _quantize_kv(kp)
+            vq, v_s = _quantize_kv(vp)
+            pool_k = (pool_k[0].at[:, page_ids].set(kq),
+                      pool_k[1].at[:, page_ids].set(k_s))
+            pool_v = (pool_v[0].at[:, page_ids].set(vq),
+                      pool_v[1].at[:, page_ids].set(v_s))
+        else:
+            pool_k = pool_k.at[:, page_ids].set(
+                kp.astype(pool_k.dtype))
+            pool_v = pool_v.at[:, page_ids].set(
+                vp.astype(pool_v.dtype))
+        return pool_k, pool_v
+
+    def _decode_fn(self, params, pool_k, pool_v, tables, lengths,
+                   owner, page_pos, active, last_ids, rng):
+        """One decode step over all slots. Signature shapes depend
+        only on pool geometry — never on which slots are live."""
+        cfg, ps = self.cfg, self.page_size
+        n_slots = last_ids.shape[0]
+
+        x = L.embedding(params["wte"], last_ids[:, None],
+                        dtype=self.compute_dtype)
+        if "wpe" in params:
+            x = x + L.embedding(params["wpe"], lengths,
+                                dtype=self.compute_dtype)[:, None]
+
+        # page → segment bookkeeping, shared by every layer: free
+        # pages divert to the trash segment n_slots; a page's token j
+        # holds absolute position page_pos*ps + j, visible iff <= its
+        # owner's current length (the token this step writes lands AT
+        # ``lengths`` and must see itself). The sweep reads pages
+        # [1:] only — page 0 is the reserved null page (dead-slot
+        # write target, never owned), and excluding it keeps the read
+        # at exactly the usable capacity, so the dense-geometry
+        # control streams exactly max_slots × seq_len tokens
+        seg = jnp.where(owner >= 0, owner, n_slots)[1:]
+        owner_c = jnp.clip(owner, 0, n_slots - 1)[1:]
+        tok_pos = page_pos[1:, None] * ps + jnp.arange(ps)[None, :]
+        owner_len = jnp.where(owner[1:] >= 0, lengths[owner_c], -1)
+        visible = tok_pos <= owner_len[:, None]      # (n_pages - 1, ps)
+
+        # this step's write target per slot: the page holding position
+        # ``lengths``; dead slots scribble the reserved null page
+        w_page = tables[jnp.arange(n_slots), lengths // ps]
+        w_page = jnp.where(active, w_page, 0)
+        w_off = lengths % ps
+
+        def layer(x, inputs):
+            bp, pk, pv = inputs
+
+            def attend(q, k, v):
+                if self.quantized:
+                    (pkv, pks), (pvv, pvs) = pk, pv
+                    kq, k_s = _quantize_kv(k)
+                    vq, v_s = _quantize_kv(v)
+                    new_k = (pkv.at[w_page, w_off].set(kq[:, 0]),
+                             pks.at[w_page, w_off].set(k_s[:, 0]))
+                    new_v = (pvv.at[w_page, w_off].set(vq[:, 0]),
+                             pvs.at[w_page, w_off].set(v_s[:, 0]))
+                else:
+                    new_k = pk.at[w_page, w_off].set(
+                        k[:, 0].astype(pk.dtype))
+                    new_v = pv.at[w_page, w_off].set(
+                        v[:, 0].astype(pv.dtype))
+                # the pool sweep: each live page attends its owner's
+                # query (a gather of the TINY q tensor — the pool
+                # itself is read in place, once, minus the null page:
+                # a static [1:] slice that fuses into the einsum
+                # operand read), then pages merge per slot via the
+                # online-softmax combine
+                if self.quantized:
+                    rk = tuple(a[1:] for a in new_k)
+                    rv = tuple(a[1:] for a in new_v)
+                else:
+                    rk, rv = new_k[1:], new_v[1:]
+                q_pages = q[owner_c]           # (n_pages - 1, 1, H, Dh)
+                o_p, m_p, l_p = _grouped_cache_attention(
+                    q_pages, rk, rv,
+                    visible[:, None, None, None, :], state=True)
+                m_p, l_p, o_p = m_p[..., 0], l_p[..., 0], o_p[:, 0]
+                m_s = jax.ops.segment_max(m_p, seg,
+                                          num_segments=n_slots + 1)
+                w = jnp.exp(m_p - m_s[seg])
+                l_s = jax.ops.segment_sum(l_p * w, seg,
+                                          num_segments=n_slots + 1)
+                o_s = jax.ops.segment_sum(o_p * w[..., None], seg,
+                                          num_segments=n_slots + 1)
+                o = o_s[:n_slots] / jnp.maximum(
+                    l_s[:n_slots], 1e-30)[..., None]
+                o = o.reshape(n_slots, 1, cfg.n_heads,
+                              cfg.d_model // cfg.n_heads)
+                return o.astype(q.dtype), (new_k, new_v)
+
+            x, _, (pk, pv) = _block_core(
+                bp, x, cfg, attend,
+                capacity_factor=max(cfg.capacity_factor,
+                                    float(cfg.n_experts)),
+                positions=lengths[:, None])     # per-slot rope depth
+            return x, (pk, pv)
+
+        x, (pool_k, pool_v) = jax.lax.scan(
+            layer, x, (params["blocks"], pool_k, pool_v))
+        logits = _lm_head(params, x)[:, 0]
+        return self._pick(rng, logits), pool_k, pool_v
+
+    # ---- host lifecycle ------------------------------------------
+    def can_admit(self, prompt_len: int) -> bool:
+        return (self.tables.free_slot() is not None
+                and self.tables.pages_for(prompt_len)
+                <= self.tables.n_free_pages
+                and prompt_len < self.cfg.seq_len)
+
+    def admit(self, prompt_ids: np.ndarray) -> tuple[int, int] | None:
+        """Prefill one request and seat it in a free slot; returns
+        ``(slot, first_token)``, or None when no slot or not enough
+        free pages (the batcher keeps it queued)."""
+        prompt_ids = np.asarray(prompt_ids, np.int32).reshape(-1)
+        if not self.can_admit(len(prompt_ids)):
+            return None
+        slot = self.tables.free_slot()
+        self._rng, sub = jax.random.split(self._rng)
+        s0 = len(prompt_ids)
+        padded = np.zeros(self.tables.pages_for(s0) * self.page_size,
+                          np.int32)
+        padded[:s0] = prompt_ids
+        first, ks, vs = self._prefill_jit(
+            self.params, jnp.asarray(padded)[None],
+            jnp.asarray(s0, jnp.int32), sub)
+        first = int(first[0])
+        page_ids = self.tables.admit(slot, len(prompt_ids), first)
+        pool_k, pool_v = self._write_jit(self.pool["k"], self.pool["v"],
+                                         ks, vs, jnp.asarray(page_ids))
+        self.pool = {"k": pool_k, "v": pool_v}
+        return slot, first
+
+    def grow_slots(self) -> list[int]:
+        """Pre-allocate each active slot's next write page; returns
+        the slots that could NOT get one (pool exhausted — the batcher
+        preempts). Call before every :meth:`step`."""
+        starved = []
+        for slot in np.flatnonzero(self.tables.active):
+            if not self.tables.ensure_next_page(int(slot)):
+                starved.append(int(slot))
+        return starved
+
+    def step(self) -> np.ndarray:
+        """One decode step over every slot; advances lengths/last_ids
+        for the active ones and returns the (max_slots,) token ids
+        (garbage at inactive slots)."""
+        active = self.tables.active.copy()
+        if active.any():
+            full = self.tables.lengths[active] >= self.cfg.seq_len
+            if full.any():
+                raise RuntimeError(
+                    "a slot reached cfg.seq_len; the batcher must "
+                    "retire sequences at the cache horizon")
+        self._rng, sub = jax.random.split(self._rng)
+        args = self.tables.device_args()
+        tokens, pool_k, pool_v = self._decode_jit(
+            self.params, self.pool["k"], self.pool["v"],
+            args["tables"], args["lengths"], args["owner"],
+            args["page_pos"], args["active"], args["last_ids"], sub)
+        self.pool = {"k": pool_k, "v": pool_v}
+        tokens = np.asarray(tokens)
+        for slot in np.flatnonzero(active):
+            self.tables.advance(int(slot), int(tokens[slot]))
+        return tokens
+
+    def retire(self, slot: int) -> None:
+        self.tables.retire(slot)
+
+    @property
+    def decode_compiles(self) -> int:
+        """Compiled decode-step count — the zero-recompile contract's
+        observable (tests assert it stays 1 across slot churn)."""
+        return self._decode_jit._cache_size()
+
+
+__all__ = ["PagedEngine"]

@@ -1,0 +1,253 @@
+"""Paged KV cache: a fixed pool of K/V pages + per-slot block tables.
+
+The dense decode cache (models/gpt.py ``jit_generate``) preallocates
+``(B, S_cache, H_kv, Dh)`` per layer and every decode step streams ALL
+of it — at realistic mixed lengths most of those bytes are padding
+(docs/performance.md roofline: decode is HBM-bound on exactly these
+reads). Here the cache is a pool of ``(n_pages, page_size, H_kv, Dh)``
+pages per layer shared by every serving slot; a sequence occupies
+``ceil(len / page_size)`` pages wired up by a per-slot block table, so
+the bytes a decode step must stream are the POOL's — sized to expected
+total occupancy — instead of ``max_slots × S_cache``.
+
+Two cooperating halves:
+
+- :func:`make_pool` — the device-side pool (one K and one V array per
+  layer, stacked on the leading layer axis for the ``lax.scan`` decode
+  step; bf16/fp32, or int8 + bf16 scales — the engine quantizes page
+  writes with the SAME ``_quantize_kv`` the dense ``cache_dtype=
+  "int8"`` path uses).
+- :class:`BlockTables` — HOST-side alloc/free bookkeeping (plain
+  integer index arithmetic on numpy arrays, nothing shape-dependent:
+  admitting and retiring sequences only changes VALUES inside
+  fixed-shape tables, so the compiled decode step — whose signature
+  depends only on pool geometry — never recompiles).
+
+Page 0 is RESERVED as the null page: free slots' table entries and
+inactive slots' write targets all point at it, its owner stays ``-1``
+forever, and the attention sweep masks it out — so a dead slot can
+scribble into the pool without a branch and without corrupting any
+live sequence.
+"""
+from __future__ import annotations
+
+from typing import Any
+
+import jax.numpy as jnp
+import numpy as np
+
+from torchbooster_tpu.models.gpt import GPTConfig
+
+NULL_PAGE = 0
+
+
+def make_pool(cfg: GPTConfig, page_size: int, n_pages: int,
+              cache_dtype: Any = None,
+              compute_dtype: Any = jnp.bfloat16) -> dict:
+    """Allocate the device pool: ``{"k": ..., "v": ...}`` with each
+    entry ``(n_layers, n_pages, page_size, kv_heads, head_dim)`` — a
+    plain array in ``compute_dtype``, or, when ``cache_dtype`` is
+    ``"int8"``, the ``(int8 values, bf16 scales)`` pair layout the
+    dense quantized cache uses (scales keep the trailing head dim as 1
+    for broadcasting)."""
+    if cache_dtype not in (None, "int8", jnp.int8):
+        raise ValueError(
+            f"cache_dtype must be None or 'int8', got {cache_dtype!r}")
+    head_dim = cfg.d_model // cfg.n_heads
+    shape = (cfg.n_layers, n_pages, page_size, cfg.kv_heads, head_dim)
+    if cache_dtype in ("int8", jnp.int8):
+        scale_shape = shape[:-1] + (1,)
+        mk = lambda: (jnp.zeros(shape, jnp.int8),
+                      jnp.ones(scale_shape, jnp.bfloat16))
+    else:
+        mk = lambda: jnp.zeros(shape, compute_dtype)
+    return {"k": mk(), "v": mk()}
+
+
+class BlockTables:
+    """Host-side page bookkeeping for ``max_slots`` serving slots over
+    a ``n_pages``-page pool (page 0 reserved null).
+
+    All state is fixed-shape numpy; alloc/free is integer index
+    arithmetic. The decode step consumes :meth:`device_args` — the
+    VALUES change per step, the shapes never do, so slot churn cannot
+    trigger a recompile.
+
+    Arrays:
+
+    - ``tables (max_slots, max_pages_per_slot) int32`` — page ids per
+      slot, ``NULL_PAGE`` where unassigned;
+    - ``lengths (max_slots,) int32`` — tokens currently stored;
+    - ``owner (n_pages,) int32`` — owning slot per page, ``-1`` free;
+    - ``page_pos (n_pages,) int32`` — the page's index within its
+      owner's sequence (page ``p`` holds absolute token positions
+      ``page_pos[p]*page_size + [0, page_size)``);
+    - ``active (max_slots,) bool`` — slot occupancy;
+    - ``last_ids (max_slots,) int32`` — each slot's most recent token
+      (the decode step's input).
+    """
+
+    def __init__(self, cfg: GPTConfig, page_size: int, n_pages: int,
+                 max_slots: int):
+        if page_size < 1 or n_pages < 2 or max_slots < 1:
+            raise ValueError(
+                f"need page_size >= 1, n_pages >= 2 (page 0 is the "
+                f"reserved null page) and max_slots >= 1; got "
+                f"page_size={page_size}, n_pages={n_pages}, "
+                f"max_slots={max_slots}")
+        self.page_size = page_size
+        self.n_pages = n_pages
+        self.max_slots = max_slots
+        self.max_pages_per_slot = -(-cfg.seq_len // page_size)
+        self.seq_len = cfg.seq_len
+        self.tables = np.full((max_slots, self.max_pages_per_slot),
+                              NULL_PAGE, np.int32)
+        self.lengths = np.zeros(max_slots, np.int32)
+        self.owner = np.full(n_pages, -1, np.int32)
+        self.page_pos = np.zeros(n_pages, np.int32)
+        self.active = np.zeros(max_slots, bool)
+        self.last_ids = np.zeros(max_slots, np.int32)
+        # LIFO free list: recently-freed pages are re-issued first
+        # (their bytes are hottest in cache); page 0 never enters
+        self._free = list(range(n_pages - 1, 0, -1))
+
+    # ---- queries -------------------------------------------------
+    @property
+    def n_free_pages(self) -> int:
+        return len(self._free)
+
+    def free_slot(self) -> int | None:
+        """Lowest free slot id, or None when all slots are occupied."""
+        idle = np.flatnonzero(~self.active)
+        return int(idle[0]) if idle.size else None
+
+    def pages_for(self, n_tokens: int) -> int:
+        return -(-n_tokens // self.page_size)
+
+    def slot_pages(self, slot: int) -> np.ndarray:
+        """The slot's live page ids, in sequence order."""
+        n = self.pages_for(int(self.lengths[slot]))
+        return self.tables[slot, :n].copy()
+
+    # ---- mutations -----------------------------------------------
+    def admit(self, slot: int, prompt_len: int,
+              first_id: int) -> np.ndarray:
+        """Claim ``slot`` for a sequence of ``prompt_len`` stored
+        tokens: allocates ``ceil(prompt_len / page_size)`` pages and
+        returns their ids (the engine scatters the prefill K/V there).
+        ``first_id`` seeds the slot's decode input (the prefill's
+        sampled token). Raises when the slot is busy or pages run out
+        — the batcher checks :attr:`n_free_pages` first."""
+        if self.active[slot]:
+            raise ValueError(f"slot {slot} is already occupied")
+        if not 0 < prompt_len < self.seq_len:
+            raise ValueError(
+                f"prompt_len must be in (0, {self.seq_len}), got "
+                f"{prompt_len}")
+        n = self.pages_for(prompt_len)
+        page_ids = self._alloc(slot, np.arange(n))
+        self.lengths[slot] = prompt_len
+        self.active[slot] = True
+        self.last_ids[slot] = first_id
+        return page_ids
+
+    def ensure_next_page(self, slot: int) -> bool:
+        """Make sure the page that position ``lengths[slot]`` (the
+        next write) lands in exists; allocates one page at a page
+        boundary. Returns False when the pool is exhausted (the
+        batcher then preempts) — the slot is untouched."""
+        length = int(self.lengths[slot])
+        idx = length // self.page_size
+        if length % self.page_size or self.tables[slot, idx] != NULL_PAGE:
+            return True
+        if not self._free:
+            return False
+        self._alloc(slot, np.array([idx]))
+        return True
+
+    def advance(self, slot: int, token_id: int) -> None:
+        """Record one decoded token (already written on device at
+        position ``lengths[slot]`` by the step that produced it)."""
+        self.lengths[slot] += 1
+        self.last_ids[slot] = token_id
+
+    def retire(self, slot: int) -> None:
+        """Free the slot and every page it holds (returned LIFO)."""
+        if not self.active[slot]:
+            return
+        for p in self.tables[slot]:
+            if p != NULL_PAGE:
+                self.owner[p] = -1
+                self.page_pos[p] = 0
+                self._free.append(int(p))
+        self.tables[slot] = NULL_PAGE
+        self.lengths[slot] = 0
+        self.active[slot] = False
+        self.last_ids[slot] = 0
+
+    def _alloc(self, slot: int, table_idx: np.ndarray) -> np.ndarray:
+        if len(table_idx) > len(self._free):
+            raise RuntimeError(
+                f"KV page pool exhausted: need {len(table_idx)} pages, "
+                f"{len(self._free)} free (n_pages={self.n_pages}, "
+                f"page_size={self.page_size}); size serving.n_pages to "
+                "the worst-case live-token total or lower max_slots")
+        ids = np.array([self._free.pop() for _ in table_idx], np.int32)
+        self.tables[slot, table_idx] = ids
+        self.owner[ids] = slot
+        # a page's position within its owner's sequence IS its table
+        # index — the sweep reconstructs absolute token positions from it
+        self.page_pos[ids] = np.asarray(table_idx, np.int32)
+        return ids
+
+    # ---- device view ---------------------------------------------
+    def device_args(self) -> dict:
+        """The decode step's table operands, as jnp arrays. Fixed
+        shapes by construction — only values change across admit/
+        retire, which is what keeps the compiled step signature
+        occupancy-independent."""
+        return {
+            "tables": jnp.asarray(self.tables),
+            "lengths": jnp.asarray(self.lengths),
+            "owner": jnp.asarray(self.owner),
+            "page_pos": jnp.asarray(self.page_pos),
+            "active": jnp.asarray(self.active),
+            "last_ids": jnp.asarray(self.last_ids),
+        }
+
+    # ---- invariants (tests) --------------------------------------
+    def check(self) -> None:
+        """Structural invariants, asserted by the churn tests: page 0
+        never allocated; free list ∪ owned pages = pool exactly once;
+        owner/page_pos agree with the tables; lengths fit the pages
+        held."""
+        free = set(self._free)
+        assert NULL_PAGE not in free, "null page entered the free list"
+        assert self.owner[NULL_PAGE] == -1, "null page acquired an owner"
+        assert len(free) == len(self._free), "free list holds duplicates"
+        owned = set()
+        for slot in range(self.max_slots):
+            n_live = self.pages_for(int(self.lengths[slot]))
+            for idx, p in enumerate(self.tables[slot]):
+                p = int(p)
+                if idx < n_live:
+                    assert p != NULL_PAGE, (
+                        f"slot {slot} live page {idx} unassigned")
+                if p == NULL_PAGE:
+                    continue
+                assert p not in owned, f"page {p} assigned twice"
+                owned.add(p)
+                assert self.owner[p] == slot, (slot, idx, p)
+                assert self.page_pos[p] == idx, (slot, idx, p)
+            if not self.active[slot]:
+                assert self.lengths[slot] == 0
+                assert (self.tables[slot] == NULL_PAGE).all()
+        assert free.isdisjoint(owned)
+        assert len(free) + len(owned) == self.n_pages - 1, (
+            "pages leaked: free + owned != pool")
+        for p in range(self.n_pages):
+            if p != NULL_PAGE and p not in owned:
+                assert p in free, f"page {p} neither owned nor free"
+
+
+__all__ = ["BlockTables", "NULL_PAGE", "make_pool"]
