@@ -38,6 +38,10 @@ BENCH_DECODE_BATCH/NEW/CACHES shape it, BENCH_SKIP_DECODE skips);
 the serve sub-bench (continuous batching through the paged-KV engine
 vs its dense-geometry control; BENCH_SERVE_REQUESTS/RATE/SLOTS/PAGE/
 PAGES/SEQ/CACHE_DTYPE shape it, BENCH_SKIP_SERVE skips);
+the obs sub-bench (telemetry-on vs telemetry-off A/B over the GPT
+step + recompile-sentinel verification; BENCH_SKIP_OBS skips);
+BENCH_SKIP_COSTCHECK=1 drops the XLA cost-analysis FLOP cross-check
+(one extra AOT compile per checked bench);
 deadlines: BENCH_SUB_DEADLINE or BENCH_DEADLINE_<name>.
 """
 from __future__ import annotations
@@ -85,7 +89,8 @@ def timed_steps(step, state, data, steps: int) -> float:
     return (time.perf_counter() - t0) / steps
 
 
-def bench_tpu(batch: int, image: int, steps: int) -> float:
+def bench_tpu(batch: int, image: int, steps: int
+              ) -> tuple[float, float | None]:
     rng = jax.random.PRNGKey(0)
     params = ResNet.init(rng, depth=50, num_classes=1000, stem="imagenet")
     # BENCH_FUSED=1 forces the pallas conv+GN kernels (ops/fused_block),
@@ -112,7 +117,19 @@ def bench_tpu(batch: int, image: int, steps: int) -> float:
     y = jax.device_put(jnp.zeros((batch,), jnp.int32))
     data = {"images": x, "labels": y}
 
-    return batch / timed_steps(step, state, data, steps)
+    # cross-check the hand FLOP denominator against the compiler's own
+    # count BEFORE the timed run (lower+compile only — donation hasn't
+    # fired yet, so ``state`` is still readable); warns >10% drift
+    # (observability/device.py). AOT means one extra compile — skip
+    # via BENCH_SKIP_COSTCHECK when compile time is the constraint.
+    ratio = None
+    if not env_flag("BENCH_SKIP_COSTCHECK"):
+        from torchbooster_tpu.observability import flop_check, xla_flops
+
+        formula = RESNET50_TRAIN_FLOP_PER_IMG * (image / 224) ** 2 * batch
+        ratio = flop_check("resnet step (3x fwd FLOPs)", formula,
+                           xla_flops(step, state, data))
+    return batch / timed_steps(step, state, data, steps), ratio
 
 
 def bench_unet(steps: int) -> float:
@@ -170,12 +187,12 @@ def _attn_resolved(seq_len: int) -> str:
     return impl
 
 
-def bench_gpt(steps: int) -> tuple[float, float, bool]:
+def bench_gpt(steps: int) -> tuple[float, float, bool, float | None]:
     """GPT-2 small (12L/768d/12H, vocab 50257, S=1024) train step —
     driver-captured version of the docs' LM claim. Returns
-    (tokens/s, mfu, flash_engaged) — the flag evaluated on the EXACT
-    seq_len this run used, not a lookalike constant (the r3 drift
-    class)."""
+    (tokens/s, mfu, flash_engaged, flop_ratio) — the flag evaluated on
+    the EXACT seq_len this run used, not a lookalike constant (the r3
+    drift class); flop_ratio is XLA cost-analysis / 6·N·D."""
     from torchbooster_tpu.models.gpt import GPT, GPTConfig
 
     # BENCH_GPT_POS=rope / BENCH_GPT_MLP=swiglu / BENCH_GPT_KV_HEADS:
@@ -195,10 +212,20 @@ def bench_gpt(steps: int) -> tuple[float, float, bool]:
     ids = jax.random.randint(jax.random.PRNGKey(1), (batch, cfg.seq_len),
                              0, cfg.vocab)
     data = {"ids": ids}
+    # 6·N·D vs XLA's count for the exact compiled graph (pre-donation,
+    # see bench_tpu) — the MFU denominator must not silently drift as
+    # architecture knobs (rope/swiglu/gqa/chunked head) reshape it
+    ratio = None
+    if not env_flag("BENCH_SKIP_COSTCHECK"):
+        from torchbooster_tpu.observability import flop_check, xla_flops
+
+        formula = 6 * n_params * batch * cfg.seq_len
+        ratio = flop_check("gpt step (6·N·D)", formula,
+                           xla_flops(step, state, data))
     dt = timed_steps(step, state, data, steps)
     tok_s = batch * cfg.seq_len / dt
     mfu = 6 * n_params * batch * cfg.seq_len / dt / (SUSTAINED_TFLOPS * 1e12)
-    return tok_s, mfu, _attn_resolved(cfg.seq_len) == "flash"
+    return tok_s, mfu, _attn_resolved(cfg.seq_len) == "flash", ratio
 
 
 def _gpt_loss_fn(cfg):
@@ -433,6 +460,63 @@ def bench_serve() -> dict:
     out[f"serve_pool_ratio{suffix}"] = round(
         slots * seq / ((n_pages - 1) * page), 2)
     return out
+
+
+def bench_obs(steps: int) -> dict:
+    """Telemetry overhead A/B: the SAME GPT bench step (bench_gpt
+    geometry + knobs) timed with observability disabled, then enabled
+    (``utils.instrument_step`` wrapper: span + step-time histogram +
+    step counter) under a :class:`RecompileSentinel` watching the
+    step's jit cache. The acceptance pair for the observability PR:
+    instrumentation must add ZERO new compiles and <2% step time.
+
+    Each arm gets a FRESH TrainState (the jitted step donates its
+    state, so the first arm consumed the original buffers), but the
+    SAME jitted callable — a recompile in the enabled arm would mean
+    instrumentation perturbed the compiled contract, exactly what the
+    sentinel is there to catch."""
+    from torchbooster_tpu import observability as obs
+    from torchbooster_tpu.models.gpt import GPT, GPTConfig
+    from torchbooster_tpu.utils import instrument_step
+
+    cfg = GPTConfig(pos=os.environ.get("BENCH_GPT_POS", "learned"),
+                    mlp=os.environ.get("BENCH_GPT_MLP", "gelu"),
+                    n_kv_heads=int(os.environ.get("BENCH_GPT_KV_HEADS",
+                                                  0)))
+    batch = int(os.environ.get("BENCH_GPT_BATCH", 16))
+    params = GPT.init(jax.random.PRNGKey(0), cfg)
+    tx = optax.adamw(1e-4)
+    loss_fn = _gpt_loss_fn(cfg)
+    step = make_step(loss_fn, tx)
+    ids = jax.random.randint(jax.random.PRNGKey(1), (batch, cfg.seq_len),
+                             0, cfg.vocab)
+    data = {"ids": ids}
+
+    def fresh_state():
+        return TrainState.create(jax.tree.map(jnp.array, params), tx)
+
+    # best-of-3 per arm: the effect being resolved (<2%) is below
+    # host-side run-to-run noise, and min-of-repeats is the standard
+    # way to read a lower bound per configuration
+    dt_off = min(timed_steps(step, fresh_state(), data, steps)
+                 for _ in range(3))
+    was_enabled = obs.get_registry().enabled
+    obs.set_enabled(True)
+    try:
+        instrumented = instrument_step(step, name="bench_gpt_step")
+        with obs.RecompileSentinel(step, expected=0, name="bench_obs",
+                                   on_recompile="ignore") as sentinel:
+            dt_on = min(timed_steps(instrumented, fresh_state(), data,
+                                    steps)
+                        for _ in range(3))
+    finally:
+        obs.set_enabled(was_enabled)
+    return {
+        "obs_step_s_off": round(dt_off, 6),
+        "obs_step_s_on": round(dt_on, 6),
+        "obs_overhead_pct": round((dt_on - dt_off) / dt_off * 100, 2),
+        "obs_recompiles": sentinel.extra,
+    }
 
 
 class _DecodeHeavyDataset:
@@ -830,21 +914,23 @@ def _sub_main(name: str) -> None:
     on_tpu = jax.default_backend() not in ("cpu",)
     batch, image, steps = _shapes(on_tpu)
     if name == "resnet":
-        value = bench_tpu(batch, image, steps)
+        value, flop_ratio = bench_tpu(batch, image, steps)
         # FLOP constant holds at 224²; conv FLOPs scale ~quadratically
         # with the side, so scale it for non-default BENCH_IMAGE runs.
         flop_per_img = RESNET50_TRAIN_FLOP_PER_IMG * (image / 224) ** 2
         mfu = (round(value * flop_per_img / (SUSTAINED_TFLOPS * 1e12), 4)
                if on_tpu else None)
-        print(json.dumps({"value": round(value, 2), "mfu": mfu}))
+        print(json.dumps({"value": round(value, 2), "mfu": mfu,
+                          "flop_xla_ratio": flop_ratio}))
     elif name == "gpt":
         # the default S=1024 sits below the flash crossover: expected
         # false. The flag makes the recorded line say WHICH attention
         # path the measured run took.
-        tok_s, mfu, engaged = bench_gpt(max(4, steps // 4))
+        tok_s, mfu, engaged, flop_ratio = bench_gpt(max(4, steps // 4))
         print(json.dumps({"gpt_tokens_per_sec": round(tok_s, 1),
                           "gpt_mfu": round(mfu, 4),
-                          "gpt_flash_engaged": engaged}))
+                          "gpt_flash_engaged": engaged,
+                          "gpt_flop_xla_ratio": flop_ratio}))
     elif name == "gpt_long":
         # the flag comes from the same resolution the loss fn uses
         # (_attn_resolved), so a forced override — including
@@ -868,6 +954,8 @@ def _sub_main(name: str) -> None:
         print(json.dumps(bench_decode()))
     elif name == "serve":
         print(json.dumps(bench_serve()))
+    elif name == "obs":
+        print(json.dumps(bench_obs(max(4, steps // 4))))
     elif name == "cifar_acc":
         print(json.dumps(bench_cifar_acc()))
     else:
@@ -1042,7 +1130,8 @@ def _deadline(name: str, default: int) -> int:
 
 # secondary sub-benches and their default deadlines, in run order
 _SECONDARY_BENCHES = (("gpt", 900), ("gpt_long", 1500), ("loader", 900),
-                      ("unet", 900), ("decode", 1500), ("serve", 1800))
+                      ("unet", 900), ("decode", 1500), ("serve", 1800),
+                      ("obs", 900))
 
 
 def _driver_hold_budget() -> int:
@@ -1245,7 +1334,7 @@ def _torch_baseline(batch: int, image: int, steps: int) -> float:
 
 def _main_cpu_inprocess() -> dict:
     batch, image, steps = _shapes(False)
-    value = bench_tpu(batch, image, steps)
+    value, flop_ratio = bench_tpu(batch, image, steps)
     baseline = _torch_baseline(batch, image, steps)
     return {
         "metric": "ResNet-50 train images/sec/chip "
@@ -1255,6 +1344,7 @@ def _main_cpu_inprocess() -> dict:
         "vs_baseline": round(value / baseline, 2),
         "baseline_stack": "torch-cpu (reference stack in this image)",
         "mfu": None,
+        "flop_xla_ratio": flop_ratio,
     }
 
 

@@ -1,0 +1,76 @@
+"""Tier-1 wiring for scripts/obs_lint.py: the package must stay free
+of per-step host-sync smells (.item(), time.time() for durations,
+float(<call>) in step-cadence paths) modulo the documented allowlist —
+a regression here silently kills async-dispatch overlap, which no
+functional test can see."""
+from __future__ import annotations
+
+import importlib.util
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[1]
+
+
+def _load_lint():
+    spec = importlib.util.spec_from_file_location(
+        "obs_lint", REPO / "scripts" / "obs_lint.py")
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+    return module
+
+
+def test_package_has_no_unallowlisted_host_sync_smells():
+    # in-process (this image's sitecustomize makes every subprocess
+    # pay a jax import): scan() is the same entry main() wraps
+    findings = _load_lint().scan()
+    pretty = "\n".join(f"{r}:{n}: {s}\n    {ln}"
+                       for r, n, s, ln in findings)
+    assert not findings, f"obs_lint found host-sync smells:\n{pretty}"
+
+
+def test_lint_detects_each_smell(tmp_path):
+    """The lint's teeth: each smell class is actually caught (a lint
+    that silently stops matching is worse than none)."""
+    lint = _load_lint()
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "import time\n"
+        "def hot(metrics, loss_fn, x):\n"
+        "    a = metrics['loss'].item()\n"
+        "    t = time.time()\n"
+        "    b = float(loss_fn(x))\n"
+        "    return a, t, b\n"
+        "# .item() in a comment must NOT trip the AST lint\n")
+    finder = lint._Finder("torchbooster_tpu/utils.py",
+                          bad.read_text().splitlines(), hot=True)
+    import ast
+
+    finder.visit(ast.parse(bad.read_text()))
+    smells = [s for _, _, s, _ in finder.findings]
+    assert len(smells) == 3
+    assert any(".item()" in s for s in smells)
+    assert any("time.time()" in s for s in smells)
+    assert any("float(<call>)" in s for s in smells)
+
+
+def test_allowlist_matches_by_path_and_substring():
+    lint = _load_lint()
+    entries = [("torchbooster_tpu/metrics.py", "float(jax.device_get")]
+    assert lint.allowed("torchbooster_tpu/metrics.py",
+                        "x = float(jax.device_get(v))", entries)
+    assert not lint.allowed("torchbooster_tpu/utils.py",
+                            "x = float(jax.device_get(v))", entries)
+    assert not lint.allowed("torchbooster_tpu/metrics.py",
+                            "x = v.item()", entries)
+
+
+def test_allowlist_entries_still_match_something():
+    """Stale allowlist entries (code moved on) must be pruned, or the
+    allowlist rots into a blanket waiver."""
+    lint = _load_lint()
+    entries = lint.load_allowlist()
+    assert entries, "allowlist unexpectedly empty"
+    for path, pattern in entries:
+        source = (REPO / path).read_text()
+        assert pattern in source, (
+            f"stale allowlist entry: {path}:{pattern}")

@@ -346,3 +346,10 @@ def test_serving_config_builds_batcher():
     sc8 = ServingConfig(page_size=4, n_pages=16, max_slots=2,
                         cache_dtype="int8")
     assert sc8.make(params, cfg).engine.quantized
+
+    # the YAML observability policy reaches the runtime guard: make()
+    # threads on_recompile into the batcher (default stays "warn")
+    assert batcher.on_recompile == "warn"
+    strict = sc.make(params, cfg, compute_dtype=jnp.float32,
+                     on_recompile="raise")
+    assert strict.on_recompile == "raise"

@@ -163,6 +163,7 @@ def test_seed_accepts_deterministic_flag():
     assert sample.shape == (3,)
 
 
+@pytest.mark.slow     # captures a REAL profiler trace (obs budget rule)
 def test_trace_context(tmp_path):
     """utils.trace captures a profiler trace (SURVEY §5.1)."""
     import jax.numpy as jnp
@@ -285,3 +286,40 @@ def test_make_step_ema_accumulation_holds():
     d = min(0.5, (1 + 3) / (10 + 3))
     np.testing.assert_allclose(float(state.ema["w"][0]), (1 - d) * w,
                                rtol=1e-5)
+
+
+# =====================================================================
+# utils.trace / utils.annotate on the CPU backend (satellite: the
+# exception path and annotate nesting were shipped untested). Marked
+# slow: each captures a REAL profiler trace (observability budget rule).
+# =====================================================================
+
+@pytest.mark.slow
+def test_trace_reraises_body_exception_after_stop(tmp_path):
+    """A failing region must still propagate its exception AND leave
+    the profiler stopped (stop_trace ran) — a second capture in the
+    same process proves the first one was closed out."""
+    with pytest.raises(ValueError, match="boom"):
+        with utils.trace(str(tmp_path / "first")):
+            jnp.ones(4).block_until_ready()
+            raise ValueError("boom")
+    with utils.trace(str(tmp_path / "second")):
+        jnp.ones(4).block_until_ready()
+    files = [p for p in (tmp_path / "second").rglob("*") if p.is_file()]
+    assert files
+
+
+@pytest.mark.slow
+def test_annotate_is_reentrant_context(tmp_path):
+    with utils.trace(str(tmp_path)):
+        with utils.annotate("outer"), utils.annotate("inner"):
+            jnp.ones(4).block_until_ready()
+
+
+def test_trace_annotate_rehomed_in_observability():
+    """utils.trace/annotate are the observability spans module's
+    objects — one implementation, two import paths."""
+    from torchbooster_tpu.observability import spans
+
+    assert utils.trace is spans.trace
+    assert utils.annotate is spans.annotate

@@ -8,21 +8,39 @@ step is a single compiled function instead of eager autograd.
 
 Parity notes (reference file:line cited per module):
 - logging bootstrap at import mirrors reference torchbooster/__init__.py:1-9
-  (coloredlogs optional there; plain logging here).
+  (coloredlogs optional there; plain logging here) — but ONLY into a
+  virgin root logger: an embedding application's own logging setup is
+  never clobbered (the reference hijacks it unconditionally), and
+  ``TORCHBOOSTER_NO_LOG_SETUP=1`` skips the bootstrap entirely.
 """
 from __future__ import annotations
 
 import logging
+import os
 
-try:  # pragma: no cover - cosmetic only
-    import coloredlogs  # type: ignore
 
-    coloredlogs.install(level=logging.INFO)
-except ImportError:  # pragma: no cover
-    logging.basicConfig(
-        level=logging.INFO,
-        format="%(asctime)s %(name)s[%(process)d] %(levelname)s %(message)s",
-        datefmt="%Y-%m-%d %H:%M:%S",
-    )
+def _setup_logging() -> None:
+    """Import-time convenience logging, politely: nothing happens when
+    the embedding app already configured the root logger (handlers
+    present) or opted out via ``TORCHBOOSTER_NO_LOG_SETUP=1``."""
+    if os.environ.get("TORCHBOOSTER_NO_LOG_SETUP", "").strip().lower() \
+            in ("1", "true", "yes"):
+        return
+    if logging.getLogger().handlers:
+        return
+    try:
+        import coloredlogs  # type: ignore
+
+        coloredlogs.install(level=logging.INFO)
+    except ImportError:
+        logging.basicConfig(
+            level=logging.INFO,
+            format="%(asctime)s %(name)s[%(process)d] %(levelname)s "
+                   "%(message)s",
+            datefmt="%Y-%m-%d %H:%M:%S",
+        )
+
+
+_setup_logging()
 
 __version__ = "0.1.0"

@@ -10,6 +10,7 @@ step, PRNG key — instead of ``.pt`` pickles.
 from __future__ import annotations
 
 import logging
+import time
 from pathlib import Path
 from typing import Any
 
@@ -39,6 +40,55 @@ def state_dict(value: Any) -> Any:
     if hasattr(value, "state_dict"):
         return value.state_dict()
     return value
+
+
+class LogCallback(BaseCallback):
+    """Telemetry drain on the training cadence: every ``every`` steps,
+    snapshot the observability registry (THE host-sync point — per-step
+    metrics stay device-side between drains, the
+    ``metrics.RunningAverage`` discipline), derive steps/s from the
+    ``steps_total`` counter delta, merge any caller metrics
+    (``log_cb(loss=avg.value)``), log one line and return the dict.
+
+    Pairs with :func:`torchbooster_tpu.utils.instrument_step` (which
+    feeds ``steps_total``/``step_seconds``) but drains whatever the
+    stack recorded — serving counters, pipeline waits, span timings.
+    """
+
+    def __init__(self, every: int, n_iter: int | None = None,
+                 registry: Any = None, logger: str = "torchbooster"):
+        super().__init__(every, n_iter)
+        from torchbooster_tpu.observability import get_registry
+
+        self.registry = registry if registry is not None else get_registry()
+        self.logger = logging.getLogger(logger)
+        # baseline the counter NOW: steps dispatched before this
+        # callback existed must not inflate the first steps/s reading
+        self._last_steps = self._steps(self.registry.snapshot())
+        self._last_t = time.perf_counter()
+
+    @staticmethod
+    def _steps(snap: dict[str, Any]) -> float:
+        return sum(v for k, v in snap.items()
+                   if k.startswith("steps_total"))
+
+    def update(self, **metrics: Any) -> dict[str, Any] | None:
+        if self.current % self.every:
+            return None
+        snap = self.registry.snapshot()
+        now = time.perf_counter()
+        steps = self._steps(snap)
+        dt = now - self._last_t
+        # stable key set (same principle as batcher.run()): paused or
+        # pre-step ticks report 0.0, not a missing column
+        snap["steps_per_s"] = round(
+            (steps - self._last_steps) / dt, 2) \
+            if steps > self._last_steps and dt > 0 else 0.0
+        self._last_steps, self._last_t = steps, now
+        out = {"step": self.current, **snap,
+               **{k: float(v) for k, v in metrics.items()}}
+        self.logger.info("telemetry %s", out)
+        return out
 
 
 class SaveCallback(BaseCallback):
@@ -138,4 +188,4 @@ class SaveCallback(BaseCallback):
         return self.checkpointer.restore(self.path(step), template)
 
 
-__all__ = ["BaseCallback", "SaveCallback", "state_dict"]
+__all__ = ["BaseCallback", "LogCallback", "SaveCallback", "state_dict"]

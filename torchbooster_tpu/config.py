@@ -645,11 +645,15 @@ class ServingConfig(BaseConfig):
     top_p: float = 0.0                 # 0 = off
 
     def make(self, params: Any, model_cfg: Any,
-             compute_dtype: Any = None) -> Any:
+             compute_dtype: Any = None,
+             on_recompile: str = "warn") -> Any:
         """Build the engine + batcher for ``params``/``model_cfg`` (a
         :class:`~torchbooster_tpu.models.gpt.GPTConfig`). Returns the
         :class:`~torchbooster_tpu.serving.ContinuousBatcher`; its
-        ``.engine`` exposes admit/step/retire for custom drivers."""
+        ``.engine`` exposes admit/step/retire for custom drivers.
+        ``on_recompile`` is the batcher's runtime-guard policy — pass
+        your ``ObservabilityConfig.on_recompile`` so the YAML policy
+        reaches the one region the docs advertise as guarded."""
         import jax.numpy as jnp
 
         from torchbooster_tpu.serving import ContinuousBatcher, PagedEngine
@@ -663,7 +667,56 @@ class ServingConfig(BaseConfig):
                            else compute_dtype),
             temperature=self.temperature,
             top_k=self.top_k or None, top_p=self.top_p or None)
-        return ContinuousBatcher(engine)
+        return ContinuousBatcher(engine, on_recompile=on_recompile)
+
+
+@dataclass
+class ObservabilityConfig(BaseConfig):
+    """Telemetry switch + exporter wiring (torchbooster_tpu/
+    observability). No reference analogue — the reference's profiling
+    story never worked (SURVEY §5.1); this is the production
+    metrics/tracing/export layer.
+
+    YAML block::
+
+        observability:
+          enabled: true
+          jsonl_path: logs/telemetry.jsonl     # '' disables the event log
+          prom_path: logs/metrics.prom         # '' disables Prometheus
+          cadence_s: 10                        # export tick
+          on_recompile: warn                   # ignore | warn | raise
+
+    ``make()`` returns an :class:`~torchbooster_tpu.observability.
+    Observability` session handle (context-manager: flushes exporters
+    on exit). With ``enabled: false`` the handle is inert and every
+    instrumented call site in the stack stays a single branch."""
+
+    enabled: bool = False
+    jsonl_path: str = ""
+    prom_path: str = ""
+    cadence_s: float = 10.0
+    on_recompile: str = "warn"         # ignore | warn | raise
+
+    def make(self) -> Any:
+        from torchbooster_tpu import observability as obs
+
+        from torchbooster_tpu.observability.recompile import POLICIES
+
+        if self.on_recompile not in POLICIES:
+            raise ValueError(
+                f"on_recompile={self.on_recompile!r}: expected one "
+                f"of {POLICIES}")
+        if not self.enabled:
+            # authoritative: `enabled: false` turns the process
+            # default OFF even if an earlier session enabled it —
+            # otherwise instrumentation keeps queueing with no
+            # exporter left to drain it
+            return obs.Observability(obs.set_enabled(False),
+                                     on_recompile=self.on_recompile)
+        return obs.enable(jsonl_path=self.jsonl_path or None,
+                          prom_path=self.prom_path or None,
+                          cadence_s=self.cadence_s,
+                          on_recompile=self.on_recompile)
 
 
 @dataclass
@@ -703,6 +756,7 @@ __all__ = [
     "EnvironementConfig",
     "HyperParameterConfig",
     "LoaderConfig",
+    "ObservabilityConfig",
     "OptimizerConfig",
     "SchedulerConfig",
     "ServingConfig",

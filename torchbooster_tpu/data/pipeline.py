@@ -29,6 +29,7 @@ import collections
 import multiprocessing
 import queue
 import threading
+import time
 from concurrent.futures import ProcessPoolExecutor, ThreadPoolExecutor
 from typing import Any, Callable, Iterable, Iterator, Sequence
 
@@ -309,20 +310,36 @@ def prefetch_to_device(loader: Iterable, mesh=None, size: int = 2
     in flight on device ahead of the consumer (the pipelined analogue of
     pin_memory + async .to(device); SURVEY §3.3). A background thread
     feeds a bounded queue so decode/augment never blocks the step."""
+    from torchbooster_tpu.observability import get_registry
+
     if mesh is None:
         mesh = dist.get_mesh()
     q: queue.Queue = queue.Queue(maxsize=size)
     sentinel = object()
     stop = threading.Event()
     error: list[BaseException] = []
+    # pipeline telemetry: batches produced + how long the producer sat
+    # blocked on a full queue (≈0 when the device is the bottleneck —
+    # the healthy state; growing wait time means host decode is
+    # OUTRUNNING the chip and prefetch depth is just masking it, while
+    # a starved consumer shows up as the step-time histogram instead)
+    reg = get_registry()
+    batches_ctr = reg.counter("data_batches_total",
+                              "batches placed on device by prefetch")
+    wait_hist = reg.histogram("data_producer_wait_seconds",
+                              "producer time blocked on a full queue")
 
     def producer() -> None:
         try:
             for batch in loader:
                 placed = _place_global(batch, mesh)
+                t_wait = time.perf_counter()
                 while not stop.is_set():
                     try:
                         q.put(placed, timeout=0.1)
+                        batches_ctr.inc()
+                        wait_hist.observe(
+                            time.perf_counter() - t_wait)
                         break
                     except queue.Full:
                         continue

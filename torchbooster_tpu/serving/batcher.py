@@ -17,7 +17,13 @@ for the whole pool fail loudly at submit.
 Metrics mirror the training A/B machinery's spirit — every number a
 JSON-serializable scalar so serving rows land in the same logs:
 per-request latency (arrival → completion) and time-to-first-token,
-plus aggregate decode tokens/s over the busy window.
+plus aggregate decode tokens/s over the busy window, plus the
+admission/preemption counts. Every run also feeds the telemetry
+registry (``serving_*`` counters/histograms/gauges — the exporters'
+view of the same events) and is watched by a
+:class:`~torchbooster_tpu.observability.RecompileSentinel`, which
+turns the engine's zero-recompile contract into a runtime guard
+(``on_recompile`` selects ignore/warn/raise).
 """
 from __future__ import annotations
 
@@ -26,6 +32,11 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
+from torchbooster_tpu.observability import (
+    RecompileSentinel,
+    get_registry,
+)
+from torchbooster_tpu.observability.recompile import POLICIES
 from torchbooster_tpu.serving.engine import PagedEngine
 
 
@@ -68,7 +79,18 @@ class ContinuousBatcher:
     while idle before an arrival; a frozen clock with a future arrival
     would wait forever)."""
 
-    def __init__(self, engine: PagedEngine, clock=time.perf_counter):
+    def __init__(self, engine: PagedEngine, clock=time.perf_counter,
+                 on_recompile: str = "warn"):
+        # the zero-recompile contract as a RUNTIME guard, not just a
+        # test assert: every run() watches the decode jit cache
+        # (observability/recompile.py); policy ignore | warn | raise —
+        # validated HERE so a YAML typo fails at build time, not deep
+        # inside the first run() after requests were accepted
+        if on_recompile not in POLICIES:
+            raise ValueError(
+                f"on_recompile={on_recompile!r}: expected one of "
+                f"{POLICIES}")
+        self.on_recompile = on_recompile
         self.engine = engine
         self.clock = clock
         # usable pool capacity in tokens (page 0 is the reserved null)
@@ -92,9 +114,29 @@ class ContinuousBatcher:
             return {"n_requests": 0, "new_tokens": 0, "elapsed_s": 0.0,
                     "decode_tok_s": 0.0, "total_tok_s": 0.0,
                     "latency_mean_s": 0.0, "latency_p95_s": 0.0,
-                    "ttft_mean_s": 0.0}
+                    "ttft_mean_s": 0.0,
+                    # stable key set: the preemption/admission counts
+                    # exist on EVERY return path, not just busy ones
+                    "n_admissions": 0, "n_preemptions": 0}
         for r in requests:
             self._check_fits(r)
+        reg = get_registry()
+        lat_hist = reg.histogram("serving_latency_seconds",
+                                 "request arrival -> completion")
+        ttft_hist = reg.histogram("serving_ttft_seconds",
+                                  "request arrival -> first token")
+        slots_gauge = reg.gauge("serving_slots_live",
+                                "occupied decode slots")
+        pages_gauge = reg.gauge("serving_pages_free",
+                                "free KV pages in the pool")
+        admissions = reg.counter("serving_admissions_total",
+                                 "prefills seated (re-admissions count)")
+        preemptions = reg.counter("serving_preemptions_total",
+                                  "youngest-victim preemptions")
+        retired = reg.counter("serving_retired_total",
+                              "sequences retired (EOS/max/horizon)")
+        tokens_ctr = reg.counter("serving_decode_tokens_total",
+                                 "tokens produced by decode steps")
         queue = sorted(requests, key=lambda r: r.arrival)
         slots: dict[int, Request] = {}
         admit_order: list[int] = []          # oldest-first live slots
@@ -102,11 +144,17 @@ class ContinuousBatcher:
         now = lambda: self.clock() - t0
         decoded = 0
         decode_time = 0.0
+        n_admissions = 0
+        n_preemptions = 0
 
         def finish(slot: int) -> None:
             req = slots.pop(slot)
             admit_order.remove(slot)
             req.finished_at = now()
+            retired.inc()
+            lat_hist.observe(req.finished_at - req.arrival)
+            if req.first_token_at is not None:
+                ttft_hist.observe(req.first_token_at - req.arrival)
             self.engine.retire(slot)
 
         def maybe_stop(slot: int, token: int) -> None:
@@ -120,55 +168,85 @@ class ContinuousBatcher:
             if hit_eos or len(req.tokens) >= req.max_new_tokens or full:
                 finish(slot)
 
-        while queue or slots:
-            # --- admit every ARRIVED request that fits, FCFS ---
-            while queue and queue[0].arrival <= now():
-                req = queue[0]
-                seated = self.engine.admit(req.prompt)
-                if seated is None:
-                    break                     # no slot/pages: keep FCFS
-                queue.pop(0)
-                slot, first = seated
-                slots[slot] = req
-                admit_order.append(slot)
-                if req.admitted_at is None:
-                    req.admitted_at = now()
-                maybe_stop(slot, first)       # prefill's token is #1
-            if not slots:
-                if queue:                     # idle until next arrival
-                    wait = queue[0].arrival - now()
-                    if wait > 0:
-                        time.sleep(min(wait, 0.05))
-                continue
-            # --- grow: every live slot's next write page must exist;
-            # starved slots preempt the YOUNGEST live request ---
-            starved = self.engine.grow_slots()
-            while starved:
-                victim = admit_order[-1]
-                req = slots.pop(victim)
-                admit_order.remove(victim)
-                self.engine.retire(victim)
-                # fold generated tokens into the prompt so it resumes
-                # from its full context on re-admission — only the
-                # NOT-yet-folded suffix: a second preemption would
-                # otherwise re-append tokens already in the prompt,
-                # duplicating context (prompt always holds base_len +
-                # folded tokens, so the folded count is its excess)
-                folded = len(req.prompt) - req.base_len
-                req.prompt = np.concatenate(
-                    [req.prompt,
-                     np.asarray(req.tokens[folded:], np.int32)])
-                queue.insert(0, req)
-                starved = self.engine.grow_slots() if slots else []
-            if not slots:
-                continue
-            # --- one compiled step over every slot ---
-            t_step = self.clock()
-            tokens = self.engine.step()
-            decode_time += self.clock() - t_step
-            decoded += len(slots)
-            for slot in list(slots):
-                maybe_stop(slot, int(tokens[slot]))
+        # expected compiles in the watched region: the decode step's
+        # very first compile is legitimate; anything after is a broken
+        # geometry contract (engine.py's zero-recompile design)
+        sentinel = RecompileSentinel(
+            lambda: self.engine.decode_compiles,
+            on_recompile=self.on_recompile,
+            expected=0 if self.engine.decode_compiles else 1,
+            name="serving_decode", registry=reg)
+        try:
+            # `with sentinel` (not manual enter/exit): an exception
+            # escaping the loop still closes the watch — the policy
+            # only fires on clean exits by design
+            with sentinel:
+                while queue or slots:
+                    # --- admit every ARRIVED request that fits, FCFS ---
+                    while queue and queue[0].arrival <= now():
+                        req = queue[0]
+                        seated = self.engine.admit(req.prompt)
+                        if seated is None:
+                            break             # no slot/pages: keep FCFS
+                        queue.pop(0)
+                        slot, first = seated
+                        slots[slot] = req
+                        admit_order.append(slot)
+                        n_admissions += 1
+                        admissions.inc()
+                        if req.admitted_at is None:
+                            req.admitted_at = now()
+                        maybe_stop(slot, first)   # prefill's token is #1
+                    slots_gauge.set(len(slots))
+                    pages_gauge.set(self.engine.tables.n_free_pages)
+                    if not slots:
+                        if queue:             # idle until next arrival
+                            wait = queue[0].arrival - now()
+                            if wait > 0:
+                                time.sleep(min(wait, 0.05))
+                        continue
+                    # --- grow: every live slot's next write page must
+                    # exist; starved slots preempt the YOUNGEST live
+                    # request ---
+                    starved = self.engine.grow_slots()
+                    while starved:
+                        victim = admit_order[-1]
+                        req = slots.pop(victim)
+                        admit_order.remove(victim)
+                        self.engine.retire(victim)
+                        # fold generated tokens into the prompt so it
+                        # resumes from its full context on re-admission
+                        # — only the NOT-yet-folded suffix: a second
+                        # preemption would otherwise re-append tokens
+                        # already in the prompt, duplicating context
+                        # (prompt always holds base_len + folded
+                        # tokens, so the folded count is its excess)
+                        folded = len(req.prompt) - req.base_len
+                        req.prompt = np.concatenate(
+                            [req.prompt,
+                             np.asarray(req.tokens[folded:], np.int32)])
+                        queue.insert(0, req)
+                        n_preemptions += 1
+                        preemptions.inc()
+                        starved = self.engine.grow_slots() if slots \
+                            else []
+                    if not slots:
+                        continue
+                    # --- one compiled step over every slot ---
+                    t_step = self.clock()
+                    tokens = self.engine.step()
+                    decode_time += self.clock() - t_step
+                    decoded += len(slots)
+                    tokens_ctr.inc(len(slots))
+                    for slot in list(slots):
+                        maybe_stop(slot, int(tokens[slot]))
+        finally:
+            # exception or not, the gauges land on engine truth at
+            # exit (an aborted run may leave seated slots — report
+            # them rather than freezing a stale mid-loop value in the
+            # Prometheus export forever); clean exits read 0 live
+            slots_gauge.set(len(slots))
+            pages_gauge.set(self.engine.tables.n_free_pages)
 
         elapsed = now()
         lat = [r.finished_at - r.arrival for r in requests]
@@ -183,6 +261,13 @@ class ContinuousBatcher:
             "latency_mean_s": round(float(np.mean(lat)), 4),
             "latency_p95_s": round(float(np.percentile(lat, 95)), 4),
             "ttft_mean_s": round(float(np.mean(ttft)), 4),
+            # previously invisible to callers: how often the
+            # youngest-preemption path actually fired, and how many
+            # seatings (INCLUDING re-admissions after preemption) the
+            # trace cost — the registry's serving_* counters carry the
+            # same events for the exporters
+            "n_admissions": n_admissions,
+            "n_preemptions": n_preemptions,
         }
 
 

@@ -47,6 +47,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from torchbooster_tpu.models import layers as L
+from torchbooster_tpu.observability import span
 from torchbooster_tpu.models.gpt import (
     GPTConfig,
     _block_core,
@@ -282,13 +283,18 @@ class PagedEngine:
         padded = np.zeros(self.tables.pages_for(s0) * self.page_size,
                           np.int32)
         padded[:s0] = prompt_ids
-        first, ks, vs = self._prefill_jit(
-            self.params, jnp.asarray(padded)[None],
-            jnp.asarray(s0, jnp.int32), sub)
-        first = int(first[0])
-        page_ids = self.tables.admit(slot, len(prompt_ids), first)
-        pool_k, pool_v = self._write_jit(self.pool["k"], self.pool["v"],
-                                         ks, vs, jnp.asarray(page_ids))
+        # span: host wall time in the event log + the same label on a
+        # captured device trace (observability/spans.py); no-op when
+        # telemetry is disabled
+        with span("serving_prefill"):
+            first, ks, vs = self._prefill_jit(
+                self.params, jnp.asarray(padded)[None],
+                jnp.asarray(s0, jnp.int32), sub)
+            first = int(first[0])
+            page_ids = self.tables.admit(slot, len(prompt_ids), first)
+            pool_k, pool_v = self._write_jit(
+                self.pool["k"], self.pool["v"], ks, vs,
+                jnp.asarray(page_ids))
         self.pool = {"k": pool_k, "v": pool_v}
         return slot, first
 
@@ -315,12 +321,13 @@ class PagedEngine:
                     "retire sequences at the cache horizon")
         self._rng, sub = jax.random.split(self._rng)
         args = self.tables.device_args()
-        tokens, pool_k, pool_v = self._decode_jit(
-            self.params, self.pool["k"], self.pool["v"],
-            args["tables"], args["lengths"], args["owner"],
-            args["page_pos"], args["active"], args["last_ids"], sub)
-        self.pool = {"k": pool_k, "v": pool_v}
-        tokens = np.asarray(tokens)
+        with span("decode_step"):
+            tokens, pool_k, pool_v = self._decode_jit(
+                self.params, self.pool["k"], self.pool["v"],
+                args["tables"], args["lengths"], args["owner"],
+                args["page_pos"], args["active"], args["last_ids"], sub)
+            self.pool = {"k": pool_k, "v": pool_v}
+            tokens = np.asarray(tokens)
         for slot in np.flatnonzero(active):
             self.tables.advance(int(slot), int(tokens[slot]))
         return tokens
@@ -331,8 +338,15 @@ class PagedEngine:
     @property
     def decode_compiles(self) -> int:
         """Compiled decode-step count — the zero-recompile contract's
-        observable (tests assert it stays 1 across slot churn)."""
+        observable (tests assert it stays 1 across slot churn; the
+        batcher's RecompileSentinel enforces it at runtime)."""
         return self._decode_jit._cache_size()
+
+    @property
+    def prefill_compiles(self) -> int:
+        """Compiled prefill count — bounded by the page-COUNT set
+        (``seq_len / page_size``), whatever prompt lengths arrive."""
+        return self._prefill_jit._cache_size()
 
 
 __all__ = ["PagedEngine"]

@@ -55,39 +55,51 @@ def boost(enable: bool = True) -> None:
     jax.config.update("jax_disable_jit", not enable)
 
 
-class trace:
-    """Profiler trace context (SURVEY §5.1: the reference constructs
-    torch profiler objects without entering them, ref utils.py:42-45 —
-    its NVTX story; here the real one): captures an XLA/TPU trace
-    viewable in TensorBoard or Perfetto.
-
-    >>> with utils.trace("/tmp/profile"):
-    ...     state, metrics = step(state, batch)
-
-    ``trace(path, annotate="step")`` also wraps the body in a named
-    TraceAnnotation so device ops group under one label."""
-
-    def __init__(self, path: str = "profile", annotate: str | None = None):
-        self.path = str(path)
-        self.annotate = annotate
-        self._annotation = None
-
-    def __enter__(self) -> "trace":
-        jax.profiler.start_trace(self.path)
-        if self.annotate:
-            self._annotation = jax.profiler.TraceAnnotation(self.annotate)
-            self._annotation.__enter__()
-        return self
-
-    def __exit__(self, *exc) -> None:
-        if self._annotation is not None:
-            self._annotation.__exit__(*exc)
-        jax.profiler.stop_trace()
+# Profiler helpers now live in the telemetry subsystem (the canonical
+# home: observability/spans.py unifies them with host spans + the
+# registry); re-exported here because ``utils.trace(...)`` is the
+# documented user surface since the seed.
+from torchbooster_tpu.observability.spans import annotate, trace  # noqa: E402,F401
 
 
-def annotate(name: str):
-    """Named trace region for host-side code (NVTX-range analogue)."""
-    return jax.profiler.TraceAnnotation(name)
+def instrument_step(step_fn: Callable, name: str = "train_step",
+                    registry: Any = None) -> Callable:
+    """Wrap a compiled ``(state, batch) -> (state, metrics)`` step with
+    telemetry: a per-call ``step_seconds`` histogram, a ``steps_total``
+    counter (``LogCallback`` derives steps/s from its deltas), and a
+    :func:`~torchbooster_tpu.observability.span` so the step groups
+    under one label in a captured trace.
+
+    Sync-free by construction: it times the HOST side of each call
+    (dispatch + whatever blocking the body itself does) and never
+    touches the result — with async dispatch the per-call number is
+    dispatch time, but the call *cadence* backpressures on the device
+    queue, so the histogram's steady-state mean converges to the true
+    device step time without a single added ``block_until_ready`` or
+    D2H read. When telemetry is disabled the wrapper is one attribute
+    check per call."""
+    import functools
+    import time as _time
+
+    from torchbooster_tpu.observability import get_registry, span
+
+    reg = registry if registry is not None else get_registry()
+    hist = reg.histogram("step_seconds",
+                         "host wall time per train-step dispatch")
+    count = reg.counter("steps_total", "train steps dispatched")
+
+    @functools.wraps(step_fn)
+    def wrapped(*args: Any, **kwargs: Any) -> Any:
+        if not reg.enabled:
+            return step_fn(*args, **kwargs)
+        t0 = _time.perf_counter()
+        with span(name, reg):
+            out = step_fn(*args, **kwargs)
+        hist.observe(_time.perf_counter() - t0, step=name)
+        count.inc(1, step=name)
+        return out
+
+    return wrapped
 
 
 def seed(value: int = 42, deterministic: bool = True) -> jax.Array:
@@ -378,7 +390,7 @@ def make_eval_step(loss_fn: Callable, has_aux: bool = True,
 
 
 __all__ = [
-    "TrainState", "annotate", "boost", "detach", "freeze", "iter_loader",
-    "make_step", "make_eval_step", "seed", "stack_dictionaries", "to_array",
-    "trace",
+    "TrainState", "annotate", "boost", "detach", "freeze",
+    "instrument_step", "iter_loader", "make_step", "make_eval_step",
+    "seed", "stack_dictionaries", "to_array", "trace",
 ]
