@@ -40,6 +40,11 @@ vs its dense-geometry control; BENCH_SERVE_REQUESTS/RATE/SLOTS/PAGE/
 PAGES/SEQ/CACHE_DTYPE shape it, BENCH_SKIP_SERVE skips);
 the obs sub-bench (telemetry-on vs telemetry-off A/B over the GPT
 step + recompile-sentinel verification; BENCH_SKIP_OBS skips);
+the comms sub-bench (gradient-sync A/B over the GPT step: implicit
+vs explicit fp32 vs int8 vs int8+zero1 — step time, modeled bytes,
+loss delta; BENCH_COMMS_VOCAB/LAYERS/DMODEL/HEADS/SEQ/BATCH/
+LOSS_STEPS shape it, BENCH_COMMS_HOST_DEVICES forces virtual CPU
+devices for real collectives off-chip, BENCH_SKIP_COMMS skips);
 BENCH_SKIP_COSTCHECK=1 drops the XLA cost-analysis FLOP cross-check
 (one extra AOT compile per checked bench);
 deadlines: BENCH_SUB_DEADLINE or BENCH_DEADLINE_<name>.
@@ -519,6 +524,107 @@ def bench_obs(steps: int) -> dict:
     }
 
 
+def bench_comms(steps: int) -> dict:
+    """Gradient-communication A/B on the GPT train step: implicit
+    (XLA's own fp32 psum) vs explicit fp32 vs int8 vs int8+ZeRO-1
+    (torchbooster_tpu/comms) over the mesh's data axes — step time,
+    modeled bytes moved per replica, and the int8-vs-fp32 loss delta
+    after a short training run.
+
+    On a multi-device backend (a pod slice, or CPU with
+    BENCH_COMMS_HOST_DEVICES=8 forcing virtual devices) the
+    collectives are real and the bytes ratio is the headline; on one
+    chip the sync degenerates (0 bytes) and the row prices the
+    quantize/dequantize compute overhead instead — both facts the
+    emitted ``comms_n_devices`` makes self-describing.
+
+    Geometry knobs: BENCH_COMMS_VOCAB/LAYERS/DMODEL/HEADS/SEQ/BATCH
+    (TPU defaults = the gpt bench's GPT-2 small; CPU defaults tiny —
+    the collectives, not the matmuls, are under test there);
+    BENCH_COMMS_LOSS_STEPS sizes the loss-parity run."""
+    from torchbooster_tpu import distributed as dist
+    from torchbooster_tpu.comms import make_grad_comms
+    from torchbooster_tpu.models.gpt import GPT, GPTConfig
+    from torchbooster_tpu.ops.losses import cross_entropy
+
+    on_tpu = jax.default_backend() not in ("cpu",)
+    cfg = GPTConfig(
+        vocab=int(os.environ.get("BENCH_COMMS_VOCAB",
+                                 50257 if on_tpu else 512)),
+        n_layers=int(os.environ.get("BENCH_COMMS_LAYERS",
+                                    12 if on_tpu else 2)),
+        d_model=int(os.environ.get("BENCH_COMMS_DMODEL",
+                                   768 if on_tpu else 128)),
+        n_heads=int(os.environ.get("BENCH_COMMS_HEADS",
+                                   12 if on_tpu else 4)),
+        seq_len=int(os.environ.get("BENCH_COMMS_SEQ",
+                                   1024 if on_tpu else 64)))
+    batch = int(os.environ.get("BENCH_COMMS_BATCH", 16 if on_tpu else 8))
+    mesh = dist.make_mesh("dp")
+    n_dev = mesh.devices.size
+
+    params = GPT.init(jax.random.PRNGKey(0), cfg)
+    n_params = sum(p.size for p in jax.tree.leaves(params))
+    tx = optax.adamw(1e-4)
+
+    def loss_fn(p, b, rng):
+        logits = GPT.apply(p, b["ids"], cfg)
+        return cross_entropy(logits[:, :-1].reshape(-1, cfg.vocab),
+                             b["ids"][:, 1:].reshape(-1)), {}
+
+    def make_batch(seed: int):
+        ids = np.random.RandomState(seed).randint(
+            0, cfg.vocab, (batch, cfg.seq_len)).astype(np.int32)
+        # learnable structure; the even-column slice is trimmed so odd
+        # BENCH_COMMS_SEQ values don't break the broadcast
+        odd = ids[:, 1::2]
+        odd[...] = (ids[:, ::2][:, :odd.shape[1]] + 1) % cfg.vocab
+        return dist.shard_batch({"ids": ids}, mesh)
+
+    data = make_batch(1)
+    arms = {"implicit": None,
+            "fp32": make_grad_comms(mesh, mode="fp32"),
+            "int8": make_grad_comms(mesh, mode="int8"),
+            "int8_zero1": make_grad_comms(mesh, mode="int8",
+                                          zero1=True)}
+    out: dict = {"comms_n_devices": n_dev, "comms_n_params": n_params}
+    for name, comms in arms.items():
+        fresh = jax.tree.map(jnp.array, params)
+        if comms is None:
+            state = TrainState.create(fresh, tx)
+            step = make_step(loss_fn, tx)
+        else:
+            state = comms.create_state(fresh, tx)
+            step = make_step(loss_fn, tx, comms=comms)
+            traffic = comms.step_traffic(n_params)
+            out[f"comms_mbytes_{name}"] = round(
+                traffic["total_bytes"] / 1e6, 3)
+        out[f"comms_step_s_{name}"] = round(
+            timed_steps(step, state, data, steps), 6)
+    if out.get("comms_mbytes_int8"):
+        out["comms_bytes_ratio_fp32_int8"] = round(
+            out["comms_mbytes_fp32"] / out["comms_mbytes_int8"], 2)
+
+    # loss-curve delta: same data stream, fp32 vs int8 wire
+    loss_steps = int(os.environ.get("BENCH_COMMS_LOSS_STEPS", 30))
+    finals = {}
+    for name in ("fp32", "int8"):
+        comms = arms[name]
+        state = comms.create_state(jax.tree.map(jnp.array, params), tx)
+        step = make_step(loss_fn, tx, comms=comms)
+        loss = None
+        for k in range(loss_steps):
+            state, metrics = step(state, make_batch(100 + k))
+            loss = metrics["loss"]
+        finals[name] = float(np.asarray(loss))
+    out["comms_loss_steps"] = loss_steps
+    out["comms_loss_fp32"] = round(finals["fp32"], 5)
+    out["comms_loss_int8"] = round(finals["int8"], 5)
+    out["comms_loss_delta_pct"] = round(
+        (finals["int8"] - finals["fp32"]) / finals["fp32"] * 100, 3)
+    return out
+
+
 class _DecodeHeavyDataset:
     """Synthetic stand-in for a real image corpus: every __getitem__
     zlib-decompresses a stored blob and runs numpy dtype/normalize work
@@ -908,6 +1014,18 @@ def _run_sub(name: str, deadline: int,
 
 def _sub_main(name: str) -> None:
     """Child-side entry: compute one fragment, print one JSON line."""
+    if name == "comms":
+        # BENCH_COMMS_HOST_DEVICES=8: force virtual CPU devices so the
+        # comms collectives are real on a 1-chip (or chip-less) box.
+        # Must land in XLA_FLAGS before the first backend touch — this
+        # child has not initialized a backend yet.
+        hosts = os.environ.get("BENCH_COMMS_HOST_DEVICES", "").strip()
+        if hosts and hosts != "0":
+            os.environ["XLA_FLAGS"] = (
+                os.environ.get("XLA_FLAGS", "")
+                + f" --xla_force_host_platform_device_count={hosts}"
+            ).strip()
+            os.environ["JAX_PLATFORMS"] = "cpu"
     if os.environ.get("JAX_PLATFORMS", "") == "cpu":
         # see main(): sitecustomize overrides the env var
         jax.config.update("jax_platforms", "cpu")
@@ -956,6 +1074,8 @@ def _sub_main(name: str) -> None:
         print(json.dumps(bench_serve()))
     elif name == "obs":
         print(json.dumps(bench_obs(max(4, steps // 4))))
+    elif name == "comms":
+        print(json.dumps(bench_comms(max(4, steps // 4))))
     elif name == "cifar_acc":
         print(json.dumps(bench_cifar_acc()))
     else:
@@ -1131,7 +1251,7 @@ def _deadline(name: str, default: int) -> int:
 # secondary sub-benches and their default deadlines, in run order
 _SECONDARY_BENCHES = (("gpt", 900), ("gpt_long", 1500), ("loader", 900),
                       ("unet", 900), ("decode", 1500), ("serve", 1800),
-                      ("obs", 900))
+                      ("obs", 900), ("comms", 900))
 
 
 def _driver_hold_budget() -> int:

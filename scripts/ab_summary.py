@@ -101,7 +101,8 @@ def main() -> None:
     # configs in the log but absent from METRICS (queue entries drift
     # in faster than this table — decode and gpt_chunked_b32 both did):
     # render them raw rather than silently dropping recorded evidence
-    multi_key = ("decode", "decode_int8", "cifar_acc")
+    multi_key = ("decode", "decode_int8", "cifar_acc", "comms",
+                 "comms_cpu8")
     for name in sorted(attempts):
         if name in METRICS or (name in multi_key and name in latest):
             continue  # multi-key ok rows print below; failures fall through
@@ -117,6 +118,29 @@ def main() -> None:
         if e:
             print(f"\n{name}:",
                   json.dumps(e.get("result", {}), indent=None))
+
+    # comms rows: bytes-moved + step-time deltas across the gradient
+    # sync arms, rendered as a compact sub-table (one row per arm)
+    for name in ("comms", "comms_cpu8"):
+        e = latest.get(name)
+        if e is None:
+            continue
+        r = e.get("result") or {}
+        base = r.get("comms_step_s_implicit")
+        print(f"\n{name} (N={r.get('comms_n_devices', '?')} replicas, "
+              f"{r.get('comms_n_params', '?')} params; int8-vs-fp32 "
+              f"loss delta {r.get('comms_loss_delta_pct', '?')}% after "
+              f"{r.get('comms_loss_steps', '?')} steps):")
+        print("| arm | step s | vs implicit | grad-sync MB/replica |")
+        print("|---|---|---|---|")
+        for arm in ("implicit", "fp32", "int8", "int8_zero1"):
+            dt = r.get(f"comms_step_s_{arm}")
+            if dt is None:
+                continue
+            delta = (f"{(dt / base - 1) * 100:+.1f}%"
+                     if base and arm != "implicit" else "—")
+            mb = r.get(f"comms_mbytes_{arm}", "—")
+            print(f"| {arm} | {dt} | {delta} | {mb} |")
 
 
 if __name__ == "__main__":

@@ -49,6 +49,10 @@ HOT_PATHS = (
     "torchbooster_tpu/serving/",
     "torchbooster_tpu/observability/",
     "torchbooster_tpu/data/pipeline.py",
+    # the gradient-sync hook runs INSIDE the compiled step and its
+    # byte counters on the step cadence — one stray host sync there
+    # serializes every dispatch
+    "torchbooster_tpu/comms/",
 )
 
 

@@ -96,6 +96,13 @@ QUEUE: list[tuple[str, str, dict, int]] = [
     # huggingface_hub's 5-retry backoff before the local fallback
     ("cifar_acc", "cifar_acc",
      {"HF_HUB_OFFLINE": "1", "HF_DATASETS_OFFLINE": "1"}, 1800),
+    # gradient-comms A/B (torchbooster_tpu/comms): on the 1-chip rig
+    # the on-chip row prices the explicit-sync + quantize compute
+    # overhead at N=1 (bytes degenerate to 0); the cpu8 row forces 8
+    # virtual host devices so the int8/zero1 collectives are REAL and
+    # the bytes-ratio + loss-delta claims are measured, not modeled
+    ("comms", "comms", {}, 1200),
+    ("comms_cpu8", "comms", {"BENCH_COMMS_HOST_DEVICES": "8"}, 1500),
     ("gpt_chunked_b32", "gpt",
      {"BENCH_GPT_CHUNKED": "1", "BENCH_GPT_BATCH": "32"}, 1200),
     # the r4 chunked-head win, applied at the length where it should

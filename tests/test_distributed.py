@@ -37,6 +37,24 @@ def test_parse_mesh_spec():
         dist.parse_mesh_spec("", 8)
 
 
+def test_parse_mesh_spec_rejects_duplicate_axes():
+    """Duplicate axis names must fail HERE with the spec named, not
+    fall through to an opaque Mesh axis-collision error."""
+    with pytest.raises(ValueError, match=r"repeats axis.*dp"):
+        dist.parse_mesh_spec("dp:2,dp:4", 8)
+    with pytest.raises(ValueError, match="repeats axis"):
+        dist.parse_mesh_spec("dp:2,tp:2,dp", 8)
+
+
+def test_parse_mesh_spec_rejects_non_positive_sizes():
+    with pytest.raises(ValueError, match="non-positive"):
+        dist.parse_mesh_spec("dp:0", 8)
+    with pytest.raises(ValueError, match="non-positive"):
+        dist.parse_mesh_spec("dp:2,tp:-4", 8)
+    with pytest.raises(ValueError, match="non-integer"):
+        dist.parse_mesh_spec("dp:two", 8)
+
+
 def test_make_mesh_and_batch_sharding():
     mesh = dist.make_mesh("dp:2,tp:4")
     assert mesh.shape == {"dp": 2, "tp": 4}

@@ -85,8 +85,36 @@ def test_save_restore_roundtrip(tmp_path):
     np.testing.assert_array_equal(
         np.asarray(restored["state"].params["w"]), np.arange(4.0))
     assert int(restored["state"].step) == 0
-    assert restored["scheduler"]["step_count"] == 1
+    # load_state_dict objects come back LIVE, progress applied
+    assert restored["scheduler"] is sched
+    assert restored["scheduler"].step_count == 1
     assert int(restored["epoch"]) == 2
+
+
+def test_scheduler_checkpoint_roundtrip(tmp_path):
+    """Regression (ISSUE 3 satellite): scheduler progress must survive
+    save → restore without the caller hand-reapplying the payload —
+    the restored object IS a live scheduler at the saved step, with
+    its lr re-derived from the schedule."""
+    schedule = CycleScheduler(lr=1.0, n_iter=20, warmup=5)
+    sched = BaseScheduler(schedule)
+    for _ in range(7):
+        sched.step()
+    lr_at_7 = sched.lr
+
+    cb = SaveCallback(every=1, n_iter=20, root=tmp_path)
+    cb.save(7, scheduler=sched)
+
+    fresh = BaseScheduler(CycleScheduler(lr=1.0, n_iter=20, warmup=5))
+    assert fresh.step_count == 0 and fresh.lr != lr_at_7
+    restored = cb.restore(like={"scheduler": fresh})
+    assert restored["scheduler"] is fresh
+    assert fresh.step_count == 7
+    assert fresh.lr == pytest.approx(lr_at_7)
+    # and stepping continues from where training left off
+    sched.step()
+    fresh.step()
+    assert fresh.lr == pytest.approx(sched.lr)
 
 
 def test_restore_missing_returns_none(tmp_path):

@@ -173,8 +173,14 @@ class SaveCallback(BaseCallback):
         ``like`` is a template ``{key: object}`` matching what was
         saved; array leaves are restored with the template's sharding —
         which is what makes resume work unchanged on a different mesh
-        size. Returns None when no checkpoint exists (so user code can
-        write ``state = cb.restore(like=...) or fresh_state``).
+        size. Template objects with a ``load_state_dict`` (the host
+        adapters — ``scheduler.BaseScheduler`` et al) get the restored
+        payload loaded back INTO them and come back as the live
+        object, closing the save→restore round-trip that previously
+        dropped scheduler progress (the saved ``step_count`` came back
+        as a bare dict the caller had to re-apply by hand). Returns
+        None when no checkpoint exists (so user code can write
+        ``state = cb.restore(like=...) or fresh_state``).
         """
         if step is None:
             step = self.latest_step()
@@ -185,7 +191,13 @@ class SaveCallback(BaseCallback):
         template = None
         if like is not None:
             template = {k: state_dict(v) for k, v in like.items()}
-        return self.checkpointer.restore(self.path(step), template)
+        restored = self.checkpointer.restore(self.path(step), template)
+        if like is not None:
+            for key, obj in like.items():
+                if hasattr(obj, "load_state_dict") and key in restored:
+                    obj.load_state_dict(restored[key])
+                    restored[key] = obj
+        return restored
 
 
 __all__ = ["BaseCallback", "LogCallback", "SaveCallback", "state_dict"]

@@ -1,0 +1,234 @@
+"""Explicit gradient communication: quantized collectives + ZeRO-1.
+
+The reference's one parallelism strategy is data parallelism, and its
+TPU translation so far let pjit reduce gradients *implicitly* in fp32
+— correct, but invisible (no byte is accounted for) and unimprovable
+(the all-reduce always moves 4 bytes/param twice). This package makes
+the gradient synchronization an explicit, measured, compressible step:
+
+- :mod:`quantized` — drop-in replacements for the implicit fp32 psum
+  over the mesh's data axes, built with ``shard_map``: ``fp32`` (the
+  explicit control arm, byte-identical math), ``bf16`` (2× fewer
+  bytes), ``int8`` (~4× fewer: per-bucket scales, stochastic rounding,
+  and persistent error-feedback residuals carried in
+  :class:`~torchbooster_tpu.utils.TrainState` so compressed training
+  tracks the fp32 loss curve — EQuARX's recipe at the JAX level);
+- :mod:`zero` — cross-replica sharded optimizer update (ZeRO-1):
+  optimizer state lives as one flat array sharded over the data axes,
+  grads reduce-scatter, each replica updates only its shard, updated
+  params all-gather — optimizer-state HBM drops by the DP degree;
+- :mod:`accounting` — static per-step collective-traffic model
+  (per-collective byte breakdown) validated against the collectives
+  XLA actually compiled, exported as ``comms_bytes_total`` counters.
+
+Front door: a ``comms:`` YAML block
+(:class:`~torchbooster_tpu.config.CommsConfig`) builds a
+:class:`GradComms`; pass it to
+:func:`torchbooster_tpu.utils.make_step(comms=...)
+<torchbooster_tpu.utils.make_step>` and create states with
+:meth:`GradComms.create_state`. ``mode: implicit`` (the default)
+preserves today's behavior exactly; flipping the YAML line is the
+whole migration.
+
+Scope: explicit modes treat every data axis (``dp``/``fsdp``) as pure
+data parallelism — parameters must be replicated (the reference's DDP
+world). Meshes with live ``tp``/``sp``/``pp``/``ep`` axes keep the
+implicit path.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+MODES = ("implicit", "fp32", "bf16", "int8")
+
+
+@dataclasses.dataclass(frozen=True)
+class GradComms:
+    """The gradient-communication plan for one mesh: which wire format
+    the all-reduce uses, whether the optimizer update is ZeRO-1
+    sharded, and the quantization bucket size. Built by
+    :func:`make_grad_comms` / ``CommsConfig.make``; consumed by
+    ``utils.make_step(comms=...)``."""
+
+    mesh: Mesh
+    mode: str = "implicit"
+    zero1: bool = False
+    bucket_size: int = 512
+
+    @property
+    def axes(self) -> tuple[str, ...]:
+        from torchbooster_tpu.distributed import DATA_AXES
+
+        return tuple(a for a in DATA_AXES if a in self.mesh.axis_names)
+
+    @property
+    def n_shards(self) -> int:
+        n = 1
+        for a in self.axes:
+            n *= self.mesh.shape[a]
+        return n
+
+    @property
+    def active(self) -> bool:
+        """True when make_step must build the explicit path at all."""
+        return self.mode != "implicit" or self.zero1
+
+    def padded_size(self, n_params: int) -> int:
+        from torchbooster_tpu.comms.zero import padded_size
+
+        return padded_size(n_params, self.n_shards, self.bucket_size)
+
+    def init_state(self, params: Any) -> dict:
+        """Error-feedback residuals for ``TrainState.comms`` — int8 mode
+        carries one full-gradient residual per replica (phase 1) and,
+        when the reduced chunk is re-quantized for the grad all-gather
+        (i.e. not ZeRO-1, where params are gathered instead), one
+        chunk residual per replica (phase 2). Other modes carry
+        nothing ({})."""
+        if self.mode != "int8":
+            return {}
+        from torchbooster_tpu.comms.quantized import data_spec
+
+        flat_n = sum(int(leaf.size) for leaf in jax.tree.leaves(params))
+        padded = self.padded_size(flat_n)
+        sharding = NamedSharding(
+            self.mesh, data_spec(self.axes) if self.axes else P())
+        state = {"ef1": jax.device_put(
+            jnp.zeros((self.n_shards, padded), jnp.float32), sharding)}
+        if not self.zero1:
+            state["ef2"] = jax.device_put(
+                jnp.zeros((padded,), jnp.float32), sharding)
+        return state
+
+    def create_state(self, params: Any, tx: Any, rng: Any = 0,
+                     accumulate: bool = False, ema: bool = False):
+        """Build the :class:`~torchbooster_tpu.utils.TrainState` this
+        plan needs: flat dp-sharded optimizer state when ZeRO-1 is on
+        (1/N of adam's m/v per replica instead of N full copies),
+        error-feedback residuals in ``.comms`` for int8. Replaces
+        ``TrainState.create`` wherever a ``comms=`` plan is in play —
+        everything it returns checkpoints through ``SaveCallback``
+        unchanged (residuals and flat optimizer state are plain
+        arrays)."""
+        from torchbooster_tpu.comms import zero
+        from torchbooster_tpu.utils import TrainState
+
+        # defensive copy: the mesh placement below may ALIAS the
+        # caller's buffers, and the compiled step donates its state —
+        # without the copy, training would silently delete the
+        # caller's params (surfacing only when they build a second
+        # state from them, e.g. a restore template)
+        params = jax.tree.map(
+            lambda l: jnp.array(l) if hasattr(l, "ndim") else l, params)
+        if self.zero1:
+            # build the SHARDED flat state directly — routing through
+            # TrainState.create would first materialize the full
+            # replicated per-leaf tree (tx.init(params)), the exact
+            # peak-HBM footprint ZeRO-1 exists to avoid
+            state = TrainState.create(params, _noop_transform(),
+                                      rng=rng, accumulate=accumulate,
+                                      ema=ema)
+            state = state.replace(opt_state=zero.init_opt_state(
+                tx, params, self.mesh, self.axes, self.bucket_size))
+        else:
+            state = TrainState.create(params, tx, rng=rng,
+                                      accumulate=accumulate, ema=ema)
+        state = state.replace(comms=self.init_state(params))
+        # commit every remaining leaf to the mesh (replicated): the
+        # compiled step's outputs carry mesh shardings, so uncommitted
+        # inputs would hit a one-off layout recompile on step 2 —
+        # breaking the zero-recompile-after-warmup contract
+        replicated = NamedSharding(self.mesh, P())
+        placed_params = jax.tree.map(
+            lambda l: jax.device_put(l, replicated)
+            if hasattr(l, "ndim") else l, state.params)
+        state = state.replace(
+            params=placed_params,
+            step=jax.device_put(state.step, replicated),
+            rng=jax.device_put(state.rng, replicated))
+        if not self.zero1:
+            state = state.replace(opt_state=jax.tree.map(
+                lambda l: jax.device_put(l, replicated)
+                if hasattr(l, "ndim") else l, state.opt_state))
+        if state.grad_acc is not None:
+            state = state.replace(grad_acc=jax.tree.map(
+                lambda l: jax.device_put(l, replicated), state.grad_acc))
+        if state.ema is not None:
+            state = state.replace(ema=jax.tree.map(
+                lambda l: jax.device_put(l, replicated), state.ema))
+        return state
+
+    def step_traffic(self, n_params: int) -> dict:
+        from torchbooster_tpu.comms import accounting
+
+        return accounting.step_traffic(
+            n_params, self.n_shards, self.mode, self.zero1,
+            self.bucket_size)
+
+
+def _noop_transform() -> Any:
+    """A zero-footprint optax stand-in for TrainState.create when the
+    real state is built flat+sharded by zero.init_opt_state."""
+    import optax
+
+    return optax.identity()
+
+
+def make_grad_comms(mesh: Mesh, mode: str = "implicit",
+                    zero1: bool = False,
+                    bucket_size: int = 512) -> GradComms:
+    """Validated :class:`GradComms` constructor (CommsConfig.make's
+    workhorse). Explicit modes and ZeRO-1 require a pure
+    data-parallel mesh — every non-data axis must have size 1,
+    because the shard_map'd sync computes per-replica gradients
+    against fully replicated parameters."""
+    from torchbooster_tpu.distributed import DATA_AXES
+
+    if mode not in MODES:
+        raise ValueError(f"comms mode {mode!r}: expected one of {MODES}")
+    if bucket_size <= 0:
+        raise ValueError(f"comms bucket_size must be positive, "
+                         f"got {bucket_size}")
+    comms = GradComms(mesh=mesh, mode=mode, zero1=bool(zero1),
+                      bucket_size=int(bucket_size))
+    if comms.active:
+        model_axes = [a for a in mesh.axis_names
+                      if a not in DATA_AXES and mesh.shape[a] > 1]
+        if model_axes:
+            raise ValueError(
+                f"comms mode={mode!r}/zero1={zero1} needs a pure "
+                f"data-parallel mesh (params replicated); mesh has "
+                f"model-parallel axes {model_axes} — keep mode: "
+                f"implicit for tp/sp/pp/ep layouts")
+        if not comms.axes:
+            raise ValueError(
+                f"mesh {tuple(mesh.axis_names)} has no data axis "
+                f"(dp/fsdp); explicit comms has nothing to reduce over")
+    return comms
+
+
+from torchbooster_tpu.comms.accounting import (  # noqa: E402
+    step_traffic,
+    xla_collective_traffic,
+)
+from torchbooster_tpu.comms.quantized import (  # noqa: E402
+    dequantize,
+    quantize,
+    reduce_flat,
+)
+from torchbooster_tpu.comms.zero import (  # noqa: E402
+    init_opt_state,
+    opt_state_specs,
+    padded_size,
+)
+
+__all__ = [
+    "GradComms", "MODES", "dequantize", "init_opt_state",
+    "make_grad_comms", "opt_state_specs", "padded_size", "quantize",
+    "reduce_flat", "step_traffic", "xla_collective_traffic",
+]

@@ -1,0 +1,172 @@
+"""Static per-step collective-traffic model, validated against XLA.
+
+Every comms mode has a closed-form byte cost per replica per step —
+the whole point of making communication explicit is that this number
+is now *derivable* instead of observed. Conventions (ring algorithms,
+the TPU ICI default; bytes are per replica, the quantity that rides
+each link):
+
+- all-reduce of ``B`` bytes:        ``2 * (N-1)/N * B``
+- reduce-scatter / all-to-all:      ``(N-1)/N * B``   (B = full input)
+- all-gather:                       ``(N-1)/N * B``   (B = gathered out)
+
+The model is checked two ways: unit tests pin the formulas, and
+:func:`xla_collective_traffic` reads the collectives XLA **actually
+compiled** into a step (via ``Compiled.as_text()`` — the same
+artifact :func:`torchbooster_tpu.observability.device.cost_analysis`
+reads its scalars from, which on this backend reports only local
+bytes-accessed and so cannot price the wire) and prices them with the
+same conventions, so the static model and the compiled graph must
+agree within tolerance or the test fails.
+
+``utils.make_step(comms=...)`` exports the model through the
+``comms_bytes_total`` counter (labeled per collective) — one host-side
+integer add per step, no device sync.
+"""
+from __future__ import annotations
+
+import re
+from typing import Any
+
+__all__ = ["step_traffic", "record_step_traffic",
+           "xla_collective_traffic"]
+
+SCALE_BYTES = 4      # fp32 per-bucket scales
+GRAD_BYTES = 4       # fp32 gradients / master params
+
+_WIRE_BYTES = {"fp32": 4.0, "bf16": 2.0}
+
+
+def step_traffic(n_params: int, n_shards: int, mode: str,
+                 zero1: bool, bucket_size: int) -> dict:
+    """Per-replica bytes the gradient sync of one train step moves,
+    broken down per collective. ``n_params`` is the raw parameter
+    count; the model accounts for padding to
+    ``n_shards * bucket_size`` and, for int8, the fp32 scale
+    sidecars. ``implicit`` mode models the all-reduce XLA inserts on
+    its own (fp32 ring) so A/B deltas are computable before flipping
+    the YAML line."""
+    from torchbooster_tpu.comms.zero import padded_size
+
+    n = max(1, n_shards)
+    padded = padded_size(n_params, n, bucket_size)
+    frac = (n - 1) / n
+    per: dict[str, float] = {}
+    if mode in ("implicit", "fp32"):
+        if zero1 and mode == "fp32":
+            per["grad_reduce_scatter"] = frac * GRAD_BYTES * padded
+        else:
+            # implicit+zero1 still pays the full implicit all-reduce:
+            # the replicated grads are sliced locally, for free
+            per["grad_all_reduce"] = 2 * frac * GRAD_BYTES * padded
+    elif mode in _WIRE_BYTES or mode == "int8":
+        if mode == "int8":
+            payload = padded * (1 + SCALE_BYTES / bucket_size)
+        else:
+            payload = padded * _WIRE_BYTES[mode]
+        per["grad_all_to_all"] = frac * payload
+        if not zero1:
+            per["grad_all_gather"] = frac * payload
+    else:
+        raise ValueError(f"step_traffic: unknown mode {mode!r}")
+    if zero1:
+        per["param_all_gather"] = frac * GRAD_BYTES * padded
+    total = sum(per.values())
+    return {
+        "mode": mode, "zero1": bool(zero1), "n_shards": n,
+        "padded_params": padded,
+        "per_collective": {k: round(v, 1) for k, v in per.items()},
+        "total_bytes": round(total, 1),
+        "grad_bytes": round(total - per.get("param_all_gather", 0.0), 1),
+    }
+
+
+def record_step_traffic(traffic: dict, registry: Any = None) -> None:
+    """Land one step's modeled bytes on the ``comms_bytes_total``
+    counter, labeled per collective — the export path the YAML
+    ``observability:`` block drains."""
+    from torchbooster_tpu.observability import get_registry
+
+    reg = registry if registry is not None else get_registry()
+    if not reg.enabled:
+        return
+    counter = reg.counter(
+        "comms_bytes_total",
+        "modeled per-replica gradient-sync bytes moved")
+    for name, n_bytes in traffic["per_collective"].items():
+        counter.inc(n_bytes, collective=name, mode=traffic["mode"])
+
+
+# `= f32[2,4]{1,0} all-reduce(` / `= (s8[512]{0}, f32[4]{0}) all-to-all(`
+_COLLECTIVE = re.compile(
+    r"=\s*(\([^)]*\)|[a-z0-9]+\[[0-9,]*\]\S*)\s+"
+    r"(all-reduce|all-gather|all-to-all|reduce-scatter|"
+    r"collective-permute)(?:-start)?\(")
+_SHAPE = re.compile(r"([a-z][a-z0-9]*)\[([0-9,]*)\]")
+_GROUPS_LIST = re.compile(r"replica_groups=\{\{([0-9,]+)\}")
+_GROUPS_IOTA = re.compile(r"replica_groups=\[([0-9]+),([0-9]+)\]")
+
+_DTYPE_BYTES = {"pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2,
+                "f16": 2, "bf16": 2, "s32": 4, "u32": 4, "f32": 4,
+                "s64": 8, "u64": 8, "f64": 8}
+
+
+def _shape_bytes(text: str) -> float:
+    total = 0.0
+    for dtype, dims in _SHAPE.findall(text):
+        if dtype not in _DTYPE_BYTES:
+            continue
+        count = 1
+        for d in dims.split(","):
+            if d:
+                count *= int(d)
+        total += count * _DTYPE_BYTES[dtype]
+    return total
+
+
+def _group_size(line: str, default: int) -> int:
+    m = _GROUPS_LIST.search(line)
+    if m:
+        return len(m.group(1).split(","))
+    m = _GROUPS_IOTA.search(line)
+    if m:                     # iota v2: [num_groups, group_size]
+        return int(m.group(2))
+    return default
+
+
+def xla_collective_traffic(compiled: Any,
+                           default_group: int = 1) -> dict:
+    """Price the collectives in a compiled executable with the same
+    ring conventions as :func:`step_traffic`. Shapes in the
+    SPMD-partitioned module are per-replica, so: all-to-all and
+    all-reduce read their printed (local) shape directly; all-gather's
+    printed shape is the gathered output ((G-1)/G of it crosses the
+    wire); reduce-scatter's printed output is 1/G of the input it
+    reduced. Returns ``{"total_bytes", "ops": [...]}`` — the
+    validation anchor the accounting tests compare the static model
+    against."""
+    text = compiled.as_text() if hasattr(compiled, "as_text") else str(
+        compiled)
+    ops = []
+    total = 0.0
+    for match in _COLLECTIVE.finditer(text):
+        shape_text, kind = match.group(1), match.group(2)
+        line = text[match.start():text.find("\n", match.start())]
+        g = _group_size(line, default_group)
+        if g <= 1:
+            continue
+        payload = _shape_bytes(shape_text)
+        frac = (g - 1) / g
+        if kind == "all-reduce":
+            wire = 2 * frac * payload
+        elif kind == "reduce-scatter":
+            wire = frac * payload * g      # printed shape = output = in/G
+        elif kind == "collective-permute":
+            wire = payload
+        else:                              # all-gather / all-to-all
+            wire = frac * payload
+        total += wire
+        ops.append({"op": kind, "group": g,
+                    "payload_bytes": round(payload, 1),
+                    "wire_bytes": round(wire, 1)})
+    return {"total_bytes": round(total, 1), "ops": ops}

@@ -117,12 +117,18 @@ def parse_mesh_spec(spec: str, n_devices: int) -> tuple[tuple[str, ...], tuple[i
             continue
         if ":" in entry:
             name, _, size_text = entry.partition(":")
-            size = int(size_text)
+            name = name.strip()
+            try:
+                size = int(size_text)
+            except ValueError:
+                raise ValueError(
+                    f"mesh spec {spec!r}: axis {name!r} has "
+                    f"non-integer size {size_text.strip()!r}") from None
             if size <= 0:
                 raise ValueError(
-                    f"mesh spec {spec!r}: axis {name.strip()!r} has "
+                    f"mesh spec {spec!r}: axis {name!r} has "
                     f"non-positive size {size}")
-            names.append(name.strip())
+            names.append(name)
             sizes.append(size)
         else:
             if unsized is not None:
@@ -133,6 +139,12 @@ def parse_mesh_spec(spec: str, n_devices: int) -> tuple[tuple[str, ...], tuple[i
             unsized = len(sizes) - 1
     if not names:
         raise ValueError(f"empty mesh spec {spec!r}")
+    dupes = sorted({n for n in names if names.count(n) > 1})
+    if dupes:
+        # caught here, where the message can name the spec — letting it
+        # fall through produces an opaque Mesh axis-collision error
+        raise ValueError(
+            f"mesh spec {spec!r} repeats axis name(s) {dupes}")
     sized_product = int(np.prod([s for s in sizes if s > 0])) if any(
         s > 0 for s in sizes) else 1
     if unsized is not None:

@@ -204,6 +204,11 @@ class TrainState(struct.PyTreeNode):
     # diffusion/GAN-style training); updated inside the compiled step
     # when make_step(ema_decay=...) is set, checkpointed with the rest
     ema: Any = None
+    # gradient-communication state (int8 error-feedback residuals;
+    # see torchbooster_tpu.comms) — populated by
+    # GradComms.create_state, None/{} otherwise; checkpointed with
+    # the rest like every other leaf
+    comms: Any = None
 
     @classmethod
     def create(cls, params: Any, tx: optax.GradientTransformation,
@@ -236,6 +241,7 @@ def make_step(
     donate: bool = True,
     rules: Any = None,
     ema_decay: float | None = None,
+    comms: Any = None,
 ) -> Callable:
     """Build the jitted train step — the functional replacement for the
     reference's per-call ``utils.step`` (ref utils.py:204-252).
@@ -268,11 +274,38 @@ def make_step(
     params to the rule layout inside the compiled step — this pins the
     layout for models with no internal constrainers, so fsdp/tp cannot
     silently degrade to whatever XLA guesses.
+
+    Gradient communication: pass ``comms`` (a
+    :class:`~torchbooster_tpu.comms.GradComms`, built from the YAML
+    ``comms:`` block) to replace the implicit fp32 gradient psum with
+    an explicit sync over the data axes — ``mode: fp32`` (the control
+    arm), ``bf16``/``int8`` (quantized wire formats with
+    error-feedback residuals carried in ``state.comms``), and/or
+    ``zero1: true`` (optimizer state reduce-scattered across replicas,
+    updated params all-gathered). Build states with
+    ``comms.create_state(params, tx)``. Explicit modes require
+    replicated params (no ``rules``); ``zero1`` is incompatible with
+    ``accumulate_every > 1`` (the accumulator would need the same
+    scatter layout — keep the implicit path there). The returned step
+    exports its modeled per-collective bytes through the
+    ``comms_bytes_total`` counter when telemetry is enabled.
     """
     accumulate = accumulate_every > 1
 
     if rules is not None and mesh is None:
         raise ValueError("make_step(rules=...) needs mesh= as well")
+    explicit = comms is not None and comms.mode != "implicit"
+    zero1 = bool(comms is not None and comms.zero1)
+    if (explicit or zero1) and rules is not None:
+        raise ValueError(
+            "make_step(comms=...) explicit modes / zero1 need fully "
+            "replicated params — rules= is the model-parallel path; "
+            "use comms mode: implicit with it")
+    if zero1 and accumulate:
+        raise ValueError(
+            "comms zero1 does not compose with accumulate_every > 1 "
+            "(the accumulator would need the scatter layout); "
+            "accumulate on the implicit path instead")
 
     def _pin(tree: Any) -> Any:
         """Constrain a param-shaped pytree to the rule layout."""
@@ -295,7 +328,7 @@ def make_step(
         batch_cast = batch if compute_dtype is None else _cast(batch)
 
         if compute_dtype is None:
-            grad_fn = jax.value_and_grad(loss_fn, has_aux=has_aux)
+            diff_fn = loss_fn
         else:
             # mixed precision, TPU-style: fp32 master params, bf16
             # compute — the whole fwd+bwd runs on the MXU in bf16 (cast
@@ -304,13 +337,49 @@ def make_step(
             def cast_loss_fn(params: Any, batch: Any, rng: jax.Array):
                 return loss_fn(_cast(params), batch, rng)
 
-            grad_fn = jax.value_and_grad(cast_loss_fn, has_aux=has_aux)
-        if has_aux:
-            (loss, aux), grads = grad_fn(state.params, batch_cast, step_rng)
+            diff_fn = cast_loss_fn
+        comms_state = state.comms
+        if explicit:
+            # per-replica fwd+bwd under shard_map, then the explicit
+            # sync in the configured wire format; with zero1 the sync
+            # stops at the reduce-scatter and grads come back as this
+            # replica's flat chunk (torchbooster_tpu.comms.quantized)
+            from torchbooster_tpu.comms.quantized import (
+                value_and_grad_sync)
+
+            (loss, aux), grads, comms_state = value_and_grad_sync(
+                diff_fn, state.params, state.comms or {}, batch_cast,
+                step_rng, comms, has_aux=has_aux, scatter=zero1)
         else:
-            loss, grads = grad_fn(state.params, batch_cast, step_rng)
-            aux = {}
-        grads = _pin(grads)
+            grad_fn = jax.value_and_grad(diff_fn, has_aux=has_aux)
+            if has_aux:
+                (loss, aux), grads = grad_fn(state.params, batch_cast,
+                                             step_rng)
+            else:
+                loss, grads = grad_fn(state.params, batch_cast, step_rng)
+                aux = {}
+        grads = grads if zero1 else _pin(grads)
+
+        if zero1:
+            # cross-replica sharded weight update: local optimizer
+            # shard + updated-param all-gather (comms.zero); clipping
+            # happens inside (global norm via scalar psum)
+            from torchbooster_tpu.comms.zero import sharded_update
+
+            params, opt_state = sharded_update(
+                tx, comms, clip, grads, state.opt_state, state.params,
+                scattered=explicit)
+            ema = state.ema
+            if ema_decay is not None and ema is not None:
+                d = jnp.minimum(ema_decay,
+                                (1.0 + state.step) / (10.0 + state.step))
+                ema = jax.tree.map(lambda e, p: e * d + (1.0 - d) * p,
+                                   ema, params)
+            new_state = state.replace(
+                params=params, opt_state=opt_state,
+                step=state.step + 1, rng=rng, ema=ema,
+                comms=comms_state)
+            return new_state, {"loss": loss, **aux}
 
         boundary = (state.step + 1) % accumulate_every == 0
         if accumulate:
@@ -358,7 +427,7 @@ def make_step(
 
         new_state = state.replace(
             params=_pin(params), opt_state=opt_state, step=state.step + 1,
-            rng=rng, grad_acc=grad_acc, ema=ema)
+            rng=rng, grad_acc=grad_acc, ema=ema, comms=comms_state)
         metrics = {"loss": loss, **aux}
         return new_state, metrics
 
@@ -366,7 +435,46 @@ def make_step(
     # state/batch inputs via jit's inference; with rules, _pin holds
     # grads and updated params to the declared layout inside the step.
     donate_argnums = (0,) if donate else ()
-    return jax.jit(step_fn, donate_argnums=donate_argnums)
+    jitted = jax.jit(step_fn, donate_argnums=donate_argnums)
+    if comms is None:
+        return jitted
+    return _instrument_comms(jitted, comms)
+
+
+def _instrument_comms(jitted: Callable, comms: Any) -> Callable:
+    """Export the step's modeled per-collective bytes through the
+    ``comms_bytes_total`` counter. Host-side constants only (the
+    traffic model is static per compiled step) — one dict walk per
+    call when telemetry is on, a single attribute check when off. The
+    jit cache handle passes through so RecompileSentinel keeps
+    working on the wrapped step."""
+    import functools
+
+    from torchbooster_tpu.observability import get_registry
+
+    cache: dict[str, Any] = {}
+
+    @functools.wraps(jitted)
+    def stepped(state: Any, batch: Any) -> Any:
+        reg = get_registry()
+        if reg.enabled and "traffic" not in cache:
+            # param count read BEFORE the call: the step donates its
+            # state, so these buffers are gone afterwards
+            n_params = sum(
+                int(leaf.size) for leaf in jax.tree.leaves(state.params)
+                if hasattr(leaf, "size"))
+            cache["traffic"] = comms.step_traffic(n_params)
+        out = jitted(state, batch)
+        if reg.enabled and "traffic" in cache:
+            from torchbooster_tpu.comms.accounting import (
+                record_step_traffic)
+
+            record_step_traffic(cache["traffic"], reg)
+        return out
+
+    stepped._cache_size = jitted._cache_size  # type: ignore[attr-defined]
+    stepped.lower = jitted.lower              # type: ignore[attr-defined]
+    return stepped
 
 
 def make_eval_step(loss_fn: Callable, has_aux: bool = True,
