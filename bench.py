@@ -38,6 +38,12 @@ BENCH_DECODE_BATCH/NEW/CACHES shape it, BENCH_SKIP_DECODE skips);
 the serve sub-bench (continuous batching through the paged-KV engine
 vs its dense-geometry control; BENCH_SERVE_REQUESTS/RATE/SLOTS/PAGE/
 PAGES/SEQ/CACHE_DTYPE shape it, BENCH_SKIP_SERVE skips);
+the serve_prefix sub-bench (prefix cache + chunked prefill A/B:
+shared-system-prompt Poisson workload served cold vs cache-hit —
+TTFT, tokens/s, hit rate, prefill chunks/compiles, modeled prefill
+FLOPs saved; BENCH_SPFX_REQUESTS/RATE/SLOTS/PAGE/PAGES/SEQ/LAYERS/
+KV_HEADS/SHARED/CHUNK_PAGES/CACHE_DTYPE shape it,
+BENCH_SKIP_SERVE_PREFIX skips);
 the obs sub-bench (telemetry-on vs telemetry-off A/B over the GPT
 step + recompile-sentinel verification; BENCH_SKIP_OBS skips);
 the comms sub-bench (gradient-sync A/B over the GPT step: implicit
@@ -435,13 +441,11 @@ def bench_serve() -> dict:
                 for p, o, a in zip(prompts, out_lens, arrivals)]
 
     def warmup_trace():
-        # prefill compiles per page COUNT (engine pads to pages);
-        # warm every count a measured prompt OR a preemption
-        # re-prefill (prompt + generated-so-far) can reach, plus the
-        # decode step, before the measured run
-        counts = range(1, -(-warm_max // page) + 1)
-        return [Request(prompt=warm_ids[:min(c * page, warm_max)],
-                        max_new_tokens=2) for c in counts]
+        # chunked prefill compiles ONE chunk shape whatever lengths
+        # arrive (engine._chunk_fn — chunk position/length are traced
+        # values), so a single worst-case request warms both the
+        # chunk and the decode executables before the measured run
+        return [Request(prompt=warm_ids, max_new_tokens=2)]
 
     out = {}
     for kv in (0, 4):
@@ -464,6 +468,118 @@ def bench_serve() -> dict:
                 = m["latency_p95_s"]
     out[f"serve_pool_ratio{suffix}"] = round(
         slots * seq / ((n_pages - 1) * page), 2)
+    return out
+
+
+def bench_serve_prefix() -> dict:
+    """Prefix-cache + chunked-prefill serving A/B: a shared-system-
+    prompt Poisson workload — every prompt = one shared prefix
+    (``BENCH_SPFX_SHARED`` tokens, page-aligned, default 384) + a
+    unique 32..128-token suffix, outputs 16..64 — served through the
+    IDENTICAL engine geometry twice: ``prefix_cache`` OFF (the cold
+    control) vs ON with the shared prefix already resident, so every
+    measured request is a cache hit.
+
+    Chunked prefill (``BENCH_SPFX_CHUNK_PAGES`` pages per chunk,
+    default 2 = 128 tokens) is live in BOTH arms — one compiled chunk
+    shape regardless of the length mix (the emitted
+    ``*_prefill_compiles`` fields are the proof) — so the arms differ
+    ONLY in the chunks the hits skip: TTFT_cold pays
+    ``ceil(prompt/chunk)`` chunk steps, TTFT_hit only the suffix's.
+    At the defaults the shared prefix is ~75% of the prompt, so the
+    acceptance target (hit TTFT >= 2x lower at >= 50% shared tokens)
+    has headroom. Also emitted: decode tokens/s per arm (the hit arm
+    shares physical prefix pages across live slots), page hit rate,
+    prefill-chunk counts, and the modeled prefill FLOPs the hits
+    skipped (2·N per reused token — the prompt forward the cache
+    made unnecessary)."""
+    from torchbooster_tpu.models.gpt import GPT, GPTConfig
+    from torchbooster_tpu.serving import (ContinuousBatcher,
+                                          PagedEngine, Request)
+
+    n_req = int(os.environ.get("BENCH_SPFX_REQUESTS", 16))
+    rate = float(os.environ.get("BENCH_SPFX_RATE", 8.0))
+    slots = int(os.environ.get("BENCH_SPFX_SLOTS", 8))
+    page = int(os.environ.get("BENCH_SPFX_PAGE", 64))
+    n_pages = int(os.environ.get("BENCH_SPFX_PAGES", 96))
+    seq = int(os.environ.get("BENCH_SPFX_SEQ", 2048))
+    n_layers = int(os.environ.get("BENCH_SPFX_LAYERS", 12))
+    kv = int(os.environ.get("BENCH_SPFX_KV_HEADS", 4))
+    shared_len = int(os.environ.get("BENCH_SPFX_SHARED", 384))
+    chunk_pages = int(os.environ.get("BENCH_SPFX_CHUNK_PAGES", 2))
+    cache_dtype = os.environ.get("BENCH_SPFX_CACHE_DTYPE") or None
+    suffix = f"_{cache_dtype}" if cache_dtype else ""
+
+    # page-aligned system prompt, capped so suffix + output always
+    # fit the cache horizon beside it (short-seq smoke runs via
+    # BENCH_SPFX_SEQ stay valid down to seq = 2*page): the cap keeps
+    # shared_len <= seq/2, so the one-full-page floor below needs
+    # seq >= 2*page or the shared prefix eats the whole horizon and
+    # the suffix/output math underflows — fail loudly instead
+    if seq < max(2 * page, 8):
+        raise ValueError(
+            f"BENCH_SPFX_SEQ ({seq}) must be >= 2*BENCH_SPFX_PAGE "
+            f"({2 * page}) and >= 8: the workload needs one shared "
+            "page plus suffix+output room beside it")
+    shared_len = max(min(shared_len, seq // 2) // page, 1) * page
+    room = seq - shared_len
+    suf_hi = max(3, min(129, room - 16))            # exclusive
+    suf_lo = min(32, suf_hi - 1)
+    out_hi = max(2, min(65, room - (suf_hi - 1)))   # exclusive
+    out_lo = min(16, out_hi - 1)
+    rs = np.random.RandomState(0)
+    sys_prompt = rs.randint(0, 50257, shared_len, dtype=np.int32)
+    arrivals = np.cumsum(rs.exponential(1.0 / rate, n_req))
+    suf_lens = rs.randint(suf_lo, suf_hi, n_req)
+    out_lens = rs.randint(out_lo, out_hi, n_req)
+    prompts = [np.concatenate(
+        [sys_prompt, rs.randint(0, 50257, int(n), dtype=np.int32)])
+        for n in suf_lens]
+
+    def trace():
+        return [Request(prompt=p, max_new_tokens=int(o),
+                        arrival=float(a))
+                for p, o, a in zip(prompts, out_lens, arrivals)]
+
+    cfg = GPTConfig(n_layers=n_layers, seq_len=seq, n_kv_heads=kv)
+    params = GPT.init(jax.random.PRNGKey(0), cfg)
+    n_params = sum(p.size for p in jax.tree.leaves(params))
+
+    out = {}
+    for arm, enabled in (("cold", False), ("hit", True)):
+        engine = PagedEngine(params, cfg, page_size=page,
+                             n_pages=n_pages, max_slots=slots,
+                             cache_dtype=cache_dtype,
+                             prefix_cache=enabled,
+                             prefill_chunk_pages=chunk_pages)
+        batcher = ContinuousBatcher(engine)
+        # warm the chunk + decode executables OUT of the measured
+        # TTFTs; on the hit arm this same request also makes the
+        # shared prefix resident, so every measured request hits
+        batcher.run([Request(
+            prompt=np.concatenate(
+                [sys_prompt, rs.randint(0, 50257, min(32, room - 2),
+                                        dtype=np.int32)]),
+            max_new_tokens=2)])
+        m = batcher.run(trace())
+        out[f"serve_prefix_ttft_{arm}_s{suffix}"] = m["ttft_mean_s"]
+        out[f"serve_prefix_tok_s_{arm}{suffix}"] = m["decode_tok_s"]
+        out[f"serve_prefix_chunks_{arm}{suffix}"] = m["n_prefill_chunks"]
+        out[f"serve_prefix_hit_rate_{arm}{suffix}"] = m["prefix_hit_rate"]
+        out[f"serve_prefix_prefill_compiles_{arm}{suffix}"] = \
+            engine.prefill_compiles
+        if enabled:
+            out[f"serve_prefix_hit_pages{suffix}"] = m["prefix_hit_pages"]
+            # prompt forward ≈ 2·N FLOPs/token: the prefill compute
+            # the mapped pages made unnecessary
+            out[f"serve_prefix_prefill_gflops_saved{suffix}"] = round(
+                2 * n_params * m["prefix_hit_pages"] * page / 1e9, 1)
+    cold = out[f"serve_prefix_ttft_cold_s{suffix}"]
+    hit = out[f"serve_prefix_ttft_hit_s{suffix}"]
+    out[f"serve_prefix_ttft_ratio{suffix}"] = round(
+        cold / max(hit, 1e-9), 2)
+    out[f"serve_prefix_shared_frac{suffix}"] = round(
+        shared_len / (shared_len + float(np.mean(suf_lens))), 3)
     return out
 
 
@@ -880,14 +996,18 @@ def _pid_alive(path: str) -> int | None:
         # alive but owned by another user — still a holder. But a
         # recycled pid landing on a foreign long-lived daemon would
         # read as live FOREVER (no self-heal), so bound it by sentinel
-        # age: any legitimate hold refreshes/releases well inside the
-        # driver's worst-case budget (~3h); same-uid holders never hit
-        # this branch.
+        # age. The cutoff is DERIVED from the driver's worst-case hold
+        # (_driver_hold_budget: probe + every sub-bench deadline +
+        # slack) rather than a constant, so env-extended deadlines
+        # (BENCH_SUB_DEADLINE / BENCH_DEADLINE_*) stretch the
+        # staleness window with the legitimate holds they authorize
+        # instead of silently re-enabling driver overlap (ADVICE r5);
+        # same-uid holders never hit this branch.
         try:
             age = time.time() - os.path.getmtime(path)
         except OSError:
             return None
-        return pid if age < 3 * 3600 else None
+        return pid if age < _driver_hold_budget() + 900 else None
     except OSError:
         return None
     return pid
@@ -1072,6 +1192,8 @@ def _sub_main(name: str) -> None:
         print(json.dumps(bench_decode()))
     elif name == "serve":
         print(json.dumps(bench_serve()))
+    elif name == "serve_prefix":
+        print(json.dumps(bench_serve_prefix()))
     elif name == "obs":
         print(json.dumps(bench_obs(max(4, steps // 4))))
     elif name == "comms":
@@ -1251,7 +1373,8 @@ def _deadline(name: str, default: int) -> int:
 # secondary sub-benches and their default deadlines, in run order
 _SECONDARY_BENCHES = (("gpt", 900), ("gpt_long", 1500), ("loader", 900),
                       ("unet", 900), ("decode", 1500), ("serve", 1800),
-                      ("obs", 900), ("comms", 900))
+                      ("serve_prefix", 1500), ("obs", 900),
+                      ("comms", 900))
 
 
 def _driver_hold_budget() -> int:

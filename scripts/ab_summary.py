@@ -102,7 +102,7 @@ def main() -> None:
     # in faster than this table — decode and gpt_chunked_b32 both did):
     # render them raw rather than silently dropping recorded evidence
     multi_key = ("decode", "decode_int8", "cifar_acc", "comms",
-                 "comms_cpu8")
+                 "comms_cpu8", "serve_prefix", "serve_prefix_int8")
     for name in sorted(attempts):
         if name in METRICS or (name in multi_key and name in latest):
             continue  # multi-key ok rows print below; failures fall through
@@ -118,6 +118,32 @@ def main() -> None:
         if e:
             print(f"\n{name}:",
                   json.dumps(e.get("result", {}), indent=None))
+
+    # serve_prefix rows: the prefix-cache A/B rendered as a cold-vs-
+    # hit sub-table (TTFT, hit rate, prefill chunks/compiles, tok/s)
+    for name in ("serve_prefix", "serve_prefix_int8"):
+        e = latest.get(name)
+        if e is None:
+            continue
+        r = e.get("result") or {}
+        sfx = "_int8" if name.endswith("int8") else ""
+        print(f"\n{name} (shared frac "
+              f"{r.get(f'serve_prefix_shared_frac{sfx}', '?')}, "
+              f"hit TTFT ratio "
+              f"{r.get(f'serve_prefix_ttft_ratio{sfx}', '?')}x, "
+              f"{r.get(f'serve_prefix_hit_pages{sfx}', '?')} hit pages "
+              f"~{r.get(f'serve_prefix_prefill_gflops_saved{sfx}', '?')}"
+              " GFLOP prefill saved):")
+        print("| arm | ttft s | decode tok/s | prefill chunks "
+              "| hit rate | prefill compiles |")
+        print("|---|---|---|---|---|---|")
+        for arm in ("cold", "hit"):
+            print(f"| {arm} "
+                  f"| {r.get(f'serve_prefix_ttft_{arm}_s{sfx}', '—')} "
+                  f"| {r.get(f'serve_prefix_tok_s_{arm}{sfx}', '—')} "
+                  f"| {r.get(f'serve_prefix_chunks_{arm}{sfx}', '—')} "
+                  f"| {r.get(f'serve_prefix_hit_rate_{arm}{sfx}', '—')} "
+                  f"| {r.get(f'serve_prefix_prefill_compiles_{arm}{sfx}', '—')} |")
 
     # comms rows: bytes-moved + step-time deltas across the gradient
     # sync arms, rendered as a compact sub-table (one row per arm)

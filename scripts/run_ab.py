@@ -88,6 +88,15 @@ QUEUE: list[tuple[str, str, dict, int]] = [
     # decode_int8 — the pair prices it in both cache layouts)
     ("serve_int8", "serve",
      {"BENCH_SERVE_CACHE_DTYPE": "int8"}, 1800),
+    # prefix cache + chunked prefill (the PR-4 tentpole A/B): the
+    # shared-system-prompt Poisson workload served cold vs with the
+    # prefix resident — cache-hit TTFT (target >= 2x lower at the
+    # default ~75% shared tokens), hit rate, prefill chunk counts,
+    # one-prefill-compile proof, and the modeled prefill FLOPs the
+    # hits skipped (bench.bench_serve_prefix)
+    ("serve_prefix", "serve_prefix", {}, 1800),
+    ("serve_prefix_int8", "serve_prefix",
+     {"BENCH_SPFX_CACHE_DTYPE": "int8"}, 1800),
     # recipe accuracy on chip (VERDICT r4 #3): the shipped ResNet
     # CIFAR recipe end to end, ref hyperparams, 20 epochs — real
     # CIFAR-10 if a binary release is under the dataset root (none in

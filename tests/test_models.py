@@ -269,12 +269,12 @@ def test_gpt_generate_bf16_cache_decisive_head_parity():
     """The NON-quantized bf16 cache path pins its numerics the same
     way the int8 path does (ADVICE r5): on a decisive-head model,
     bf16-compute cached decode matches the fp32 full-forward re-run
-    token for token. The cached path keeps softmax probs fp32 through
-    masking and casts them to the cache dtype only at the PV einsum
-    (an fp32 PV operand would make XLA materialize an fp32 copy of
-    the whole cache per step — the exact HBM tax decode is roofed
-    on), so this decisive-head parity is the guard that the bf16
-    probs cast cannot drift greedy decode."""
+    token for token. The cached path now keeps softmax probs fp32 all
+    the way THROUGH the PV einsum (they are the small operand; V
+    stays narrow in HBM and widens only in the dot's fused operand
+    read — the same bet the int8 path makes), so this decisive-head
+    parity guards the remaining bf16 cache rounding from drifting
+    greedy decode."""
     from torchbooster_tpu.models.gpt import GPT, GPTConfig
 
     cfg = GPTConfig(vocab=97, n_layers=2, d_model=32, n_heads=4,

@@ -287,6 +287,49 @@ def test_gpt_pipeline_tp_major_layout_skips_per_step_permute():
         GPT.apply(tp_params, ids, cfg, qkv_tp_major=True)
 
 
+def test_qkv_tp_major_marker_guards():
+    """ADVICE r5: qkv_to_tp_major stamps a ``_tp_major<tp>`` marker at
+    permute time and every consumer checks it — a double permute, an
+    inverse of the wrong (or no) permute, and canonical paths handed
+    permuted params all raise instead of silently scrambling
+    attention. All trace-time checks: no compiles."""
+    from torchbooster_tpu.models.gpt import GPT, GPTConfig, qkv_to_tp_major
+
+    cfg = GPTConfig(vocab=64, n_layers=2, d_model=32, n_heads=4,
+                    seq_len=16, n_kv_heads=2)
+    params = GPT.init(jax.random.PRNGKey(0), cfg)
+    ids = jax.random.randint(jax.random.PRNGKey(1), (2, 8), 0, 64)
+
+    tp_params = qkv_to_tp_major(params, cfg, tp_size=2)
+    assert any(k.startswith("_tp_major")
+               for k in tp_params["blocks"]["attn_qkv"])
+    # double permute is loud
+    with pytest.raises(ValueError, match="already tp-major"):
+        qkv_to_tp_major(tp_params, cfg, tp_size=2)
+    # inverting a permute that never happened / the wrong tp is loud
+    with pytest.raises(ValueError, match="never permuted"):
+        qkv_to_tp_major(params, cfg, tp_size=2, inverse=True)
+    with pytest.raises(ValueError, match="permuted for tp=2"):
+        qkv_to_tp_major(tp_params, cfg, tp_size=1, inverse=True)
+    # canonical paths reject permuted params outright (apply without
+    # the flag, generate, and the serving engine all share the check)
+    with pytest.raises(ValueError, match="tp-major"):
+        GPT.apply(tp_params, ids, cfg)
+    with pytest.raises(ValueError, match="tp-major"):
+        GPT.generate(tp_params, ids, cfg, n_new=2, temperature=0.0)
+    # the flag without the marker is loud on a real pp×tp mesh
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(2, 2, 2),
+                ("dp", "pp", "tp"))
+    with mesh:
+        with pytest.raises(ValueError, match="no _tp_major marker"):
+            jax.make_jaxpr(lambda p, i: GPT.apply(
+                p, i, cfg, mesh=mesh, qkv_tp_major=True))(params, ids)
+    # round trip restores a marker-free canonical tree
+    back = qkv_to_tp_major(tp_params, cfg, tp_size=2, inverse=True)
+    assert not any(k.startswith("_tp_major")
+                   for k in back["blocks"]["attn_qkv"])
+
+
 def test_gpt_pipeline_tp_major_resume_from_canonical_checkpoint():
     """A canonical single-device checkpoint (params + adam mu/nu)
     resumes onto a pp×tp mesh via qkv_state_to_tp_major: the optimizer

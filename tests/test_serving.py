@@ -3,11 +3,18 @@
 - paged decode matches the dense ``jit_generate`` path token-for-token
   on decisive-head greedy decode (bf16 AND int8 pages — the acceptance
   parity);
-- admitting/retiring sequences at runtime causes ZERO decode
-  recompiles after warmup (the jit cache-size observable);
-- block-table alloc/free invariants hold under randomized churn;
+- prefix-cache hits decode IDENTICAL tokens to the cold path (MHA+GQA,
+  bf16+int8 pages), including two LIVE slots sharing the same prefix
+  pages through the multi-lane decode sweep;
+- chunked prefill compiles exactly ONE executable whatever prompt
+  lengths arrive, and seat/retire/evict churn causes ZERO decode
+  recompiles after warmup (the jit cache-size observables);
+- block-table refcount/cache/free invariants hold under randomized
+  churn with eviction (refcounts never negative, every page exactly
+  one of referenced/cached/free);
 - the continuous batcher preserves per-request tokens through
-  admission waves and pool-pressure preemption.
+  admission waves, chunk-interleaved prefill, and pool-pressure
+  preemption.
 """
 import jax
 import jax.numpy as jnp
@@ -79,6 +86,99 @@ def test_paged_decode_matches_dense_mha():
     np.testing.assert_array_equal(np.asarray(want[0, 7:]), got)
 
 
+@pytest.mark.parametrize("compute_dtype,cache_dtype,kv", [
+    (jnp.float32, None, 2),
+    (jnp.bfloat16, None, 2),
+    (jnp.bfloat16, "int8", 2),     # the acceptance pair
+    (jnp.float32, None, 0),        # full-MHA cache width
+])
+def test_prefix_cache_hit_token_parity(compute_dtype, cache_dtype, kv):
+    """The tentpole acceptance parity: with ``prefix_cache`` enabled,
+    a request whose prompt prefix is resident (mapped pages, only the
+    tail re-prefilled) decodes IDENTICAL tokens to the same request
+    served cold — and both match dense ``generate`` — across MHA+GQA
+    and bf16+int8 pages. Covers a SECOND request sharing the prefix
+    but continuing with a different suffix (the shared-system-prompt
+    traffic shape)."""
+    from torchbooster_tpu.serving import PagedEngine
+
+    params, cfg = _decisive_model(n_kv_heads=kv)
+    rs = np.random.RandomState(0)
+    shared = rs.randint(0, 97, 8).astype(np.int32)     # 2 full pages
+    suf_a = rs.randint(0, 97, 3).astype(np.int32)
+    suf_b = rs.randint(0, 97, 3).astype(np.int32)
+    p_a = np.concatenate([shared, suf_a])
+    p_b = np.concatenate([shared, suf_b])
+    n_new = 6
+
+    def dense(prompt):
+        out = GPT.generate(params, jnp.asarray(prompt)[None], cfg,
+                           n_new=n_new, temperature=0.0,
+                           compute_dtype=compute_dtype,
+                           cache_dtype=cache_dtype)
+        return np.asarray(out)[0, len(prompt):]
+
+    engine = PagedEngine(params, cfg, page_size=4, n_pages=16,
+                         max_slots=2, cache_dtype=cache_dtype,
+                         compute_dtype=compute_dtype,
+                         prefix_cache=True, prefill_chunk_pages=1)
+    cold_a = _paged_tokens(engine, p_a, n_new)     # fills the cache
+    assert engine.prefix_hit_pages == 0
+    hot_a = _paged_tokens(engine, p_a, n_new)      # full-prefix hit
+    assert engine.prefix_hit_pages == 2            # both shared pages
+    hot_b = _paged_tokens(engine, p_b, n_new)      # shared-prefix hit
+    assert engine.prefix_hit_pages == 4
+    np.testing.assert_array_equal(dense(p_a), cold_a)
+    np.testing.assert_array_equal(cold_a, hot_a)
+    np.testing.assert_array_equal(dense(p_b), hot_b)
+    engine.tables.check()
+    assert engine.prefill_compiles == 1
+    assert engine.decode_compiles == 1
+
+
+def test_concurrent_prefix_sharing_decode_parity():
+    """TWO live slots share the same resident prefix pages DURING
+    decode (refcount 2 — the multi-lane sweep must serve one page to
+    both queries from the one pool read); each request's greedy
+    stream matches its dense reference."""
+    from torchbooster_tpu.serving import PagedEngine
+
+    params, cfg = _decisive_model()
+    rs = np.random.RandomState(1)
+    shared = rs.randint(0, 97, 8).astype(np.int32)
+    p_a = np.concatenate([shared, rs.randint(0, 97, 3).astype(np.int32)])
+    p_b = np.concatenate([shared, rs.randint(0, 97, 5).astype(np.int32)])
+    n_new = 6
+
+    def dense(prompt):
+        out = GPT.generate(params, jnp.asarray(prompt)[None], cfg,
+                           n_new=n_new, temperature=0.0,
+                           compute_dtype=jnp.float32)
+        return np.asarray(out)[0, len(prompt):]
+
+    engine = PagedEngine(params, cfg, page_size=4, n_pages=16,
+                         max_slots=2, compute_dtype=jnp.float32,
+                         prefix_cache=True, prefill_chunk_pages=1)
+    prime = _paged_tokens(engine, p_a, 2)          # registers prefix
+    del prime
+    slot_a, first_a = engine.admit(p_a)
+    slot_b, first_b = engine.admit(p_b)
+    assert int(engine.tables.refcount.max()) >= 2, (
+        "live slots did not share the prefix pages")
+    toks_a, toks_b = [first_a], [first_b]
+    for _ in range(n_new - 1):
+        assert engine.grow_slots() == []
+        t = engine.step()
+        toks_a.append(int(t[slot_a]))
+        toks_b.append(int(t[slot_b]))
+    np.testing.assert_array_equal(dense(p_a), toks_a)
+    np.testing.assert_array_equal(dense(p_b), toks_b)
+    engine.retire(slot_a)
+    engine.retire(slot_b)
+    engine.tables.check()
+    assert engine.decode_compiles == 1
+
+
 def test_admit_retire_zero_recompiles():
     """The zero-recompile acceptance: after the first decode step
     compiles, slot churn — admits at NEW prompt lengths, retires,
@@ -114,10 +214,60 @@ def test_admit_retire_zero_recompiles():
         "slot churn recompiled the decode step")
 
 
+def test_chunked_prefill_one_compile_and_evict_churn_zero_recompiles():
+    """Chunked-prefill acceptance: whatever prompt-length mix arrives
+    — crossing chunk boundaries, cache hits starting mid-prompt,
+    preemption-style re-admits — the prefill executable count stays
+    at exactly 1 (the old page-count-shaped prefill compiled one per
+    count), and seat/retire/EVICT churn with the prefix cache on
+    leaves the decode executable count at exactly 1."""
+    from torchbooster_tpu.serving import PagedEngine
+
+    params, cfg = _decisive_model()                 # seq_len = 32
+    rng = np.random.RandomState(3)
+    shared = rng.randint(0, 97, 8).astype(np.int32)
+    # tight pool: 9 usable pages = 36 tokens; cached prefixes MUST
+    # evict to seat the unrelated prompts
+    engine = PagedEngine(params, cfg, page_size=4, n_pages=10,
+                         max_slots=2, compute_dtype=jnp.float32,
+                         prefix_cache=True, prefill_chunk_pages=2)
+    saw_cached = saw_evict = False
+    for n in (3, 5, 9, 13, 17):       # 1..3 chunks, partial + exact
+        prompt = (np.concatenate(
+            [shared, rng.randint(0, 97, n - 8).astype(np.int32)])
+            if n > 8 else rng.randint(0, 97, n).astype(np.int32))
+        slot, _ = engine.admit(prompt)
+        for _ in range(3):
+            assert engine.grow_slots() == []
+            engine.step()
+        engine.retire(slot)
+        cached = engine.tables.n_cached_pages
+        saw_cached |= cached > 0
+        engine.tables.check()
+    # unrelated full-width prompts force LRU eviction of the cache
+    before = engine.tables.n_cached_pages
+    slot, _ = engine.admit(rng.randint(0, 97, 17).astype(np.int32))
+    slot2, _ = engine.admit(rng.randint(0, 97, 13).astype(np.int32))
+    saw_evict = engine.tables.n_cached_pages < before
+    for _ in range(3):
+        assert engine.grow_slots() == []
+        engine.step()
+    engine.retire(slot)
+    engine.retire(slot2)
+    engine.tables.check()
+    assert saw_cached, "retire never cached a prefix"
+    assert saw_evict, "pool pressure never evicted the cache"
+    assert engine.prefill_compiles == 1, (
+        "prompt-length mix recompiled the prefill chunk")
+    assert engine.decode_compiles == 1, (
+        "seat/retire/evict churn recompiled the decode step")
+
+
 def test_block_tables_churn_invariants():
-    """Randomized admit/grow/advance/retire churn: structural
-    invariants (page 0 reserved, no double-assignment, no leaks,
-    owner/page_pos consistent) hold after every operation."""
+    """Randomized seat/grow/advance/retire churn (cache off — plain
+    alloc/free): structural invariants (page 0 reserved, no
+    double-assignment, no leaks, refs/page_pos consistent) hold after
+    every operation."""
     from torchbooster_tpu.serving import BlockTables, NULL_PAGE
 
     cfg = GPTConfig(seq_len=64)
@@ -130,7 +280,8 @@ def test_block_tables_churn_invariants():
         if roll < 0.35 and slot is not None:
             n = int(rng.randint(1, 12))
             if bt.pages_for(n) <= bt.n_free_pages:
-                bt.admit(slot, n, int(rng.randint(0, 97)))
+                bt.seat(slot, rng.randint(0, 97, n).astype(np.int32))
+                bt.activate(slot, int(rng.randint(0, 97)))
                 live[slot] = n
         elif roll < 0.8 and live:
             slot = int(rng.choice(sorted(live)))
@@ -149,20 +300,81 @@ def test_block_tables_churn_invariants():
     assert (bt.tables == NULL_PAGE).all()
 
 
+def test_block_tables_prefix_refcount_eviction_churn():
+    """Randomized churn WITH the prefix cache on (the tentpole's
+    page-lifetime acceptance): most prompts share a 3-page prefix, so
+    seats hit the index (refcount > 1 on shared pages while several
+    sharers are live), retires cache rather than free, and the tight
+    pool forces LRU eviction. ``check()`` after every op asserts
+    refcounts never go negative, every page is exactly one of
+    referenced/cached/free (no leaks), and index/page_pos stay
+    consistent."""
+    from torchbooster_tpu.serving import BlockTables, NULL_PAGE
+
+    cfg = GPTConfig(seq_len=64)
+    bt = BlockTables(cfg, page_size=4, n_pages=24, max_slots=4,
+                     prefix_cache=True)
+    rng = np.random.RandomState(11)
+    shared = rng.randint(0, 97, 12).astype(np.int32)   # 3 full pages
+    live = {}
+    hits = 0
+    saw_shared_live = False
+    saw_cached = False
+    for op in range(400):
+        roll = rng.rand()
+        slot = bt.free_slot()
+        if roll < 0.4 and slot is not None:
+            n_suffix = int(rng.randint(1, 16))
+            tail = rng.randint(0, 97, n_suffix).astype(np.int32)
+            prompt = (np.concatenate([shared, tail])
+                      if rng.rand() < 0.7 else tail)
+            if bt.pages_for(len(prompt)) <= bt.n_available_pages:
+                _, matched = bt.seat(slot, prompt)
+                hits += matched
+                bt.activate(slot, int(rng.randint(0, 97)))
+                bt.register_prefix(slot, prompt)
+                live[slot] = True
+        elif roll < 0.8 and live:
+            slot = int(rng.choice(sorted(live)))
+            if bt.lengths[slot] < cfg.seq_len and \
+                    bt.ensure_next_page(slot):
+                bt.advance(slot, int(rng.randint(0, 97)))
+        elif live:
+            slot = int(rng.choice(sorted(live)))
+            bt.retire(slot)
+            del live[slot]
+        saw_shared_live |= bool((bt.refcount > 1).any())
+        saw_cached |= bt.n_cached_pages > 0
+        bt.check()
+    assert hits > 0, "the shared prefix never hit the index"
+    assert saw_shared_live, "no page was ever shared by live slots"
+    assert saw_cached, "retire never left a cached prefix resident"
+    for slot in list(live):
+        bt.retire(slot)
+    bt.check()
+    # everything is reclaimable: free + cached covers the whole pool
+    assert bt.n_available_pages == bt.n_pages - 1
+    assert (bt.tables == NULL_PAGE).all()
+    assert (bt.refcount == 0).all()
+
+
 def test_block_tables_validation():
     from torchbooster_tpu.serving import BlockTables
 
     cfg = GPTConfig(seq_len=64)
     bt = BlockTables(cfg, page_size=4, n_pages=8, max_slots=2)
-    with pytest.raises(ValueError, match="prompt_len"):
-        bt.admit(0, 0, 1)
-    with pytest.raises(ValueError, match="prompt_len"):
-        bt.admit(0, 64, 1)
-    bt.admit(0, 5, 1)
+    with pytest.raises(ValueError, match="prompt"):
+        bt.seat(0, np.zeros(0, np.int32))
+    with pytest.raises(ValueError, match="prompt"):
+        bt.seat(0, np.zeros(64, np.int32))
+    bt.seat(0, np.arange(5, dtype=np.int32))
+    bt.activate(0, 1)
     with pytest.raises(ValueError, match="occupied"):
-        bt.admit(0, 3, 1)
+        bt.seat(0, np.arange(3, dtype=np.int32))
     with pytest.raises(RuntimeError, match="exhausted"):
-        bt.admit(1, 25, 1)              # 7 pages needed, 5 free
+        bt.seat(1, np.arange(25, dtype=np.int32))  # 7 needed, 5 free
+    with pytest.raises(ValueError, match="not seated"):
+        bt.activate(1, 1)
     bt.check()
 
 
@@ -288,6 +500,137 @@ def test_batcher_repeated_preemption_folds_each_token_once():
     assert engine.tables.n_free_pages == engine.n_pages - 1
 
 
+def test_admit_begin_matched_pages_not_counted_as_capacity():
+    """Review regression: the admission quick-check counts CACHED
+    matched pages as available capacity, but mapping them makes them
+    un-evictable — under an exactly-full pool the private-tail
+    allocation then comes up short. admit_begin must return None (the
+    request stays queued; seat's rollback re-caches the shares), not
+    crash the batcher with RuntimeError."""
+    from torchbooster_tpu.serving import PagedEngine
+
+    params, cfg = _decisive_model()
+    rs = np.random.RandomState(4)
+    shared = rs.randint(0, 97, 8).astype(np.int32)
+    engine = PagedEngine(params, cfg, page_size=4, n_pages=5,
+                         max_slots=2, compute_dtype=jnp.float32,
+                         prefix_cache=True, prefill_chunk_pages=1)
+    # cache the 2-page shared prefix (9-token prompt: 2 full + 1
+    # partial page; retire caches the 2 registered, frees the third)
+    slot, _ = engine.admit(np.concatenate(
+        [shared, rs.randint(0, 97, 1).astype(np.int32)]))
+    engine.retire(slot)
+    assert engine.tables.n_cached_pages == 2
+    # an unrelated live request consumes the remaining 2 free pages
+    slot_a, _ = engine.admit(rs.randint(0, 97, 7).astype(np.int32))
+    assert engine.tables.n_free_pages == 0
+    # 15-token prompt matching the cached prefix: pages_for=4,
+    # matched=2, and the other 2 exist neither free nor evictable
+    # once the matched pair is mapped
+    got = engine.admit_begin(np.concatenate(
+        [shared, rs.randint(0, 97, 7).astype(np.int32)]))
+    assert got is None
+    engine.tables.check()                  # rollback left no damage
+    assert engine.tables.n_cached_pages == 2
+    engine.retire(slot_a)
+    engine.tables.check()
+    # the rollback re-cached the shares TAIL-FIRST (like retire):
+    # evicting one page must shrink the chain from its tail — a
+    # decapitated chain would make the cached remainder unmatchable
+    assert engine.tables._evict(1) == 1
+    probe = np.concatenate([shared, rs.randint(0, 97, 1).astype(np.int32)])
+    assert engine.tables.match_prefix(probe) == 1
+    engine.tables.check()
+
+
+def test_batcher_prefix_cache_shared_prompt_end_to_end():
+    """Continuous batching with the prefix cache + chunked prefill on,
+    over the shared-system-prompt traffic shape (one shared prefix,
+    per-request suffixes, more requests than slots): every request
+    decodes the SAME greedy tokens as its single-sequence dense
+    reference, later admissions hit the cache, and the metrics dict
+    reports the hit/chunk stats with its stable key set."""
+    from torchbooster_tpu.serving import (ContinuousBatcher,
+                                          PagedEngine, Request)
+
+    params, cfg = _decisive_model()
+    rs = np.random.RandomState(2)
+    shared = rs.randint(0, 97, 8).astype(np.int32)
+    suffixes = [rs.randint(0, 97, n).astype(np.int32)
+                for n in (3, 5, 3, 7)]
+    prompts = [np.concatenate([shared, s]) for s in suffixes]
+    n_new = 6
+
+    def dense(prompt):
+        out = GPT.generate(params, jnp.asarray(prompt)[None], cfg,
+                           n_new=n_new, temperature=0.0,
+                           compute_dtype=jnp.float32)
+        return np.asarray(out)[0, len(prompt):]
+
+    engine = PagedEngine(params, cfg, page_size=4, n_pages=24,
+                         max_slots=2, compute_dtype=jnp.float32,
+                         prefix_cache=True, prefill_chunk_pages=1)
+    reqs = [Request(prompt=p, max_new_tokens=n_new) for p in prompts]
+    metrics = ContinuousBatcher(engine).run(reqs)
+    for p, r in zip(prompts, reqs):
+        np.testing.assert_array_equal(dense(p), r.tokens)
+    # the first admission wave (2 slots) is cold — the index fills
+    # when the first prefill completes; every later admission hits
+    # both shared pages
+    assert metrics["prefix_hit_pages"] >= 4
+    assert 0 < metrics["prefix_hit_rate"] <= 1
+    assert metrics["n_prefill_chunks"] > 0
+    assert engine.prefill_compiles == 1
+    assert engine.decode_compiles == 1
+    engine.tables.check()
+
+    # empty trace keeps the stable key set (incl. the new stats)
+    empty = ContinuousBatcher(engine).run([])
+    for key in ("n_prefill_chunks", "prefix_hit_pages",
+                "prefix_hit_rate"):
+        assert key in empty and key in metrics
+
+
+def test_batcher_cancels_stale_pending_prefills_from_aborted_run():
+    """A run() that aborts mid-loop (engine error, interrupt) can
+    leave the ENGINE holding half-prefilled slots — cross-run state
+    chunked prefill introduced. A fresh run() must cancel them up
+    front: their requests belong to the dead trace, and letting
+    prefill_step complete a slot this run never seated would KeyError
+    the batcher's filling dict (regression)."""
+    from torchbooster_tpu.serving import (ContinuousBatcher,
+                                          PagedEngine, Request)
+
+    params, cfg = _decisive_model()
+    rs = np.random.RandomState(4)
+    engine = PagedEngine(params, cfg, page_size=4, n_pages=16,
+                         max_slots=2, compute_dtype=jnp.float32,
+                         prefill_chunk_pages=1)
+    # simulate the aborted run: seat a request and advance its
+    # prefill PARTWAY, then abandon it (no batcher bookkeeping)
+    stale = rs.randint(0, 97, 9).astype(np.int32)   # 3 chunks
+    slot = engine.admit_begin(stale)
+    assert slot is not None
+    assert engine.prefill_step() is None            # 1 of 3 chunks
+    assert engine.has_pending
+    free_before = engine.tables.n_free_pages
+
+    prompt = rs.randint(0, 97, 5).astype(np.int32)
+    n_new = 4
+    want = np.asarray(GPT.generate(params, jnp.asarray(prompt)[None],
+                                   cfg, n_new=n_new, temperature=0.0,
+                                   compute_dtype=jnp.float32)
+                      )[0, len(prompt):]
+    req = Request(prompt=prompt, max_new_tokens=n_new)
+    ContinuousBatcher(engine).run([req])
+    np.testing.assert_array_equal(want, req.tokens)
+    assert not engine.has_pending
+    # the stale slot's pages were reclaimed, not leaked
+    assert engine.tables.n_free_pages > free_before
+    assert (engine.tables.lengths == 0).all()
+    engine.tables.check()
+
+
 def test_batcher_eos_and_fit_validation():
     from torchbooster_tpu.serving import (ContinuousBatcher,
                                           PagedEngine, Request)
@@ -346,6 +689,19 @@ def test_serving_config_builds_batcher():
     sc8 = ServingConfig(page_size=4, n_pages=16, max_slots=2,
                         cache_dtype="int8")
     assert sc8.make(params, cfg).engine.quantized
+
+    # the PR-4 serving keys reach the engine (prefix cache + chunked
+    # prefill); chunk size clamps to the slot's page budget
+    scp = ServingConfig(page_size=4, n_pages=16, max_slots=2,
+                        prefix_cache=True, prefill_chunk_pages=2)
+    eng = scp.make(params, cfg, compute_dtype=jnp.float32).engine
+    assert eng.prefix_cache and eng.tables.prefix_cache
+    assert eng.prefill_chunk_pages == 2
+    assert eng.chunk_tokens == 8
+    big = ServingConfig(page_size=4, n_pages=16, max_slots=2,
+                        prefill_chunk_pages=99)
+    assert big.make(params, cfg).engine.prefill_chunk_pages == \
+        eng.tables.max_pages_per_slot
 
     # the YAML observability policy reaches the runtime guard: make()
     # threads on_recompile into the batcher (default stays "warn")

@@ -634,6 +634,16 @@ class ServingConfig(BaseConfig):
     concurrent sequences, NOT to the worst case ``max_slots *
     seq_len`` (that is exactly the dense-cache behavior the pager
     exists to avoid; docs/performance.md "Serving" has the roofline).
+
+    ``prefix_cache: true`` keeps retired requests' full prompt pages
+    resident (refcounted, LRU-evicted under pool pressure) so a
+    request sharing a prompt prefix — the shared-system-prompt
+    traffic shape — maps those pages into its block table instead of
+    re-prefilling them (token-identical to the cold path).
+    ``prefill_chunk_pages`` sizes the prefill chunks the batcher
+    interleaves between decode steps: one compiled chunk shape serves
+    every prompt length, and decode latency stays bounded by one
+    chunk while long prompts stream in.
     """
 
     page_size: int = 64
@@ -643,6 +653,8 @@ class ServingConfig(BaseConfig):
     temperature: float = 0.0           # 0 = greedy
     top_k: int = 0                     # 0 = off
     top_p: float = 0.0                 # 0 = off
+    prefix_cache: bool = False         # share resident prompt prefixes
+    prefill_chunk_pages: int = 4       # chunked-prefill granularity
 
     def make(self, params: Any, model_cfg: Any,
              compute_dtype: Any = None,
@@ -666,7 +678,9 @@ class ServingConfig(BaseConfig):
             compute_dtype=(jnp.bfloat16 if compute_dtype is None
                            else compute_dtype),
             temperature=self.temperature,
-            top_k=self.top_k or None, top_p=self.top_p or None)
+            top_k=self.top_k or None, top_p=self.top_p or None,
+            prefix_cache=self.prefix_cache,
+            prefill_chunk_pages=self.prefill_chunk_pages)
         return ContinuousBatcher(engine, on_recompile=on_recompile)
 
 

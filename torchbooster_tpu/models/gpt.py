@@ -225,7 +225,7 @@ class GPT:
         and loud anywhere else (the canonical math would silently read
         scrambled columns)."""
         b, s = ids.shape
-        _check_pos(params, cfg)
+        _check_pos(params, cfg, allow_tp_major=qkv_tp_major)
         if s > cfg.seq_len:
             # jnp.take would silently fill NaN embeddings for positions
             # beyond the wpe table; shapes are static, so fail loudly
@@ -261,6 +261,18 @@ class GPT:
                 "axes — these params' qkv columns are rank-major and "
                 "the canonical paths would read them scrambled; "
                 "restore with qkv_to_tp_major(..., inverse=True)")
+        if qkv_tp_major:
+            # the stamp qkv_to_tp_major left must exist AND match this
+            # mesh's tp — a never-permuted tree or one permuted for a
+            # different tp would slice scrambled columns (ADVICE r5)
+            stamped = _qkv_tp_marker(params)
+            if stamped != mesh.shape["tp"]:
+                raise ValueError(
+                    "qkv_tp_major=True but params carry "
+                    + ("no _tp_major marker — qkv_to_tp_major was "
+                       "never applied" if stamped is None else
+                       f"a tp={stamped} marker")
+                    + f"; this mesh has tp={mesh.shape['tp']}")
         if use_pp:
             x, aux = _pipelined_blocks(params, x, cfg, mesh, remat,
                                        attn_impl, drop, layer_keys,
@@ -331,11 +343,16 @@ class GPT:
         return params["wte"]["table"]
 
 
-def _check_pos(params: dict, cfg: GPTConfig) -> None:
+def _check_pos(params: dict, cfg: GPTConfig,
+               allow_tp_major: bool = False) -> None:
     """A params tree from a rope checkpoint run with pos="learned" (or
     vice versa) would silently train/decode with NO position signal —
     the wpe add keys on the params, the rotation on the config. Make
-    the mismatch loud instead."""
+    the mismatch loud instead. Also rejects tp-major-permuted params
+    (the :func:`qkv_to_tp_major` marker, ADVICE r5) on every path that
+    reads canonical qkv columns — ``allow_tp_major=True`` only for the
+    pp×tp apply path, which checks the marker against the mesh
+    itself."""
     has_wpe = "wpe" in params
     if cfg.pos == "rope" and has_wpe:
         raise ValueError("params carry a wpe table but cfg.pos='rope' "
@@ -344,6 +361,37 @@ def _check_pos(params: dict, cfg: GPTConfig) -> None:
         raise ValueError("params have no wpe table but cfg.pos="
                          f"{cfg.pos!r} — was this checkpoint trained "
                          "with pos='rope'?")
+    stamped = _qkv_tp_marker(params)
+    if stamped is not None and not allow_tp_major:
+        raise ValueError(
+            f"params' qkv columns are tp-major for tp={stamped} "
+            "(qkv_to_tp_major) but this path reads the canonical "
+            "layout — attention would be silently scrambled; restore "
+            "with qkv_to_tp_major(..., inverse=True) or run the pp×tp "
+            "pipeline with qkv_tp_major=True")
+
+
+# key prefix of the layout marker qkv_to_tp_major stamps into the
+# attn_qkv block dict: f"{_TP_MAJOR_PREFIX}{tp_size}". The tp size
+# lives in the KEY (static tree structure — checkable under tracing
+# and immune to optimizer updates touching leaf VALUES); the value is
+# a zero (n_layers,) float so the leaf scans/shards/optimizes like any
+# other stacked block tensor.
+_TP_MAJOR_PREFIX = "_tp_major"
+
+
+def _qkv_tp_marker(params: dict) -> int | None:
+    """The tp size :func:`qkv_to_tp_major` stamped on these params, or
+    None for the canonical layout."""
+    qkv = params.get("blocks", {}).get("attn_qkv", {})
+    marks = [k for k in qkv if k.startswith(_TP_MAJOR_PREFIX)]
+    if not marks:
+        return None
+    if len(marks) > 1:
+        raise ValueError(
+            f"params carry multiple tp-major markers {sorted(marks)} — "
+            "corrupted layout bookkeeping")
+    return int(marks[0][len(_TP_MAJOR_PREFIX):])
 
 
 def _rope(x: jax.Array, positions: jax.Array,
@@ -406,9 +454,13 @@ def qkv_to_tp_major(params: dict, cfg: GPTConfig, tp_size: int,
     mirrors are non-zero needs :func:`qkv_state_to_tp_major` instead:
     permuting params alone would misalign adam mu/nu columns.
 
-    The caller must pass the SAME tp size the mesh will have — that
-    agreement cannot be checked here (no mesh yet) and a mismatch
-    scrambles the math, so it is part of the contract."""
+    The caller must pass the SAME tp size the mesh will have — the
+    permute stamps a ``_tp_major<tp>`` marker leaf into the attn_qkv
+    dict (ADVICE r5) and the pp×tp apply path checks it against the
+    mesh, so a mismatched, double, or missing permute raises instead
+    of silently scrambling attention; every canonical-layout path
+    (plain apply, generate, the serving engine) rejects marked params
+    outright."""
     import numpy as onp
 
     if cfg.n_heads % tp_size or cfg.kv_heads % tp_size:
@@ -418,6 +470,19 @@ def qkv_to_tp_major(params: dict, cfg: GPTConfig, tp_size: int,
         raise ValueError(
             f"qkv_to_tp_major needs n_heads ({cfg.n_heads}) and "
             f"kv_heads ({cfg.kv_heads}) divisible by tp ({tp_size})")
+    stamped = _qkv_tp_marker(params)
+    if inverse and stamped != tp_size:
+        raise ValueError(
+            f"qkv_to_tp_major(inverse=True, tp_size={tp_size}) on "
+            + ("params that were never permuted (no _tp_major marker)"
+               if stamped is None else
+               f"params permuted for tp={stamped}")
+            + " — inverting the wrong permutation scrambles attention")
+    if not inverse and stamped is not None:
+        raise ValueError(
+            f"params are already tp-major (tp={stamped}) — a second "
+            "permute would scramble the qkv columns; restore with "
+            "inverse=True first")
     perm = qkv_tp_permutation(cfg, tp_size)
     if inverse:
         perm = onp.argsort(perm)
@@ -425,6 +490,12 @@ def qkv_to_tp_major(params: dict, cfg: GPTConfig, tp_size: int,
     new_qkv = {"kernel": jnp.take(qkv["kernel"], perm, axis=2)}
     if "bias" in qkv:
         new_qkv["bias"] = jnp.take(qkv["bias"], perm, axis=1)
+    if not inverse:
+        # stacked (n_layers,) zeros: scans/shards/checkpoints like any
+        # block leaf, and the tp size rides in the KEY so optimizer
+        # updates to the value cannot erase the layout fact
+        new_qkv[f"{_TP_MAJOR_PREFIX}{tp_size}"] = jnp.zeros(
+            (qkv["kernel"].shape[0],), qkv["kernel"].dtype)
     return {**params,
             "blocks": {**params["blocks"], "attn_qkv": new_qkv}}
 
@@ -558,6 +629,10 @@ def _pipelined_blocks(params: dict, x: jax.Array, cfg: GPTConfig,
         def assign(path: tuple, leaf: Any) -> P:
             name = _path_str(path)
             layer, kind = name.split("/")[0], name.split("/")[-1]
+            if kind.startswith(_TP_MAJOR_PREFIX):
+                # the layout-marker leaf: stacked (n_layers,) zeros —
+                # layer axis over pp like every other block scalar
+                return P("pp")
             if layer in col:
                 return P("pp", None, t_ax) if kind == "kernel" \
                     else P("pp", t_ax)
@@ -796,7 +871,12 @@ def _grouped_cache_attention(q: jax.Array, cache_k, cache_v,
     whole cache per step (2× the HBM traffic decode is roofed on) or
     run the MXU in fp32 mode — narrow inputs +
     preferred_element_type=f32 is the native MXU contract (softmax
-    itself stays fp32). For the int8 cache the per-token scales FACTOR
+    itself stays fp32). One deliberate exception (ADVICE r5): on the
+    NON-quantized path the softmax probs stay fp32 into the PV dot —
+    probs are tiny next to the cache, V keeps its narrow HBM layout
+    and only widens in the dot's fused operand read, and the bf16
+    probs downcast was the one numerics loss the decisive-head bf16
+    parity test exists to guard. For the int8 cache the per-token scales FACTOR
     OUT of the dots: scores scale by s_k[token] after the QK dot, and
     s_v folds into the (small) probs tensor before the PV dot. The
     int8→dot-dtype convert is written to fuse into the dot's operand
@@ -840,8 +920,16 @@ def _grouped_cache_attention(q: jax.Array, cache_k, cache_v,
     if quantized:
         probs = probs * jnp.transpose(
             cv_s[..., 0], (0, 2, 1))[:, :, None, None, :]
-    o = jnp.einsum("bgrqk,bkgd->bqgrd", probs.astype(dot_t),
-                   cv.astype(dot_t),
+        probs = probs.astype(dot_t)
+        pv = cv.astype(dot_t)
+    else:
+        # probs stay fp32 into the PV dot (ADVICE r5 numerics pin):
+        # they are the SMALL operand — V is the one that must stay
+        # narrow in HBM, and its widening convert is written to fuse
+        # into the dot's operand read exactly like the int8 path's
+        # (keeping the cache stream at its native byte width)
+        pv = cv.astype(jnp.float32)
+    o = jnp.einsum("bgrqk,bkgd->bqgrd", probs, pv,
                    preferred_element_type=jnp.float32)
     if state:
         return o, m, l

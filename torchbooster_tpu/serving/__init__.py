@@ -5,13 +5,18 @@ already measured and tuned (docs/performance.md), and the decode
 roofline says the step time IS the cache bytes it streams. This
 package stops streaming dead bytes:
 
-- :mod:`kv_pages` — the fixed page pool + host-side block tables
-  (alloc/free without recompiles);
-- :mod:`engine` — prefill/decode split; ONE compiled decode step whose
-  signature depends only on pool geometry, with attention reading the
-  pool once per step (length-masked pages, online-softmax combine);
-- :mod:`batcher` — FCFS admission, preemption under pool pressure,
-  latency/tokens-per-second metrics.
+- :mod:`kv_pages` — the fixed page pool + host-side block tables with
+  REFCOUNTED pages and a prompt-prefix index (seat/retire/evict
+  without recompiles; retired prompts' prefixes stay resident and
+  shareable, LRU-evicted under pressure);
+- :mod:`engine` — chunked prefill/decode split; ONE compiled decode
+  step whose signature depends only on pool geometry, with attention
+  reading the pool once per step and routing shared pages to every
+  referencing slot (length-masked pages, online-softmax combine), and
+  ONE compiled prefill chunk serving every prompt length;
+- :mod:`batcher` — FCFS admission, one prefill chunk interleaved per
+  decode step, preemption under pool pressure,
+  latency/TTFT/tokens-per-second + prefix-hit metrics.
 
 Entry points: build a :class:`~torchbooster_tpu.serving.engine.
 PagedEngine` (or via ``ServingConfig.make`` from YAML), wrap it in a

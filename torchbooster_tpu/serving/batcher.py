@@ -3,24 +3,30 @@
 The reference framework has no serving story at all (DDP training
 only); this is the front door of the serving subsystem. Requests queue
 FCFS; whenever a slot AND enough pages are free, the next ARRIVED
-request prefills into a slot; every loop iteration runs one compiled
-decode step over all live slots; sequences retire on EOS, on their
-``max_new_tokens``, or at the ``seq_len`` cache horizon — all without
-touching the compiled step (kv_pages.py fixed-shape tables).
+request is SEATED (its prompt pages allocated, cached prefix pages
+mapped in) and its prefill streams in as fixed-size chunks — the
+scheduling loop issues ONE prefill chunk, then one compiled decode
+step over all live slots, per iteration, so a long arriving prompt
+adds at most one chunk of latency between decode steps instead of
+stalling them for its whole prefill. Sequences retire on EOS, on
+their ``max_new_tokens``, or at the ``seq_len`` cache horizon — all
+without touching the compiled steps (kv_pages.py fixed-shape tables).
 
 Pool pressure is handled by PREEMPTION, not failure: when a growing
-sequence cannot get its next page, the youngest live request is pushed
-back to the FRONT of the queue with its generated tokens folded into
-its prompt (it re-prefills later and keeps going); requests too big
-for the whole pool fail loudly at submit.
+sequence cannot get its next page (even after evicting cached
+prefixes), the youngest seated request — mid-prefill or decoding — is
+pushed back to the FRONT of the queue with its generated tokens
+folded into its prompt (it re-prefills later and keeps going);
+requests too big for the whole pool fail loudly at submit.
 
 Metrics mirror the training A/B machinery's spirit — every number a
 JSON-serializable scalar so serving rows land in the same logs:
 per-request latency (arrival → completion) and time-to-first-token,
 plus aggregate decode tokens/s over the busy window, plus the
-admission/preemption counts. Every run also feeds the telemetry
-registry (``serving_*`` counters/histograms/gauges — the exporters'
-view of the same events) and is watched by a
+admission/preemption counts, prefill-chunk count, and prefix-cache
+hit stats. Every run also feeds the telemetry registry (``serving_*``
+counters/histograms/gauges — the exporters' view of the same events)
+and is watched by a
 :class:`~torchbooster_tpu.observability.RecompileSentinel`, which
 turns the engine's zero-recompile contract into a runtime guard
 (``on_recompile`` selects ignore/warn/raise).
@@ -115,11 +121,23 @@ class ContinuousBatcher:
                     "decode_tok_s": 0.0, "total_tok_s": 0.0,
                     "latency_mean_s": 0.0, "latency_p95_s": 0.0,
                     "ttft_mean_s": 0.0,
-                    # stable key set: the preemption/admission counts
-                    # exist on EVERY return path, not just busy ones
-                    "n_admissions": 0, "n_preemptions": 0}
+                    # stable key set: the preemption/admission/prefill
+                    # stats exist on EVERY return path, not just busy
+                    # ones
+                    "n_admissions": 0, "n_preemptions": 0,
+                    "n_prefill_chunks": 0, "prefix_hit_pages": 0,
+                    "prefix_hit_rate": 0.0}
         for r in requests:
             self._check_fits(r)
+        # a previous run that aborted mid-loop (engine error,
+        # KeyboardInterrupt) can leave the engine holding
+        # half-prefilled slots — cross-run state chunked prefill
+        # introduced (the old synchronous admit could not). Their
+        # requests belong to the dead trace: cancel them up front so
+        # this run's prefill_step never completes a slot it never
+        # seated.
+        for slot in self.engine.pending_slots:
+            self.engine.retire(slot)
         reg = get_registry()
         lat_hist = reg.histogram("serving_latency_seconds",
                                  "request arrival -> completion")
@@ -130,25 +148,37 @@ class ContinuousBatcher:
         pages_gauge = reg.gauge("serving_pages_free",
                                 "free KV pages in the pool")
         admissions = reg.counter("serving_admissions_total",
-                                 "prefills seated (re-admissions count)")
+                                 "requests seated (re-admissions count)")
         preemptions = reg.counter("serving_preemptions_total",
                                   "youngest-victim preemptions")
         retired = reg.counter("serving_retired_total",
                               "sequences retired (EOS/max/horizon)")
         tokens_ctr = reg.counter("serving_decode_tokens_total",
                                  "tokens produced by decode steps")
+        hit_pages_ctr = reg.counter(
+            "serving_prefix_hit_pages_total",
+            "prompt pages served from the prefix cache")
+        chunks_ctr = reg.counter("serving_prefill_chunks_total",
+                                 "prefill chunks issued")
+        hit_rate_gauge = reg.gauge(
+            "serving_prefix_hit_rate",
+            "prefix-cache page hit rate over this run")
         queue = sorted(requests, key=lambda r: r.arrival)
-        slots: dict[int, Request] = {}
-        admit_order: list[int] = []          # oldest-first live slots
+        live: dict[int, Request] = {}        # decoding
+        filling: dict[int, Request] = {}     # seated, prefill streaming
+        admit_order: list[int] = []          # oldest-first seated slots
         t0 = self.clock()
         now = lambda: self.clock() - t0
         decoded = 0
         decode_time = 0.0
         n_admissions = 0
         n_preemptions = 0
+        hits0 = self.engine.prefix_hit_pages
+        lookups0 = self.engine.prefix_lookup_pages
+        chunks0 = self.engine.prefill_chunks
 
         def finish(slot: int) -> None:
-            req = slots.pop(slot)
+            req = live.pop(slot)
             admit_order.remove(slot)
             req.finished_at = now()
             retired.inc()
@@ -158,7 +188,7 @@ class ContinuousBatcher:
             self.engine.retire(slot)
 
         def maybe_stop(slot: int, token: int) -> None:
-            req = slots[slot]
+            req = live[slot]
             req.tokens.append(int(token))
             if req.first_token_at is None:
                 req.first_token_at = now()
@@ -181,37 +211,48 @@ class ContinuousBatcher:
             # escaping the loop still closes the watch — the policy
             # only fires on clean exits by design
             with sentinel:
-                while queue or slots:
-                    # --- admit every ARRIVED request that fits, FCFS ---
+                while queue or live or filling:
+                    # --- seat every ARRIVED request that fits, FCFS;
+                    # cached prefix pages map in here, so a hit's
+                    # remaining prefill is only its private tail ---
                     while queue and queue[0].arrival <= now():
                         req = queue[0]
-                        seated = self.engine.admit(req.prompt)
-                        if seated is None:
-                            break             # no slot/pages: keep FCFS
+                        slot = self.engine.admit_begin(req.prompt)
+                        if slot is None:
+                            break         # no slot/pages: keep FCFS
                         queue.pop(0)
-                        slot, first = seated
-                        slots[slot] = req
+                        filling[slot] = req
                         admit_order.append(slot)
                         n_admissions += 1
                         admissions.inc()
                         if req.admitted_at is None:
                             req.admitted_at = now()
-                        maybe_stop(slot, first)   # prefill's token is #1
-                    slots_gauge.set(len(slots))
+                    # --- ONE prefill chunk per iteration, interleaved
+                    # with decode: long prompts stream in while the
+                    # live slots keep producing tokens ---
+                    if self.engine.has_pending:
+                        done = self.engine.prefill_step()
+                        if done is not None:
+                            slot, first = done
+                            live[slot] = filling.pop(slot)
+                            maybe_stop(slot, first)  # prefill's token
+                    slots_gauge.set(len(live))
                     pages_gauge.set(self.engine.tables.n_free_pages)
-                    if not slots:
-                        if queue:             # idle until next arrival
+                    if not live:
+                        if not filling and queue:
+                            # idle until the next arrival
                             wait = queue[0].arrival - now()
                             if wait > 0:
                                 time.sleep(min(wait, 0.05))
                         continue
                     # --- grow: every live slot's next write page must
-                    # exist; starved slots preempt the YOUNGEST live
-                    # request ---
+                    # exist (cached prefixes evict first); starved
+                    # slots preempt the YOUNGEST seated request ---
                     starved = self.engine.grow_slots()
                     while starved:
                         victim = admit_order[-1]
-                        req = slots.pop(victim)
+                        req = (live.pop(victim) if victim in live
+                               else filling.pop(victim))
                         admit_order.remove(victim)
                         self.engine.retire(victim)
                         # fold generated tokens into the prompt so it
@@ -220,7 +261,9 @@ class ContinuousBatcher:
                         # preemption would otherwise re-append tokens
                         # already in the prompt, duplicating context
                         # (prompt always holds base_len + folded
-                        # tokens, so the folded count is its excess)
+                        # tokens, so the folded count is its excess;
+                        # a mid-prefill victim has no tokens and folds
+                        # nothing)
                         folded = len(req.prompt) - req.base_len
                         req.prompt = np.concatenate(
                             [req.prompt,
@@ -228,25 +271,31 @@ class ContinuousBatcher:
                         queue.insert(0, req)
                         n_preemptions += 1
                         preemptions.inc()
-                        starved = self.engine.grow_slots() if slots \
+                        starved = self.engine.grow_slots() if live \
                             else []
-                    if not slots:
+                    if not live:
                         continue
-                    # --- one compiled step over every slot ---
+                    # --- one compiled step over every live slot ---
                     t_step = self.clock()
                     tokens = self.engine.step()
                     decode_time += self.clock() - t_step
-                    decoded += len(slots)
-                    tokens_ctr.inc(len(slots))
-                    for slot in list(slots):
+                    decoded += len(live)
+                    tokens_ctr.inc(len(live))
+                    for slot in list(live):
                         maybe_stop(slot, int(tokens[slot]))
         finally:
             # exception or not, the gauges land on engine truth at
             # exit (an aborted run may leave seated slots — report
             # them rather than freezing a stale mid-loop value in the
             # Prometheus export forever); clean exits read 0 live
-            slots_gauge.set(len(slots))
+            slots_gauge.set(len(live))
             pages_gauge.set(self.engine.tables.n_free_pages)
+            hit_pages = self.engine.prefix_hit_pages - hits0
+            lookups = self.engine.prefix_lookup_pages - lookups0
+            n_chunks = self.engine.prefill_chunks - chunks0
+            hit_pages_ctr.inc(hit_pages)
+            chunks_ctr.inc(n_chunks)
+            hit_rate_gauge.set(hit_pages / max(lookups, 1))
 
         elapsed = now()
         lat = [r.finished_at - r.arrival for r in requests]
@@ -262,12 +311,16 @@ class ContinuousBatcher:
             "latency_p95_s": round(float(np.percentile(lat, 95)), 4),
             "ttft_mean_s": round(float(np.mean(ttft)), 4),
             # previously invisible to callers: how often the
-            # youngest-preemption path actually fired, and how many
+            # youngest-preemption path actually fired, how many
             # seatings (INCLUDING re-admissions after preemption) the
-            # trace cost — the registry's serving_* counters carry the
-            # same events for the exporters
+            # trace cost, and what the prefix cache + chunked prefill
+            # actually did — the registry's serving_* counters carry
+            # the same events for the exporters
             "n_admissions": n_admissions,
             "n_preemptions": n_preemptions,
+            "n_prefill_chunks": n_chunks,
+            "prefix_hit_pages": hit_pages,
+            "prefix_hit_rate": round(hit_pages / max(lookups, 1), 4),
         }
 
 

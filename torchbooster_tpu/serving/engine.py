@@ -1,21 +1,35 @@
-"""Continuous-batching decode engine over the paged KV cache.
+"""Continuous-batching decode engine over the paged, prefix-shared KV
+cache.
 
-Prefill/decode split:
+Prefill/decode split — both sides compile exactly ONCE:
 
-- **prefill** runs once per admitted request through the SAME
-  block path training uses (``models/gpt.py _prefill_forward`` —
-  ``_block_core`` + the attention dispatcher), produces the request's
-  first token, and scatters its K/V into the pages the block table
-  assigned;
+- **prefill** streams a request's prompt in through fixed-size
+  page-aligned CHUNKS (``prefill_chunk_pages`` pages each, issued
+  between decode steps by the batcher): each chunk runs the SAME block
+  math training uses (``models/gpt.py _block_core``), writes its K/V
+  into the pages the block table assigned, and attends its prior
+  context by gathering the slot's own pages back out of the pool —
+  two flash-style partials (prior pages + the intra-chunk causal
+  part) merged with the online-softmax combine. The chunk's shapes
+  depend only on (chunk size, pool geometry, model); prompt length,
+  chunk position, and page ids are traced VALUES, so one compiled
+  chunk serves every prompt length — killing the old
+  compile-per-page-count ``_prefill_fn`` — and a long prompt costs
+  many small chunks instead of one decode-stalling prefill. Requests
+  whose prompt prefix is resident in the page pool (kv_pages.py
+  prefix index) skip the matched pages' chunks entirely: the
+  cache-hit TTFT win is exactly the prefill compute not re-run.
 - **decode** is ONE jitted step over all ``max_slots`` slots: embed
   each slot's last token at its own depth, write this step's K/V into
-  each slot's current page, then attend by sweeping the page pool
-  once — every page computes a flash-style partial softmax of its
-  ``page_size`` tokens against its OWNING slot's query
-  (``_grouped_cache_attention(state=True)``, the same numerics core
+  each slot's current (always private) page, then attend by sweeping
+  the page pool once — every page computes a flash-style partial
+  softmax of its ``page_size`` tokens against the queries of EVERY
+  slot referencing it (``refs`` lanes: a prefix page shared by k
+  live requests serves all k from the one pool read;
+  ``_grouped_cache_attention(state=True)``, the same numerics core
   the dense ``jit_generate`` control runs), and per-slot results
-  combine across pages with the online-softmax merge
-  (``segment_max``/``segment_sum`` keyed by page owner).
+  combine across (page, lane) partials with the online-softmax merge
+  (``segment_max``/``segment_sum`` keyed by the lane's slot).
 
 Why the pool sweep is the length-aware read: the dense decode step
 streams ``max_slots × S_cache`` cache rows regardless of how many
@@ -23,20 +37,20 @@ tokens each slot holds; the sweep streams ``(n_pages - 1) ×
 page_size`` rows — the pool's USABLE capacity (the reserved null page
 is statically sliced out of the read), which the operator sizes to
 expected total occupancy — and free/partial pages contribute nothing
-but masked lanes. On an HBM-bound loop the read bytes ARE the step time, so
-tokens/s scales with pool-vs-dense bytes (the ``serve`` bench rows
-measure exactly this ratio; a dense-geometry control —
-``page_size=seq_len``, one page per slot — runs the SAME code at dense
-bytes).
+but masked lanes. Prefix sharing compounds it: k requests on one
+system prompt hold ONE copy of its pages, so the same pool holds more
+live requests. On an HBM-bound loop the read bytes ARE the step time
+(the ``serve`` bench rows measure the ratio; ``serve_prefix`` measures
+the cache-hit TTFT and the prefill FLOPs the hits skip; a
+dense-geometry control — ``page_size=seq_len``, one page per slot —
+runs the SAME code at dense bytes).
 
 The compiled step's signature depends only on pool geometry
-``(n_pages, page_size, max_slots)`` and the model config — admission
-and retirement change VALUES in fixed-shape tables (kv_pages.py), so
-slot churn after warmup causes ZERO recompiles (asserted in
-tests/test_serving.py via the jit cache size). Prefill pads prompts
-to whole pages and reads the last real token's logits at a traced
-offset, so it compiles once per page COUNT — at most
-``seq_len / page_size`` executables, whatever lengths arrive.
+``(n_pages, page_size, max_slots)`` and the model config — admission,
+retirement, and prefix-cache eviction change VALUES in fixed-shape
+tables (kv_pages.py), so slot churn after warmup causes ZERO
+recompiles (asserted in tests/test_serving.py via the jit cache
+size).
 """
 from __future__ import annotations
 
@@ -55,21 +69,36 @@ from torchbooster_tpu.models.gpt import (
     _grouped_cache_attention,
     _lm_head,
     _make_pick,
-    _prefill_forward,
     _quantize_kv,
 )
-from torchbooster_tpu.serving.kv_pages import BlockTables, make_pool
+from torchbooster_tpu.serving.kv_pages import (
+    NULL_PAGE,
+    BlockTables,
+    make_pool,
+)
 
 
 class PagedEngine:
-    """Single-compile continuous-batching decode over a paged KV pool.
+    """Single-compile continuous-batching decode over a paged KV pool
+    with an optional prompt-prefix cache.
 
-    ``admit``/``step``/``retire`` are the whole lifecycle; the
-    host-side batcher (serving/batcher.py) drives them. ``cache_dtype
-    ="int8"`` stores quantized pages (``_quantize_kv`` — the same
+    ``admit_begin``/``prefill_step``/``step``/``retire`` are the whole
+    lifecycle; the host-side batcher (serving/batcher.py) drives them,
+    interleaving one prefill chunk per decode step so long prompts
+    never stall in-flight decode. ``admit`` is the one-shot
+    convenience (seat + drain this request's chunks). ``cache_dtype=
+    "int8"`` stores quantized pages (``_quantize_kv`` — the same
     per-(token, head) scheme as the dense cache). ``temperature=0``
     decodes greedily; otherwise sampling follows ``_make_pick`` (the
     same filtering the dense path uses).
+
+    ``prefix_cache=True`` keeps retired requests' full prompt pages
+    resident (refcounted, LRU-evicted under pool pressure): a new
+    request whose prompt prefix matches maps those pages into its
+    block table and prefills only the tail — generated tokens are
+    IDENTICAL to the cold path (the pages hold bitwise the same K/V a
+    re-prefill would write). ``prefill_chunk_pages`` sizes the chunk
+    (clamped to the slot's page budget).
 
     ``dense_control=True`` is the A/B geometry: one ``seq_len``-wide
     page per slot, so the identical compiled step streams the dense
@@ -83,17 +112,23 @@ class PagedEngine:
                  compute_dtype: Any = jnp.bfloat16,
                  temperature: float = 0.0, top_k: int | None = None,
                  top_p: float | None = None,
-                 rng: jax.Array | None = None):
+                 rng: jax.Array | None = None,
+                 prefix_cache: bool = False,
+                 prefill_chunk_pages: int = 4):
         if cfg.seq_len % page_size:
             # a last partial page per slot would shift page_pos math;
             # geometry is static, so fail loudly at construction
             raise ValueError(
                 f"page_size ({page_size}) must divide cfg.seq_len "
                 f"({cfg.seq_len})")
+        if prefill_chunk_pages < 1:
+            raise ValueError(
+                f"prefill_chunk_pages must be >= 1, got "
+                f"{prefill_chunk_pages}")
         # same params/config positional-encoding guard the dense
         # generate() applies — a rope checkpoint served with
-        # pos="learned" (or vice versa) must fail here, not decode
-        # garbage quietly
+        # pos="learned" (or vice versa, or a tp-major-permuted tree)
+        # must fail here, not decode garbage quietly
         _check_pos(params, cfg)
         self.params = params
         self.cfg = cfg
@@ -101,22 +136,33 @@ class PagedEngine:
         self.n_pages = n_pages
         self.max_slots = max_slots
         self.compute_dtype = compute_dtype
+        self.prefix_cache = bool(prefix_cache)
         self.quantized = cache_dtype in ("int8", jnp.int8)
         if not self.quantized and cache_dtype is not None:
             raise ValueError(
                 f"cache_dtype must be None or 'int8', got {cache_dtype!r}")
-        self.tables = BlockTables(cfg, page_size, n_pages, max_slots)
+        self.tables = BlockTables(cfg, page_size, n_pages, max_slots,
+                                  prefix_cache=prefix_cache)
+        self.prefill_chunk_pages = min(prefill_chunk_pages,
+                                       self.tables.max_pages_per_slot)
+        self.chunk_tokens = self.prefill_chunk_pages * page_size
         self.pool = make_pool(cfg, page_size, n_pages,
                               cache_dtype=cache_dtype,
                               compute_dtype=compute_dtype)
         self._pick = _make_pick(temperature, top_k, top_p, jnp.int32)
         self._rng = jax.random.PRNGKey(0) if rng is None else rng
-        self._prefill_jit = jax.jit(self._prefill_fn)
-        # the pool crosses the jit boundary EVERY step — donate it so
+        # in-flight chunked prefills, oldest first: dicts of
+        # {slot, ids (chunk-padded np), s0, start}
+        self._pending: list[dict] = []
+        # host-side totals the batcher exports (telemetry counters)
+        self.prefill_chunks = 0
+        self.prefix_hit_pages = 0
+        self.prefix_lookup_pages = 0
+        # the pool crosses the jit boundary EVERY call — donate it so
         # XLA updates the pages in place; an undonated pool would copy
         # pool-sized bytes per step, re-taxing exactly the HBM traffic
         # the pager removes (CPU backends ignore donation — harmless)
-        self._write_jit = jax.jit(self._write_fn, donate_argnums=(0, 1))
+        self._chunk_jit = jax.jit(self._chunk_fn, donate_argnums=(1, 2))
         self._decode_jit = jax.jit(self._decode_fn,
                                    donate_argnums=(1, 2))
 
@@ -130,51 +176,116 @@ class PagedEngine:
                    n_pages=max_slots + 1, max_slots=max_slots, **kw)
 
     # ---- compiled pieces -----------------------------------------
-    def _prefill_fn(self, params, ids, s0, rng):
-        """Prompt forward over PAGE-ALIGNED ids (right-padded to a
-        whole page count; ``s0`` is the real length). Causal attention
-        makes right-padding a no-op for the first s0 tokens' K/V and
-        logits, so prefill compiles once per page COUNT — a bounded
-        set — instead of once per raw prompt length (preemption
-        re-prefills at arbitrary lengths; per-length compiles would
-        land in measured request latency). Pad-token K/V is written to
-        the pages but sits at positions >= lengths and the sweep's
-        mask never reads it."""
-        x, ks, vs = _prefill_forward(params, ids, self.cfg,
-                                     self.compute_dtype)
-        last = jax.lax.dynamic_slice_in_dim(x, s0 - 1, 1, axis=1)
-        logits = _lm_head(params, last)[:, 0]
-        return self._pick(rng, logits), ks, vs
+    def _chunk_fn(self, params, pool_k, pool_v, ids, start, s0,
+                  table_row, rng):
+        """ONE prefill chunk: forward ``ids`` (1, chunk_tokens) at
+        absolute positions ``start + [0, C)``, writing each layer's
+        K/V into the slot's pages and attending prior context through
+        the pool. Shapes depend only on (chunk size, pool geometry,
+        model) — ``start``/``s0``/``table_row`` are traced VALUES, so
+        this compiles exactly once whatever prompt lengths arrive
+        (the old ``_prefill_fn`` compiled per page COUNT).
 
-    def _write_fn(self, pool_k, pool_v, ks, vs, page_ids):
-        """Scatter a request's prefill K/V (L, 1, s0, g, Dh) into its
-        ``page_ids`` — padded to whole pages; the pad tokens sit at
-        positions >= length and the sweep's mask never reads them."""
-        n_layers, _, s0, g, d = ks.shape
-        n_p = page_ids.shape[0]
-        pad = ((0, 0), (0, n_p * self.page_size - s0), (0, 0), (0, 0))
-        kp = jnp.pad(ks[:, 0], pad).reshape(
-            n_layers, n_p, self.page_size, g, d)
-        vp = jnp.pad(vs[:, 0], pad).reshape(
-            n_layers, n_p, self.page_size, g, d)
-        if self.quantized:
-            kq, k_s = _quantize_kv(kp)
-            vq, v_s = _quantize_kv(vp)
-            pool_k = (pool_k[0].at[:, page_ids].set(kq),
-                      pool_k[1].at[:, page_ids].set(k_s))
-            pool_v = (pool_v[0].at[:, page_ids].set(vq),
-                      pool_v[1].at[:, page_ids].set(v_s))
-        else:
-            pool_k = pool_k.at[:, page_ids].set(
-                kp.astype(pool_k.dtype))
-            pool_v = pool_v.at[:, page_ids].set(
-                vp.astype(pool_v.dtype))
-        return pool_k, pool_v
+        Numerics: the chunk's own tokens attend each other in compute
+        dtype (the un-quantized intra-prompt attention the dense
+        prefill runs) while prior pages are read back from the pool in
+        page dtype (what decode reads) — the two flash-style partials
+        merge with the standard online-softmax combine. Pad tokens in
+        the final chunk write K/V at positions >= ``s0`` (or into the
+        reserved null page past the table) which every mask excludes.
+        Returns ``(picked token, pool_k, pool_v)`` — the pick is only
+        meaningful on the chunk containing position ``s0 - 1`` (the
+        host uses it there; earlier chunks discard it)."""
+        cfg, ps = self.cfg, self.page_size
+        C = ids.shape[1]
+        n_cp = C // ps
+        mp = table_row.shape[0]
+        head_dim = cfg.d_model // cfg.n_heads
+        positions = start + jnp.arange(C)
+
+        x = L.embedding(params["wte"], ids, dtype=self.compute_dtype)
+        if "wpe" in params:
+            x = x + L.embedding(params["wpe"], positions,
+                                dtype=self.compute_dtype)[None]
+
+        # chunk pages: table entries [start/ps, start/ps + n_cp); the
+        # final chunk's pad pages (beyond the slot's allocation, or
+        # past the table itself) divert to the reserved null page
+        pidx = start // ps + jnp.arange(n_cp)
+        w_pages = jnp.where(pidx < mp,
+                            table_row[jnp.clip(pidx, 0, mp - 1)],
+                            NULL_PAGE)
+        # absolute position of every gathered pool token: the slot's
+        # table is sequential, so table index i holds positions
+        # i*ps + [0, ps)
+        tok_abs = (jnp.arange(mp)[:, None] * ps
+                   + jnp.arange(ps)[None, :]).reshape(-1)
+        vis_prior = (tok_abs < start)[None, None, None, None, :]
+        local = jnp.arange(C)
+        vis_chunk = (local[:, None] >= local[None, :])[None, None, None]
+
+        def layer(x, inputs):
+            bp, pk, pv = inputs
+
+            def attend(q, k, v):
+                g = k.shape[2]
+                kp = k[0].reshape(n_cp, ps, g, head_dim)
+                vp = v[0].reshape(n_cp, ps, g, head_dim)
+                if self.quantized:
+                    kq, k_s = _quantize_kv(kp)
+                    vq, v_s = _quantize_kv(vp)
+                    new_k = (pk[0].at[w_pages].set(kq),
+                             pk[1].at[w_pages].set(k_s))
+                    new_v = (pv[0].at[w_pages].set(vq),
+                             pv[1].at[w_pages].set(v_s))
+                    gk = tuple(a[table_row].reshape(1, mp * ps, g, -1)
+                               for a in pk)
+                    gv = tuple(a[table_row].reshape(1, mp * ps, g, -1)
+                               for a in pv)
+                else:
+                    new_k = pk.at[w_pages].set(kp.astype(pk.dtype))
+                    new_v = pv.at[w_pages].set(vp.astype(pv.dtype))
+                    gk = pk[table_row].reshape(1, mp * ps, g, head_dim)
+                    gv = pv[table_row].reshape(1, mp * ps, g, head_dim)
+                # prior context (this slot's already-written pages,
+                # gathered PRE-write and masked to < start) and the
+                # chunk itself (compute-dtype K/V — parity with the
+                # dense prefill's un-quantized intra-prompt attention)
+                # are two flash-style partials merged online-softmax
+                # style — the same math spread over a split token axis
+                oA, mA, lA = _grouped_cache_attention(
+                    q, gk, gv, vis_prior, state=True)
+                oB, mB, lB = _grouped_cache_attention(
+                    q, k, v, vis_chunk, state=True)
+                m = jnp.maximum(mA, mB)
+                wA = jnp.exp(mA - m)
+                wB = jnp.exp(mB - m)
+                l = jnp.maximum(lA * wA + lB * wB, 1e-30)
+                # (B, g, rep, S_q) weights -> (B, S_q, g, rep, 1)
+                mv = lambda t: jnp.moveaxis(t, -1, 1)[..., None]
+                o = (oA * mv(wA) + oB * mv(wB)) / mv(l)
+                o = o.reshape(1, C, cfg.n_heads, head_dim)
+                return o.astype(q.dtype), (new_k, new_v)
+
+            x, _, (pk, pv) = _block_core(
+                bp, x, cfg, attend,
+                capacity_factor=max(cfg.capacity_factor,
+                                    float(cfg.n_experts)),
+                positions=positions[None])      # per-slot rope depth
+            return x, (pk, pv)
+
+        x, (pool_k, pool_v) = jax.lax.scan(
+            layer, x, (params["blocks"], pool_k, pool_v))
+        last = jax.lax.dynamic_slice_in_dim(
+            x, jnp.clip(s0 - 1 - start, 0, C - 1), 1, axis=1)
+        logits = _lm_head(params, last)[:, 0]
+        return self._pick(rng, logits), pool_k, pool_v
 
     def _decode_fn(self, params, pool_k, pool_v, tables, lengths,
-                   owner, page_pos, active, last_ids, rng):
+                   refs, page_pos, active, last_ids, rng):
         """One decode step over all slots. Signature shapes depend
-        only on pool geometry — never on which slots are live."""
+        only on pool geometry — never on which slots are live or how
+        pages are shared."""
         cfg, ps = self.cfg, self.page_size
         n_slots = last_ids.shape[0]
 
@@ -184,23 +295,33 @@ class PagedEngine:
             x = x + L.embedding(params["wpe"], lengths,
                                 dtype=self.compute_dtype)[:, None]
 
-        # page → segment bookkeeping, shared by every layer: free
-        # pages divert to the trash segment n_slots; a page's token j
-        # holds absolute position page_pos*ps + j, visible iff <= its
-        # owner's current length (the token this step writes lands AT
-        # ``lengths`` and must see itself). The sweep reads pages
-        # [1:] only — page 0 is the reserved null page (dead-slot
-        # write target, never owned), and excluding it keeps the read
-        # at exactly the usable capacity, so the dense-geometry
-        # control streams exactly max_slots × seq_len tokens
-        seg = jnp.where(owner >= 0, owner, n_slots)[1:]
-        owner_c = jnp.clip(owner, 0, n_slots - 1)[1:]
+        # page -> lane bookkeeping, shared by every layer: each page
+        # carries reference LANES (refs row: the slots holding it —
+        # prefix-shared pages list every sharer; empty lanes divert to
+        # the trash segment n_slots; without the prefix cache the lane
+        # axis is 1 and this is exactly the old single-owner sweep). A page's token j
+        # holds absolute position page_pos*ps + j, visible to a lane
+        # iff <= that slot's current length (the token this step
+        # writes lands AT ``lengths`` and must see itself; a sharer
+        # mid-prompt never sees past its own depth). The sweep reads
+        # pages [1:] only — page 0 is the reserved null page
+        # (dead-slot write target, never referenced), and excluding it
+        # keeps the read at exactly the usable capacity, so the
+        # dense-geometry control streams exactly max_slots × seq_len
+        refs_t = refs[1:]                       # (P, R)
+        n_lanes = refs_t.shape[1]
+        seg = jnp.where(refs_t >= 0, refs_t, n_slots).reshape(-1)
+        ref_c = jnp.clip(refs_t, 0, n_slots - 1)
         tok_pos = page_pos[1:, None] * ps + jnp.arange(ps)[None, :]
-        owner_len = jnp.where(owner[1:] >= 0, lengths[owner_c], -1)
-        visible = tok_pos <= owner_len[:, None]      # (n_pages - 1, ps)
+        ref_len = jnp.where(refs_t >= 0, lengths[ref_c], -1)
+        visible = tok_pos[:, None, :] <= ref_len[:, :, None]
+        # (P, R, ps) -> broadcast against the (P, g, rep, R, ps) scores
 
         # this step's write target per slot: the page holding position
-        # ``lengths``; dead slots scribble the reserved null page
+        # ``lengths`` — ALWAYS private (shared pages are full prompt
+        # prefixes and the match is capped before the last prompt
+        # token, so the write offset sits past every shared page);
+        # dead slots scribble the reserved null page
         w_page = tables[jnp.arange(n_slots), lengths // ps]
         w_page = jnp.where(active, w_page, 0)
         w_off = lengths % ps
@@ -222,28 +343,38 @@ class PagedEngine:
                         k[:, 0].astype(pk.dtype))
                     new_v = pv.at[w_page, w_off].set(
                         v[:, 0].astype(pv.dtype))
-                # the pool sweep: each live page attends its owner's
-                # query (a gather of the TINY q tensor — the pool
-                # itself is read in place, once, minus the null page:
-                # a static [1:] slice that fuses into the einsum
-                # operand read), then pages merge per slot via the
+                # the pool sweep: each live page attends the queries
+                # of ALL its reference lanes (a gather of the TINY q
+                # tensor into (P, R, H, Dh) — the pool itself is read
+                # in place, ONCE, minus the null page: a static [1:]
+                # slice that fuses into the einsum operand read; lanes
+                # ride the query axis so sharing multiplies only the
+                # small-side compute, never the HBM stream), then
+                # (page, lane) partials merge per slot via the
                 # online-softmax combine
                 if self.quantized:
                     rk = tuple(a[1:] for a in new_k)
                     rv = tuple(a[1:] for a in new_v)
                 else:
                     rk, rv = new_k[1:], new_v[1:]
-                q_pages = q[owner_c]           # (n_pages - 1, 1, H, Dh)
+                q_lanes = q[:, 0][ref_c]        # (P, R, H, Dh)
                 o_p, m_p, l_p = _grouped_cache_attention(
-                    q_pages, rk, rv,
-                    visible[:, None, None, None, :], state=True)
-                m_p, l_p, o_p = m_p[..., 0], l_p[..., 0], o_p[:, 0]
-                m_s = jax.ops.segment_max(m_p, seg,
+                    q_lanes, rk, rv,
+                    visible[:, None, None, :, :], state=True)
+                # o (P, R, g, rep, Dh); m/l (P, g, rep, R): flatten
+                # the (page, lane) pairs into one segment axis
+                n_pp = o_p.shape[0]
+                o_f = o_p.reshape(n_pp * n_lanes, *o_p.shape[2:])
+                m_f = jnp.moveaxis(m_p, -1, 1).reshape(
+                    n_pp * n_lanes, *m_p.shape[1:3])
+                l_f = jnp.moveaxis(l_p, -1, 1).reshape(
+                    n_pp * n_lanes, *l_p.shape[1:3])
+                m_s = jax.ops.segment_max(m_f, seg,
                                           num_segments=n_slots + 1)
-                w = jnp.exp(m_p - m_s[seg])
-                l_s = jax.ops.segment_sum(l_p * w, seg,
+                w = jnp.exp(m_f - m_s[seg])
+                l_s = jax.ops.segment_sum(l_f * w, seg,
                                           num_segments=n_slots + 1)
-                o_s = jax.ops.segment_sum(o_p * w[..., None], seg,
+                o_s = jax.ops.segment_sum(o_f * w[..., None], seg,
                                           num_segments=n_slots + 1)
                 o = o_s[:n_slots] / jnp.maximum(
                     l_s[:n_slots], 1e-30)[..., None]
@@ -264,44 +395,145 @@ class PagedEngine:
         return self._pick(rng, logits), pool_k, pool_v
 
     # ---- host lifecycle ------------------------------------------
-    def can_admit(self, prompt_len: int) -> bool:
-        return (self.tables.free_slot() is not None
-                and self.tables.pages_for(prompt_len)
-                <= self.tables.n_free_pages
-                and prompt_len < self.cfg.seq_len)
+    def can_admit(self, prompt_ids: np.ndarray) -> bool:
+        """Dry-run of :meth:`admit_begin`'s checks (slot, horizon, and
+        pages net of the prefix-cache discount) without seating —
+        for external drivers that want to peek before committing.
+        Takes the prompt TOKEN ARRAY (matching is content-based); the
+        pre-PR-4 scalar prompt_len form is rejected loudly rather
+        than silently reinterpreted as a one-token prompt."""
+        if np.asarray(prompt_ids).ndim == 0:
+            raise TypeError(
+                "can_admit takes the prompt token array (prefix "
+                "matching is content-based), not its length")
+        prompt = np.ascontiguousarray(prompt_ids, np.int32).reshape(-1)
+        s0 = len(prompt)
+        if self.tables.free_slot() is None \
+                or not 0 < s0 < self.cfg.seq_len:
+            return False
+        return (self.tables.pages_for(s0)
+                - len(self.tables.match_pages(prompt))
+                <= self.tables.n_available_pages)
 
-    def admit(self, prompt_ids: np.ndarray) -> tuple[int, int] | None:
-        """Prefill one request and seat it in a free slot; returns
-        ``(slot, first_token)``, or None when no slot or not enough
-        free pages (the batcher keeps it queued)."""
-        prompt_ids = np.asarray(prompt_ids, np.int32).reshape(-1)
-        if not self.can_admit(len(prompt_ids)):
-            return None
+    def admit_begin(self, prompt_ids: np.ndarray) -> int | None:
+        """Seat one request: map cached prefix pages into its block
+        table, allocate private pages for the rest, and queue its
+        chunked prefill. Returns the slot, or None when no slot or
+        not enough pages (the batcher keeps it queued). The request
+        decodes only after :meth:`prefill_step` drains its chunks."""
+        prompt = np.ascontiguousarray(prompt_ids, np.int32).reshape(-1)
+        s0 = len(prompt)
         slot = self.tables.free_slot()
-        self._rng, sub = jax.random.split(self._rng)
-        s0 = len(prompt_ids)
-        padded = np.zeros(self.tables.pages_for(s0) * self.page_size,
+        if slot is None or not 0 < s0 < self.cfg.seq_len:
+            return None
+        # hopeless-case bail BEFORE the index walk: even a full
+        # prefix hit leaves at least the last page to allocate (the
+        # match cap), so with nothing available skip the quadratic
+        # prompt-hashing entirely — this is the branch a queue-head
+        # request under total pool exhaustion retries every
+        # scheduling iteration
+        if self.tables.pages_for(s0) - (s0 - 1) // self.page_size \
+                > self.tables.n_available_pages:
+            return None
+        # ONE index walk serves both the capacity check and the
+        # seating (the walk hashes prompt-prefix bytes per page —
+        # quadratic in prompt length, so never repeated within an
+        # attempt; a failed attempt that got past the bail above may
+        # re-walk on retry, which only happens when a seat is
+        # plausibly one retire away)
+        matched = self.tables.match_pages(prompt)
+        n_matched = len(matched)
+        if self.tables.pages_for(s0) - n_matched \
+                > self.tables.n_available_pages:
+            return None
+        try:
+            self.tables.seat(slot, prompt, matched=matched)
+        except RuntimeError:
+            # the quick check above counts CACHED matched pages as
+            # available capacity, but mapping them makes them
+            # un-evictable — under exactly-full pool pressure the
+            # private-tail allocation can then come up short. seat()
+            # rolled the shares back (the matched pages re-enter the
+            # LRU), so the request just stays queued until retires
+            # return pages — the same contract as any other
+            # not-enough-pages admission.
+            return None
+        self.prefix_lookup_pages += (s0 - 1) // self.page_size
+        self.prefix_hit_pages += n_matched
+        # chunking starts at the matched boundary (page-aligned by
+        # construction) — the cache hit's whole point is skipping the
+        # matched pages' chunks; pad the tail to a whole chunk
+        start = n_matched * self.page_size
+        n_chunks = -(-(s0 - start) // self.chunk_tokens)
+        padded = np.zeros(start + n_chunks * self.chunk_tokens,
                           np.int32)
-        padded[:s0] = prompt_ids
+        padded[:s0] = prompt
+        self._pending.append(
+            {"slot": slot, "ids": padded, "s0": s0, "start": start})
+        return slot
+
+    @property
+    def has_pending(self) -> bool:
+        return bool(self._pending)
+
+    @property
+    def pending_slots(self) -> list[int]:
+        """Slots with an in-flight chunked prefill, oldest first —
+        cross-run residue when a driver loop aborts mid-prefill; the
+        batcher cancels them before starting a fresh trace."""
+        return [p["slot"] for p in self._pending]
+
+    def prefill_step(self) -> tuple[int, int] | None:
+        """Run ONE chunk of the oldest queued prefill (no-op None when
+        idle). Returns ``(slot, first_token)`` when that request's
+        prefill completed — the slot is then activated for decode and
+        its full prompt pages registered in the prefix index — else
+        None."""
+        if not self._pending:
+            return None
+        p = self._pending[0]
+        self._rng, sub = jax.random.split(self._rng)
+        C = self.chunk_tokens
+        ids = jnp.asarray(p["ids"][p["start"]:p["start"] + C])[None]
+        table_row = jnp.asarray(self.tables.tables[p["slot"]])
         # span: host wall time in the event log + the same label on a
         # captured device trace (observability/spans.py); no-op when
         # telemetry is disabled
-        with span("serving_prefill"):
-            first, ks, vs = self._prefill_jit(
-                self.params, jnp.asarray(padded)[None],
-                jnp.asarray(s0, jnp.int32), sub)
-            first = int(first[0])
-            page_ids = self.tables.admit(slot, len(prompt_ids), first)
-            pool_k, pool_v = self._write_jit(
-                self.pool["k"], self.pool["v"], ks, vs,
-                jnp.asarray(page_ids))
+        with span("serving_prefill_chunk"):
+            tok, pool_k, pool_v = self._chunk_jit(
+                self.params, self.pool["k"], self.pool["v"], ids,
+                jnp.asarray(p["start"], jnp.int32),
+                jnp.asarray(p["s0"], jnp.int32), table_row, sub)
         self.pool = {"k": pool_k, "v": pool_v}
-        return slot, first
+        self.prefill_chunks += 1
+        p["start"] += C
+        if p["start"] < p["s0"]:
+            return None
+        self._pending.pop(0)
+        first = int(np.asarray(tok)[0])
+        self.tables.activate(p["slot"], first)
+        self.tables.register_prefix(p["slot"], p["ids"][:p["s0"]])
+        return p["slot"], first
+
+    def admit(self, prompt_ids: np.ndarray) -> tuple[int, int] | None:
+        """One-shot admission (tests and simple drivers): seat the
+        request and drain prefill chunks until ITS first token lands;
+        returns ``(slot, first_token)`` or None. Drains any older
+        pending prefills along the way (their slots activate with
+        their first tokens recorded in the tables)."""
+        slot = self.admit_begin(prompt_ids)
+        if slot is None:
+            return None
+        while True:
+            done = self.prefill_step()
+            if done is not None and done[0] == slot:
+                return done
 
     def grow_slots(self) -> list[int]:
-        """Pre-allocate each active slot's next write page; returns
-        the slots that could NOT get one (pool exhausted — the batcher
-        preempts). Call before every :meth:`step`."""
+        """Pre-allocate each active slot's next write page (evicting
+        cached prefixes under pressure); returns the slots that could
+        NOT get one (pool exhausted — the batcher preempts). Call
+        before every :meth:`step`."""
         starved = []
         for slot in np.flatnonzero(self.tables.active):
             if not self.tables.ensure_next_page(int(slot)):
@@ -309,9 +541,9 @@ class PagedEngine:
         return starved
 
     def step(self) -> np.ndarray:
-        """One decode step over every slot; advances lengths/last_ids
-        for the active ones and returns the (max_slots,) token ids
-        (garbage at inactive slots)."""
+        """One decode step over every ACTIVE slot; advances lengths/
+        last_ids for those and returns the (max_slots,) token ids
+        (garbage at inactive or mid-prefill slots)."""
         active = self.tables.active.copy()
         if active.any():
             full = self.tables.lengths[active] >= self.cfg.seq_len
@@ -324,7 +556,7 @@ class PagedEngine:
         with span("decode_step"):
             tokens, pool_k, pool_v = self._decode_jit(
                 self.params, self.pool["k"], self.pool["v"],
-                args["tables"], args["lengths"], args["owner"],
+                args["tables"], args["lengths"], args["refs"],
                 args["page_pos"], args["active"], args["last_ids"], sub)
             self.pool = {"k": pool_k, "v": pool_v}
             tokens = np.asarray(tokens)
@@ -333,20 +565,32 @@ class PagedEngine:
         return tokens
 
     def retire(self, slot: int) -> None:
+        """Release the slot (cancelling any in-flight prefill); shared
+        prefix pages stay resident for later hits, everything else
+        frees (kv_pages.py refcount/evict lifetime)."""
+        self._pending = [p for p in self._pending
+                         if p["slot"] != slot]
         self.tables.retire(slot)
+
+    @property
+    def prefix_hit_rate(self) -> float:
+        """Fraction of eligible prompt pages served from the cache."""
+        return self.prefix_hit_pages / max(self.prefix_lookup_pages, 1)
 
     @property
     def decode_compiles(self) -> int:
         """Compiled decode-step count — the zero-recompile contract's
-        observable (tests assert it stays 1 across slot churn; the
-        batcher's RecompileSentinel enforces it at runtime)."""
+        observable (tests assert it stays 1 across seat/retire/evict
+        churn; the batcher's RecompileSentinel enforces it at
+        runtime)."""
         return self._decode_jit._cache_size()
 
     @property
     def prefill_compiles(self) -> int:
-        """Compiled prefill count — bounded by the page-COUNT set
-        (``seq_len / page_size``), whatever prompt lengths arrive."""
-        return self._prefill_jit._cache_size()
+        """Compiled prefill-chunk count — exactly ONE whatever prompt
+        lengths arrive (chunk position/length/page-ids are traced
+        values, never shapes)."""
+        return self._chunk_jit._cache_size()
 
 
 __all__ = ["PagedEngine"]

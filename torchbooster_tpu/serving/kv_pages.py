@@ -1,4 +1,6 @@
-"""Paged KV cache: a fixed pool of K/V pages + per-slot block tables.
+"""Paged KV cache: a fixed pool of K/V pages + per-slot block tables,
+with REFCOUNTED pages and a prompt-prefix index so requests that share
+a prompt prefix share the physical pages instead of recomputing them.
 
 The dense decode cache (models/gpt.py ``jit_generate``) preallocates
 ``(B, S_cache, H_kv, Dh)`` per layer and every decode step streams ALL
@@ -17,14 +19,36 @@ Two cooperating halves:
   step; bf16/fp32, or int8 + bf16 scales — the engine quantizes page
   writes with the SAME ``_quantize_kv`` the dense ``cache_dtype=
   "int8"`` path uses).
-- :class:`BlockTables` — HOST-side alloc/free bookkeeping (plain
+- :class:`BlockTables` — HOST-side refcount/evict bookkeeping (plain
   integer index arithmetic on numpy arrays, nothing shape-dependent:
-  admitting and retiring sequences only changes VALUES inside
+  seating, retiring, and evicting only change VALUES inside
   fixed-shape tables, so the compiled decode step — whose signature
   depends only on pool geometry — never recompiles).
 
+**Page lifetime (PR 4: alloc/free → refcount/evict).** A page is in
+exactly one of three states: *referenced* (``refcount > 0`` — one or
+more slots hold it in their tables; a prefix page shared by k live
+requests counts k), *cached* (``refcount == 0`` but the page is a
+registered prompt prefix: its K/V stay resident and a later request
+with the same prefix maps it straight into its table), or *free*.
+Retire decrements refcounts and only truly frees orphaned
+non-prefix pages; cached prefixes are reclaimed LRU — deepest chain
+pages first, so a prefix shrinks from its tail — whenever an
+allocation needs more pages than the free list holds.
+
+**The prefix index.** Pages holding FULL pages of a prompt are
+registered under the exact byte string of the prompt's tokens up to
+and including that page (a chain key: collision-free by construction,
+process-local). ``match_prefix`` walks the chain page by page; the
+match is capped at ``(prompt_len - 1) // page_size`` pages so the
+LAST prompt token is always recomputed — its logits seed the
+request's first sampled token. Copy-on-write falls out of the
+alignment rule: matched full pages are mapped shared, and the first
+partial page plus everything after it allocate private pages, so a
+decode write can never land on a shared page.
+
 Page 0 is RESERVED as the null page: free slots' table entries and
-inactive slots' write targets all point at it, its owner stays ``-1``
+inactive slots' write targets all point at it, its refcount stays 0
 forever, and the attention sweep masks it out — so a dead slot can
 scribble into the pool without a branch and without corrupting any
 live sequence.
@@ -65,10 +89,10 @@ def make_pool(cfg: GPTConfig, page_size: int, n_pages: int,
 
 
 class BlockTables:
-    """Host-side page bookkeeping for ``max_slots`` serving slots over
-    a ``n_pages``-page pool (page 0 reserved null).
+    """Host-side refcounted page bookkeeping for ``max_slots`` serving
+    slots over a ``n_pages``-page pool (page 0 reserved null).
 
-    All state is fixed-shape numpy; alloc/free is integer index
+    All state is fixed-shape numpy; seat/retire/evict is integer index
     arithmetic. The decode step consumes :meth:`device_args` — the
     VALUES change per step, the shapes never do, so slot churn cannot
     trigger a recompile.
@@ -76,19 +100,37 @@ class BlockTables:
     Arrays:
 
     - ``tables (max_slots, max_pages_per_slot) int32`` — page ids per
-      slot, ``NULL_PAGE`` where unassigned;
-    - ``lengths (max_slots,) int32`` — tokens currently stored;
-    - ``owner (n_pages,) int32`` — owning slot per page, ``-1`` free;
+      slot, ``NULL_PAGE`` where unassigned; prefix-shared pages appear
+      in several slots' rows at the SAME index;
+    - ``lengths (max_slots,) int32`` — tokens currently stored (set at
+      :meth:`seat` time, grown by :meth:`advance`);
+    - ``refcount (n_pages,) int32`` — number of slots holding the page
+      (0 = free or cached);
+    - ``refs (n_pages, n_ref_lanes) int32`` — WHICH slots hold the
+      page, ``-1`` empty lanes (``n_ref_lanes`` = ``max_slots`` with
+      the prefix cache, 1 without — no sharing means one lane
+      suffices and the decode sweep pays nothing extra). This is the
+      decode sweep's routing table: each page attends one query per
+      referencing slot, so a page shared by k live requests serves
+      all k in the one pool read;
     - ``page_pos (n_pages,) int32`` — the page's index within its
-      owner's sequence (page ``p`` holds absolute token positions
-      ``page_pos[p]*page_size + [0, page_size)``);
-    - ``active (max_slots,) bool`` — slot occupancy;
+      holders' sequences (identical for every sharer — shared pages
+      are prompt PREFIX pages, which sit at the same table index by
+      construction);
+    - ``active (max_slots,) bool`` — DECODE-READY slots. A seated slot
+      mid-chunked-prefill holds pages and a length but stays inactive
+      until :meth:`activate`;
     - ``last_ids (max_slots,) int32`` — each slot's most recent token
       (the decode step's input).
+
+    ``prefix_cache=False`` (the default) degenerates to plain
+    alloc/free: nothing is matched or registered, every refcount is 0
+    or 1, and retire frees every page — the cold control the parity
+    suite measures the cache against.
     """
 
     def __init__(self, cfg: GPTConfig, page_size: int, n_pages: int,
-                 max_slots: int):
+                 max_slots: int, prefix_cache: bool = False):
         if page_size < 1 or n_pages < 2 or max_slots < 1:
             raise ValueError(
                 f"need page_size >= 1, n_pages >= 2 (page 0 is the "
@@ -100,13 +142,29 @@ class BlockTables:
         self.max_slots = max_slots
         self.max_pages_per_slot = -(-cfg.seq_len // page_size)
         self.seq_len = cfg.seq_len
+        self.prefix_cache = bool(prefix_cache)
         self.tables = np.full((max_slots, self.max_pages_per_slot),
                               NULL_PAGE, np.int32)
         self.lengths = np.zeros(max_slots, np.int32)
-        self.owner = np.full(n_pages, -1, np.int32)
+        self.refcount = np.zeros(n_pages, np.int32)
+        # reference lanes: with the prefix cache every slot may share
+        # one page, so a page needs max_slots lanes; without it no
+        # page ever has more than one holder and the lane axis
+        # collapses to 1 — the cold engine's decode sweep then pays
+        # ZERO extra query-side compute for the sharing machinery
+        self.n_ref_lanes = max_slots if self.prefix_cache else 1
+        self.refs = np.full((n_pages, self.n_ref_lanes), -1, np.int32)
         self.page_pos = np.zeros(n_pages, np.int32)
         self.active = np.zeros(max_slots, bool)
         self.last_ids = np.zeros(max_slots, np.int32)
+        # prefix index: prompt-prefix bytes -> page id (bijective with
+        # _page_key); _lru tracks refcount-0 cached pages by last-use
+        # tick — retire assigns ticks tail-first so eviction shrinks a
+        # cached prefix from its deepest page
+        self._index: dict[bytes, int] = {}
+        self._page_key: dict[int, bytes] = {}
+        self._lru: dict[int, int] = {}
+        self._tick = 0
         # LIFO free list: recently-freed pages are re-issued first
         # (their bytes are hottest in cache); page 0 never enters
         self._free = list(range(n_pages - 1, 0, -1))
@@ -116,9 +174,20 @@ class BlockTables:
     def n_free_pages(self) -> int:
         return len(self._free)
 
+    @property
+    def n_cached_pages(self) -> int:
+        """Resident refcount-0 prefix pages (LRU-evictable)."""
+        return len(self._lru)
+
+    @property
+    def n_available_pages(self) -> int:
+        """Free + evictable — the admission capacity check (cached
+        prefixes never block an admission; they evict under it)."""
+        return len(self._free) + len(self._lru)
+
     def free_slot(self) -> int | None:
-        """Lowest free slot id, or None when all slots are occupied."""
-        idle = np.flatnonzero(~self.active)
+        """Lowest unseated slot id, or None when all are occupied."""
+        idle = np.flatnonzero(~self.active & (self.lengths == 0))
         return int(idle[0]) if idle.size else None
 
     def pages_for(self, n_tokens: int) -> int:
@@ -129,38 +198,120 @@ class BlockTables:
         n = self.pages_for(int(self.lengths[slot]))
         return self.tables[slot, :n].copy()
 
+    def match_prefix(self, prompt: np.ndarray) -> int:
+        """How many leading FULL pages of ``prompt`` are resident in
+        the prefix index — capped at ``(len - 1) // page_size`` so the
+        last prompt token always recomputes (its logits seed the first
+        sampled token)."""
+        return len(self.match_pages(prompt))
+
+    def match_pages(self, prompt: np.ndarray) -> list[int]:
+        """The resident page chain for ``prompt``'s leading full pages
+        (same cap as :meth:`match_prefix`). The walk hashes the prompt
+        prefix once per page — callers that need both the capacity
+        check and the seating (engine ``admit_begin``) do ONE walk and
+        hand the result to :meth:`seat`."""
+        if not self.prefix_cache or len(prompt) < 1:
+            return []
+        prompt = np.ascontiguousarray(prompt, np.int32)
+        limit = (len(prompt) - 1) // self.page_size
+        pages: list[int] = []
+        while len(pages) < limit:
+            p = self._index.get(
+                prompt[:(len(pages) + 1) * self.page_size].tobytes())
+            if p is None:
+                break
+            pages.append(p)
+        return pages
+
     # ---- mutations -----------------------------------------------
-    def admit(self, slot: int, prompt_len: int,
-              first_id: int) -> np.ndarray:
-        """Claim ``slot`` for a sequence of ``prompt_len`` stored
-        tokens: allocates ``ceil(prompt_len / page_size)`` pages and
-        returns their ids (the engine scatters the prefill K/V there).
-        ``first_id`` seeds the slot's decode input (the prefill's
-        sampled token). Raises when the slot is busy or pages run out
-        — the batcher checks :attr:`n_free_pages` first."""
-        if self.active[slot]:
+    def seat(self, slot: int, prompt: np.ndarray,
+             matched: list[int] | None = None
+             ) -> tuple[np.ndarray, int]:
+        """Claim ``slot`` for ``prompt``: map the matched cached
+        prefix pages into its table (refcount++) and allocate private
+        pages for the rest (evicting LRU cached prefixes under
+        pressure). ``matched`` short-circuits the index walk with a
+        fresh :meth:`match_pages` result (no mutation in between).
+        The slot stays INACTIVE (no decode) until :meth:`activate` —
+        the engine streams the unmatched prompt in via chunked
+        prefill first. Returns ``(page_ids, n_matched)``; raises when
+        the slot is busy or pages run out even after eviction (the
+        caller checks :attr:`n_available_pages`)."""
+        prompt = np.ascontiguousarray(prompt, np.int32).reshape(-1)
+        if self.active[slot] or self.lengths[slot]:
             raise ValueError(f"slot {slot} is already occupied")
-        if not 0 < prompt_len < self.seq_len:
+        if not 0 < len(prompt) < self.seq_len:
             raise ValueError(
-                f"prompt_len must be in (0, {self.seq_len}), got "
-                f"{prompt_len}")
-        n = self.pages_for(prompt_len)
-        page_ids = self._alloc(slot, np.arange(n))
-        self.lengths[slot] = prompt_len
+                f"prompt length must be in (0, {self.seq_len}), got "
+                f"{len(prompt)}")
+        n_total = self.pages_for(len(prompt))
+        if matched is None:
+            matched = self.match_pages(prompt)
+        n_matched = len(matched)
+        # remember the matched pages' LRU ticks: a failed seat must
+        # put them back EXACTLY as found — minting fresh ticks on
+        # rollback would promote a chain that keeps failing to seat
+        # to most-recently-used, evicting genuinely useful prefixes
+        # ahead of it
+        old_ticks = {p: self._lru[p] for p in matched if p in self._lru}
+        for i, p in enumerate(matched):
+            self._ref(slot, i, p)
+        try:
+            self._alloc(slot, np.arange(n_matched, n_total))
+        except RuntimeError:
+            for i in reversed(range(n_matched)):
+                self._unref(slot, int(self.tables[slot, i]))
+            self.tables[slot, :n_matched] = NULL_PAGE
+            for p, tick in old_ticks.items():
+                if p in self._lru:       # still refcount-0 cached
+                    self._lru[p] = tick
+            raise
+        self.lengths[slot] = len(prompt)
+        self.last_ids[slot] = 0
+        return self.tables[slot, :n_total].copy(), n_matched
+
+    def activate(self, slot: int, first_id: int) -> None:
+        """Mark a seated slot decode-ready (prefill done); ``first_id``
+        seeds its decode input (the prefill's sampled token)."""
+        if not self.lengths[slot] or self.active[slot]:
+            raise ValueError(
+                f"slot {slot} is not seated-and-inactive")
         self.active[slot] = True
         self.last_ids[slot] = first_id
-        return page_ids
+
+    def register_prefix(self, slot: int, prompt: np.ndarray) -> int:
+        """Publish the slot's FULL prompt pages into the prefix index
+        (call once prefill has written them — their content is final:
+        only the partial tail page ever grows). Returns how many new
+        entries landed."""
+        if not self.prefix_cache:
+            return 0
+        prompt = np.ascontiguousarray(prompt, np.int32).reshape(-1)
+        n_new = 0
+        for i in range(len(prompt) // self.page_size):
+            key = prompt[:(i + 1) * self.page_size].tobytes()
+            if key in self._index:
+                continue                 # first writer wins
+            p = int(self.tables[slot, i])
+            if p == NULL_PAGE or p in self._page_key:
+                continue
+            self._index[key] = p
+            self._page_key[p] = key
+            n_new += 1
+        return n_new
 
     def ensure_next_page(self, slot: int) -> bool:
         """Make sure the page that position ``lengths[slot]`` (the
         next write) lands in exists; allocates one page at a page
-        boundary. Returns False when the pool is exhausted (the
+        boundary, evicting a cached prefix page if the free list is
+        empty. Returns False when the pool is truly exhausted (the
         batcher then preempts) — the slot is untouched."""
         length = int(self.lengths[slot])
         idx = length // self.page_size
         if length % self.page_size or self.tables[slot, idx] != NULL_PAGE:
             return True
-        if not self._free:
+        if not self._free and not self._evict(1):
             return False
         self._alloc(slot, np.array([idx]))
         return True
@@ -172,30 +323,81 @@ class BlockTables:
         self.last_ids[slot] = token_id
 
     def retire(self, slot: int) -> None:
-        """Free the slot and every page it holds (returned LIFO)."""
-        if not self.active[slot]:
+        """Release the slot: every page's refcount drops by one; pages
+        that hit zero either stay RESIDENT as cached prefixes (if
+        registered) or return to the free list. Iterates the table
+        tail-first so a cached prefix's deepest pages get the OLDEST
+        LRU ticks and evict first — the chain shrinks from its tail,
+        never breaking the match walk mid-prefix."""
+        if not self.active[slot] and not self.lengths[slot]:
             return
-        for p in self.tables[slot]:
+        for p in self.tables[slot][::-1]:
             if p != NULL_PAGE:
-                self.owner[p] = -1
-                self.page_pos[p] = 0
-                self._free.append(int(p))
+                self._unref(slot, int(p))
         self.tables[slot] = NULL_PAGE
         self.lengths[slot] = 0
         self.active[slot] = False
         self.last_ids[slot] = 0
 
+    # ---- internals -----------------------------------------------
+    def _ref(self, slot: int, idx: int, p: int) -> None:
+        """Map an existing (cached or live-shared) page into a slot's
+        table at index ``idx``."""
+        assert self.page_pos[p] == idx, (
+            f"prefix page {p} sits at position {self.page_pos[p]}, "
+            f"matched at table index {idx}")
+        if self.refcount[p] == 0:
+            self._lru.pop(p, None)           # cached -> referenced
+        lane = int(np.flatnonzero(self.refs[p] == -1)[0])
+        self.refs[p, lane] = slot
+        self.refcount[p] += 1
+        self.tables[slot, idx] = p
+
+    def _unref(self, slot: int, p: int) -> None:
+        self.refcount[p] -= 1
+        assert self.refcount[p] >= 0, f"page {p} refcount went negative"
+        self.refs[p][self.refs[p] == slot] = -1
+        if self.refcount[p] == 0:
+            if p in self._page_key:          # registered prefix: cache
+                self._tick += 1
+                self._lru[p] = self._tick
+            else:
+                self.page_pos[p] = 0
+                self._free.append(int(p))
+
+    def _evict(self, n: int) -> int:
+        """Reclaim up to ``n`` LRU cached prefix pages into the free
+        list (dropping their index entries); returns how many."""
+        got = 0
+        while got < n and self._lru:
+            p = min(self._lru, key=self._lru.get)
+            del self._lru[p]
+            del self._index[self._page_key.pop(p)]
+            self.page_pos[p] = 0
+            self._free.append(int(p))
+            got += 1
+        return got
+
     def _alloc(self, slot: int, table_idx: np.ndarray) -> np.ndarray:
-        if len(table_idx) > len(self._free):
+        if len(table_idx) > len(self._free) + len(self._lru):
+            # raise BEFORE evicting: a doomed allocation must not
+            # drain unrelated cached prefixes (dropping their index
+            # entries for nothing) on its way to failing anyway
             raise RuntimeError(
                 f"KV page pool exhausted: need {len(table_idx)} pages, "
-                f"{len(self._free)} free (n_pages={self.n_pages}, "
-                f"page_size={self.page_size}); size serving.n_pages to "
-                "the worst-case live-token total or lower max_slots")
+                f"{len(self._free)} free + {len(self._lru)} evictable "
+                f"(n_pages={self.n_pages}, page_size={self.page_size})"
+                "; size serving.n_pages to the worst-case live-token "
+                "total or lower max_slots")
+        short = len(table_idx) - len(self._free)
+        if short > 0:
+            self._evict(short)
         ids = np.array([self._free.pop() for _ in table_idx], np.int32)
         self.tables[slot, table_idx] = ids
-        self.owner[ids] = slot
-        # a page's position within its owner's sequence IS its table
+        self.refcount[ids] = 1
+        self.refs[ids, :] = -1
+        self.refs[ids, 0] = slot
+        # a page's position within its holders' sequences IS its table
         # index — the sweep reconstructs absolute token positions from it
         self.page_pos[ids] = np.asarray(table_idx, np.int32)
         return ids
@@ -203,13 +405,13 @@ class BlockTables:
     # ---- device view ---------------------------------------------
     def device_args(self) -> dict:
         """The decode step's table operands, as jnp arrays. Fixed
-        shapes by construction — only values change across admit/
-        retire, which is what keeps the compiled step signature
+        shapes by construction — only values change across seat/
+        retire/evict, which is what keeps the compiled step signature
         occupancy-independent."""
         return {
             "tables": jnp.asarray(self.tables),
             "lengths": jnp.asarray(self.lengths),
-            "owner": jnp.asarray(self.owner),
+            "refs": jnp.asarray(self.refs),
             "page_pos": jnp.asarray(self.page_pos),
             "active": jnp.asarray(self.active),
             "last_ids": jnp.asarray(self.last_ids),
@@ -218,16 +420,22 @@ class BlockTables:
     # ---- invariants (tests) --------------------------------------
     def check(self) -> None:
         """Structural invariants, asserted by the churn tests: page 0
-        never allocated; free list ∪ owned pages = pool exactly once;
-        owner/page_pos agree with the tables; lengths fit the pages
-        held."""
+        never allocated; referenced ∪ cached ∪ free = pool exactly
+        once; refcounts equal the table references (never negative);
+        refs lanes agree with the tables; page_pos agrees with every
+        holder; the prefix index is a bijection and cached pages all
+        carry keys."""
         free = set(self._free)
+        cached = set(self._lru)
         assert NULL_PAGE not in free, "null page entered the free list"
-        assert self.owner[NULL_PAGE] == -1, "null page acquired an owner"
+        assert NULL_PAGE not in cached, "null page entered the cache"
+        assert self.refcount[NULL_PAGE] == 0, "null page got referenced"
         assert len(free) == len(self._free), "free list holds duplicates"
-        owned = set()
+        assert free.isdisjoint(cached)
+        want = np.zeros(self.n_pages, np.int64)
         for slot in range(self.max_slots):
             n_live = self.pages_for(int(self.lengths[slot]))
+            seen = set()
             for idx, p in enumerate(self.tables[slot]):
                 p = int(p)
                 if idx < n_live:
@@ -235,19 +443,30 @@ class BlockTables:
                         f"slot {slot} live page {idx} unassigned")
                 if p == NULL_PAGE:
                     continue
-                assert p not in owned, f"page {p} assigned twice"
-                owned.add(p)
-                assert self.owner[p] == slot, (slot, idx, p)
+                assert p not in seen, f"slot {slot} holds page {p} twice"
+                seen.add(p)
+                want[p] += 1
                 assert self.page_pos[p] == idx, (slot, idx, p)
-            if not self.active[slot]:
-                assert self.lengths[slot] == 0
+                assert slot in set(self.refs[p].tolist()), (slot, p)
+            if not self.lengths[slot]:
+                assert not self.active[slot]
                 assert (self.tables[slot] == NULL_PAGE).all()
-        assert free.isdisjoint(owned)
-        assert len(free) + len(owned) == self.n_pages - 1, (
-            "pages leaked: free + owned != pool")
+        assert (want == self.refcount).all(), "refcount drift vs tables"
+        assert (self.refcount >= 0).all(), "negative refcount"
         for p in range(self.n_pages):
-            if p != NULL_PAGE and p not in owned:
-                assert p in free, f"page {p} neither owned nor free"
+            lanes = [int(s) for s in self.refs[p] if s >= 0]
+            assert len(lanes) == self.refcount[p], (p, lanes)
+            assert len(set(lanes)) == len(lanes), f"page {p} lane dup"
+        referenced = set(np.flatnonzero(self.refcount > 0).tolist())
+        assert free.isdisjoint(referenced)
+        assert cached.isdisjoint(referenced)
+        assert len(free) + len(cached) + len(referenced) \
+            == self.n_pages - 1, "pages leaked: partition != pool"
+        assert len(self._index) == len(self._page_key)
+        for key, p in self._index.items():
+            assert self._page_key.get(p) == key, "index/page_key drift"
+        for p in cached:
+            assert p in self._page_key and self.refcount[p] == 0
 
 
 __all__ = ["BlockTables", "NULL_PAGE", "make_pool"]
