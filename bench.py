@@ -44,6 +44,12 @@ TTFT, tokens/s, hit rate, prefill chunks/compiles, modeled prefill
 FLOPs saved; BENCH_SPFX_REQUESTS/RATE/SLOTS/PAGE/PAGES/SEQ/LAYERS/
 KV_HEADS/SHARED/CHUNK_PAGES/CACHE_DTYPE shape it,
 BENCH_SKIP_SERVE_PREFIX skips);
+the serve_spec sub-bench (speculative decoding A/B: a repetitive
+greedy workload served spec-off vs spec-on through IDENTICAL
+geometry — decode tokens/s ratio, mean accepted draft length,
+accept rate, one-verify-compile proof, token parity;
+BENCH_SPEC_REQUESTS/SLOTS/PAGE/PAGES/SEQ/LAYERS/KV_HEADS/DRAFT/
+NGRAM_MIN/PERIOD/CACHE_DTYPE shape it, BENCH_SKIP_SERVE_SPEC skips);
 the obs sub-bench (telemetry-on vs telemetry-off A/B over the GPT
 step + recompile-sentinel verification; BENCH_SKIP_OBS skips);
 the comms sub-bench (gradient-sync A/B over the GPT step: implicit
@@ -580,6 +586,118 @@ def bench_serve_prefix() -> dict:
         cold / max(hit, 1e-9), 2)
     out[f"serve_prefix_shared_frac{suffix}"] = round(
         shared_len / (shared_len + float(np.mean(suf_lens))), 3)
+    return out
+
+
+def bench_serve_spec() -> dict:
+    """Speculative-decoding serving A/B (the PR-5 tentpole): a
+    REPETITIVE greedy workload — every prompt tiles a short random
+    pattern (period ``BENCH_SPEC_PERIOD``, default 16 tokens), the
+    traffic shape where prompt-lookup drafting shines (code,
+    extraction, templated continuations) — served through IDENTICAL
+    engine geometry twice: ``speculative`` OFF (the one-token control)
+    vs ON with ``BENCH_SPEC_DRAFT`` drafted tokens per verify step.
+
+    The decode roofline is pool BYTES per step; speculation leaves
+    bytes/step essentially unchanged (the verify sweep reads the same
+    pool once) and emits ``E[accepted] + 1`` tokens per read, so on an
+    HBM-bound loop the decode tokens/s ratio should track the mean
+    burst length (the acceptance target is >= 1.5x on this workload).
+    Emitted per arm: decode tokens/s and mean latency; plus the
+    ratio, accept rate, MEAN ACCEPTED draft length per verify step,
+    the one-verify-compile proof (and zero-decode-compile on the spec
+    arm / zero-verify on the control), and a token-parity bool — the
+    greedy spec-on streams must be byte-identical to spec-off through
+    the same trace, or the speedup is meaningless.
+
+    ``BENCH_SPEC_DRAFT`` is validated LOUDLY against the page
+    geometry here (not just in the engine): draft_len < 1 proposes
+    nothing and >= page_size breaks the one-page write-ahead bound.
+    """
+    from torchbooster_tpu.models.gpt import GPT, GPTConfig
+    from torchbooster_tpu.serving import (ContinuousBatcher,
+                                          PagedEngine, Request)
+
+    n_req = int(os.environ.get("BENCH_SPEC_REQUESTS", 12))
+    slots = int(os.environ.get("BENCH_SPEC_SLOTS", 8))
+    page = int(os.environ.get("BENCH_SPEC_PAGE", 64))
+    n_pages = int(os.environ.get("BENCH_SPEC_PAGES", 96))
+    seq = int(os.environ.get("BENCH_SPEC_SEQ", 2048))
+    n_layers = int(os.environ.get("BENCH_SPEC_LAYERS", 12))
+    kv = int(os.environ.get("BENCH_SPEC_KV_HEADS", 4))
+    draft = int(os.environ.get("BENCH_SPEC_DRAFT", 8))
+    ngram_min = int(os.environ.get("BENCH_SPEC_NGRAM_MIN", 2))
+    period = int(os.environ.get("BENCH_SPEC_PERIOD", 16))
+    cache_dtype = os.environ.get("BENCH_SPEC_CACHE_DTYPE") or None
+    suffix = f"_{cache_dtype}" if cache_dtype else ""
+    if not 1 <= draft < page:
+        raise ValueError(
+            f"BENCH_SPEC_DRAFT ({draft}) must satisfy 1 <= draft_len "
+            f"< page_size ({page}): below 1 nothing is ever drafted "
+            "and the verify step is pure overhead; at or above "
+            "page_size the verify write-ahead spans more than one "
+            "page past the cursor's, breaking the engine's "
+            "grow/preempt bound (PagedEngine enforces the same rule)")
+
+    # prompts: page-aligned tiles of a per-request random pattern —
+    # repetitive WITHIN a request (prompt lookup mines the slot's own
+    # stream), distinct ACROSS requests; outputs sized so prompt +
+    # output fits the horizon
+    prompt_len = max(period, min(4 * page, seq // 2) // period * period)
+    out_hi = max(2, min(129, seq - prompt_len))
+    rs = np.random.RandomState(0)
+    prompts = [np.tile(rs.randint(0, 50257, period, dtype=np.int32),
+                       prompt_len // period)
+               for _ in range(n_req)]
+    out_lens = rs.randint(min(32, out_hi - 1), out_hi, n_req)
+    warm = np.tile(rs.randint(0, 50257, period, dtype=np.int32),
+                   prompt_len // period)
+
+    def trace():
+        # all arrivals at 0: a standing batch keeps every decode step
+        # full on BOTH arms, so the ratio isolates the per-step token
+        # yield instead of arrival-process noise
+        return [Request(prompt=p, max_new_tokens=int(o))
+                for p, o in zip(prompts, out_lens)]
+
+    cfg = GPTConfig(n_layers=n_layers, seq_len=seq, n_kv_heads=kv)
+    params = GPT.init(jax.random.PRNGKey(0), cfg)
+
+    out = {}
+    tokens_by_arm = {}
+    for arm, enabled in (("off", False), ("on", True)):
+        engine = PagedEngine(params, cfg, page_size=page,
+                             n_pages=n_pages, max_slots=slots,
+                             cache_dtype=cache_dtype,
+                             speculative=enabled, draft_len=draft,
+                             ngram_min=ngram_min)
+        batcher = ContinuousBatcher(engine)
+        batcher.run([Request(prompt=warm, max_new_tokens=4)])
+        reqs = trace()
+        m = batcher.run(reqs)
+        tokens_by_arm[arm] = [list(r.tokens) for r in reqs]
+        out[f"serve_spec_tok_s_{arm}{suffix}"] = m["decode_tok_s"]
+        out[f"serve_spec_latency_{arm}_s{suffix}"] = m["latency_mean_s"]
+        if enabled:
+            out[f"serve_spec_accept_rate{suffix}"] = \
+                m["spec_accept_rate"]
+            out[f"serve_spec_mean_accepted{suffix}"] = \
+                m["spec_mean_accepted"]
+            out[f"serve_spec_verify_compiles{suffix}"] = \
+                engine.verify_compiles
+            out[f"serve_spec_decode_compiles_on{suffix}"] = \
+                engine.decode_compiles
+        else:
+            out[f"serve_spec_verify_compiles_off{suffix}"] = \
+                engine.verify_compiles
+    out[f"serve_spec_tok_s_ratio{suffix}"] = round(
+        out[f"serve_spec_tok_s_on{suffix}"]
+        / max(out[f"serve_spec_tok_s_off{suffix}"], 1e-9), 2)
+    out[f"serve_spec_draft_len{suffix}"] = draft
+    # greedy parity across the arms: the speedup row is only evidence
+    # if the spec arm emitted EXACTLY the control's tokens
+    out[f"serve_spec_token_parity{suffix}"] = \
+        tokens_by_arm["on"] == tokens_by_arm["off"]
     return out
 
 
@@ -1194,6 +1312,8 @@ def _sub_main(name: str) -> None:
         print(json.dumps(bench_serve()))
     elif name == "serve_prefix":
         print(json.dumps(bench_serve_prefix()))
+    elif name == "serve_spec":
+        print(json.dumps(bench_serve_spec()))
     elif name == "obs":
         print(json.dumps(bench_obs(max(4, steps // 4))))
     elif name == "comms":
@@ -1373,8 +1493,8 @@ def _deadline(name: str, default: int) -> int:
 # secondary sub-benches and their default deadlines, in run order
 _SECONDARY_BENCHES = (("gpt", 900), ("gpt_long", 1500), ("loader", 900),
                       ("unet", 900), ("decode", 1500), ("serve", 1800),
-                      ("serve_prefix", 1500), ("obs", 900),
-                      ("comms", 900))
+                      ("serve_prefix", 1500), ("serve_spec", 1500),
+                      ("obs", 900), ("comms", 900))
 
 
 def _driver_hold_budget() -> int:

@@ -102,7 +102,8 @@ def main() -> None:
     # in faster than this table — decode and gpt_chunked_b32 both did):
     # render them raw rather than silently dropping recorded evidence
     multi_key = ("decode", "decode_int8", "cifar_acc", "comms",
-                 "comms_cpu8", "serve_prefix", "serve_prefix_int8")
+                 "comms_cpu8", "serve_prefix", "serve_prefix_int8",
+                 "serve_spec", "serve_spec_int8")
     for name in sorted(attempts):
         if name in METRICS or (name in multi_key and name in latest):
             continue  # multi-key ok rows print below; failures fall through
@@ -144,6 +145,32 @@ def main() -> None:
                   f"| {r.get(f'serve_prefix_chunks_{arm}{sfx}', '—')} "
                   f"| {r.get(f'serve_prefix_hit_rate_{arm}{sfx}', '—')} "
                   f"| {r.get(f'serve_prefix_prefill_compiles_{arm}{sfx}', '—')} |")
+
+    # serve_spec rows: the speculative-decoding A/B rendered as an
+    # off-vs-on sub-table (decode tok/s, latency) plus the accept
+    # stats, compile proof, and greedy-parity bit
+    for name in ("serve_spec", "serve_spec_int8"):
+        e = latest.get(name)
+        if e is None:
+            continue
+        r = e.get("result") or {}
+        sfx = "_int8" if name.endswith("int8") else ""
+        print(f"\n{name} (draft_len "
+              f"{r.get(f'serve_spec_draft_len{sfx}', '?')}, tok/s "
+              f"ratio {r.get(f'serve_spec_tok_s_ratio{sfx}', '?')}x, "
+              f"accept rate "
+              f"{r.get(f'serve_spec_accept_rate{sfx}', '?')}, mean "
+              f"accepted {r.get(f'serve_spec_mean_accepted{sfx}', '?')}"
+              f"/step, verify compiles "
+              f"{r.get(f'serve_spec_verify_compiles{sfx}', '?')}, "
+              f"token parity "
+              f"{r.get(f'serve_spec_token_parity{sfx}', '?')}):")
+        print("| arm | decode tok/s | mean latency s |")
+        print("|---|---|---|")
+        for arm in ("off", "on"):
+            print(f"| {arm} "
+                  f"| {r.get(f'serve_spec_tok_s_{arm}{sfx}', '—')} "
+                  f"| {r.get(f'serve_spec_latency_{arm}_s{sfx}', '—')} |")
 
     # comms rows: bytes-moved + step-time deltas across the gradient
     # sync arms, rendered as a compact sub-table (one row per arm)

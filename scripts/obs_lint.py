@@ -46,6 +46,11 @@ HOT_PATHS = (
     "torchbooster_tpu/utils.py",
     "torchbooster_tpu/metrics.py",
     "torchbooster_tpu/scheduler.py",
+    # the whole serving package is step-cadence: engine decode/prefill,
+    # the batcher loop, AND speculative.py (host-side drafting runs
+    # between every verify dispatch — a stray sync there stalls the
+    # multi-token pipeline exactly like one in the decode loop;
+    # tests/test_obs_lint.py pins the coverage)
     "torchbooster_tpu/serving/",
     "torchbooster_tpu/observability/",
     "torchbooster_tpu/data/pipeline.py",

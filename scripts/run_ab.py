@@ -97,6 +97,16 @@ QUEUE: list[tuple[str, str, dict, int]] = [
     ("serve_prefix", "serve_prefix", {}, 1800),
     ("serve_prefix_int8", "serve_prefix",
      {"BENCH_SPFX_CACHE_DTYPE": "int8"}, 1800),
+    # speculative decoding (the PR-5 tentpole A/B): repetitive greedy
+    # workload served spec-off vs spec-on through identical geometry
+    # — decode tokens/s ratio (target >= 1.5x), mean accepted draft
+    # length, accept rate, one-verify-compile proof, and the
+    # greedy-token-parity bool (bench.bench_serve_spec); the int8 row
+    # asks whether the multi-token verify keeps the quantized pool's
+    # byte win
+    ("serve_spec", "serve_spec", {}, 1800),
+    ("serve_spec_int8", "serve_spec",
+     {"BENCH_SPEC_CACHE_DTYPE": "int8"}, 1800),
     # recipe accuracy on chip (VERDICT r4 #3): the shipped ResNet
     # CIFAR recipe end to end, ref hyperparams, 20 epochs — real
     # CIFAR-10 if a binary release is under the dataset root (none in

@@ -814,6 +814,102 @@ def test_gpt_generate_top_p():
     np.testing.assert_array_equal(np.asarray(full_p), np.asarray(plain))
 
 
+def test_make_pick_greedy_tie_and_dtype():
+    """The greedy rule the speculative verify reuses per position:
+    argmax ties resolve to the LOWEST token id in every logits dtype
+    (fp32/bf16/fp16), and the returned ids carry the requested dtype —
+    the exact contract the serving engine's token parity sits on."""
+    from torchbooster_tpu.models.gpt import _make_pick
+
+    logits = np.full((2, 8), -1.0, np.float32)
+    logits[0, 3] = logits[0, 5] = 2.0      # tie -> 3, never 5
+    logits[1, 6] = 2.0
+    for dt in (jnp.float32, jnp.bfloat16, jnp.float16):
+        for out_dt in (jnp.int32, jnp.int16):
+            pick = _make_pick(0.0, None, None, out_dt)
+            got = pick(jax.random.PRNGKey(0),
+                       jnp.asarray(logits, dt))
+            assert got.dtype == out_dt
+            np.testing.assert_array_equal(np.asarray(got), [3, 6])
+    # greedy never consumes the rng: the same logits pick the same
+    # token under any key (the serving engine splits a key per step
+    # regardless of mode — picks must not depend on it)
+    pick = _make_pick(0.0, None, None, jnp.int32)
+    np.testing.assert_array_equal(
+        np.asarray(pick(jax.random.PRNGKey(1), jnp.asarray(logits))),
+        np.asarray(pick(jax.random.PRNGKey(2), jnp.asarray(logits))))
+
+
+def test_filter_logits_topk_topp_composition():
+    """top-k ∩ top-p compose in the documented order: top-k caps the
+    candidate set FIRST, then top-p's cumulative mass is measured over
+    the top-k-filtered distribution — so the joint support can be
+    smaller than either filter alone, never larger, and renormalizing
+    over fewer survivors can admit a token top-p alone would not."""
+    from torchbooster_tpu.models.gpt import _filter_logits
+
+    # softmax masses ~ [0.64, 0.24, 0.09, 0.03]
+    logits = jnp.asarray([[4.0, 3.0, 2.0, 1.0]])
+
+    def support(**kw):
+        f = np.asarray(_filter_logits(
+            logits, kw.pop("temperature", 1.0),
+            kw.pop("top_k", None), kw.pop("top_p", None)))
+        return set(np.flatnonzero(np.isfinite(f[0])).tolist())
+
+    assert support(top_k=3) == {0, 1, 2}
+    assert support(top_p=0.7) == {0, 1}       # 0.64 < 0.7 <= 0.88
+    # composed: top_k=2 renormalizes to [0.73, 0.27] -> top_p=0.7
+    # keeps ONLY token 0 (smaller than either filter alone)
+    assert support(top_k=2, top_p=0.7) == {0}
+    # and the composition never exceeds the top-k set even when top_p
+    # alone would keep more
+    assert support(top_k=2, top_p=0.999) == {0, 1}
+    # batched-position shape (the verify step filters (S, K+1, V)):
+    # same per-row result as the 2-D path
+    stacked = jnp.tile(logits[None], (2, 3, 1))
+    f = np.asarray(_filter_logits(stacked, 1.0, 2, 0.7))
+    assert (np.isfinite(f).sum(-1) == 1).all()
+
+
+def test_seeded_sampling_parity_dense_vs_paged_step():
+    """Seeded-sampling parity (the satellite pin the speculative
+    verify builds on): the paged engine's per-step rng stream — one
+    split for the prefill pick, one per decode step — matches dense
+    ``jit_generate``'s exactly for a one-chunk prompt, so the same
+    seed yields the SAME sampled tokens through both paths (decisive
+    logits keep the categorical draw off the knife edge). The draw is
+    shape-coupled: ``categorical`` draws noise per logits ROW, so
+    parity holds at ``max_slots == batch`` — the pin documents that
+    contract too."""
+    from torchbooster_tpu.models.gpt import jit_generate
+    from torchbooster_tpu.serving import PagedEngine
+
+    cfg = GPTConfig(vocab=97, n_layers=2, d_model=32, n_heads=4,
+                    seq_len=32, n_kv_heads=2)
+    params = GPT.init(jax.random.PRNGKey(0), cfg)
+    params = {**params, "wte": {"table": params["wte"]["table"] * 4.0}}
+    ids = jax.random.randint(jax.random.PRNGKey(1), (1, 5), 0,
+                             cfg.vocab)
+    n_new = 8
+    seed = jax.random.PRNGKey(11)
+    gen = jit_generate(cfg, n_new=n_new, temperature=0.8, top_k=5,
+                       compute_dtype=jnp.float32)
+    want = np.asarray(gen(params, ids, seed))[0, 5:]
+
+    engine = PagedEngine(params, cfg, page_size=8, n_pages=16,
+                         max_slots=1, compute_dtype=jnp.float32,
+                         temperature=0.8, top_k=5, rng=seed,
+                         prefill_chunk_pages=1)   # prompt = 1 chunk
+    slot, first = engine.admit(np.asarray(ids[0]))
+    got = [first]
+    for _ in range(n_new - 1):
+        assert engine.grow_slots() == []
+        got.append(int(engine.step()[slot]))
+    np.testing.assert_array_equal(want, got)
+    engine.retire(slot)
+
+
 def test_gpt_pos_checkpoint_mismatch_is_loud():
     """A rope checkpoint run under pos="learned" (or the reverse) must
     raise, not silently run position-free."""

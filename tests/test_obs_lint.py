@@ -53,6 +53,22 @@ def test_lint_detects_each_smell(tmp_path):
     assert any("float(<call>)" in s for s in smells)
 
 
+def test_hot_paths_cover_step_cadence_serving_files():
+    """HOT_PATHS must keep covering the serving hot loop — including
+    speculative.py, whose host-side drafting runs between every verify
+    dispatch. The prefix rule covers new files automatically; this
+    pins it so a HOT_PATHS refactor to per-file entries cannot
+    silently drop one."""
+    lint = _load_lint()
+    for rel in ("torchbooster_tpu/serving/engine.py",
+                "torchbooster_tpu/serving/batcher.py",
+                "torchbooster_tpu/serving/speculative.py",
+                "torchbooster_tpu/serving/kv_pages.py"):
+        assert (REPO / rel).exists(), f"{rel} moved without this test"
+        assert any(rel.startswith(h) for h in lint.HOT_PATHS), (
+            f"{rel} fell out of obs_lint HOT_PATHS")
+
+
 def test_allowlist_matches_by_path_and_substring():
     lint = _load_lint()
     entries = [("torchbooster_tpu/metrics.py", "float(jax.device_get")]

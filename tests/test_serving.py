@@ -14,7 +14,14 @@
   one of referenced/cached/free);
 - the continuous batcher preserves per-request tokens through
   admission waves, chunk-interleaved prefill, and pool-pressure
-  preemption.
+  preemption;
+- speculative decoding (serving/speculative.py): greedy spec-on
+  output is token-for-token identical to the non-speculative paged
+  engine AND dense ``generate`` (MHA+GQA, bf16+int8 pages), exactly
+  ONE verify-step compile across accept-length/slot churn, zero
+  decode recompiles with speculation off, and the rewind invariants
+  (length never below the copy-on-write boundary, no cached page past
+  a rewound length) hold under randomized accept/reject/rewind churn.
 """
 import jax
 import jax.numpy as jnp
@@ -665,6 +672,451 @@ def test_batcher_eos_and_fit_validation():
         Request(prompt=np.zeros(0, np.int32))
 
 
+# ---- speculative decoding (serving/speculative.py) -----------------
+
+def _spec_tokens(engine, prompt, n_new):
+    """Drive a speculative engine one verify step at a time; returns
+    the first ``n_new`` emitted tokens."""
+    slot, first = engine.admit(prompt)
+    toks = [first]
+    while len(toks) < n_new:
+        assert engine.grow_slots() == []
+        toks.extend(engine.spec_step()[slot])
+    engine.retire(slot)
+    return toks[:n_new]
+
+
+def _repetitive_prompt(rs, n_base=3, reps=3):
+    return np.tile(rs.randint(0, 97, n_base).astype(np.int32), reps)
+
+
+@pytest.mark.parametrize("compute_dtype,cache_dtype,kv", [
+    # each param compiles a dense generate + two engines (~12s on the
+    # CPU rig), so only the widest-coverage pair rides tier-1; the
+    # rest keep full MHA/GQA × bf16/int8/fp32 coverage in the slow
+    # suite (the PR 1 precedent for the 870s tier-1 budget)
+    pytest.param(jnp.float32, None, 2, marks=pytest.mark.slow),
+    pytest.param(jnp.bfloat16, None, 2, marks=pytest.mark.slow),
+    (jnp.bfloat16, "int8", 2),     # the acceptance pair
+    pytest.param(jnp.float32, None, 0,      # full-MHA cache width
+                 marks=pytest.mark.slow),
+])
+def test_spec_greedy_parity(compute_dtype, cache_dtype, kv):
+    """The tentpole acceptance parity: speculative greedy decode is
+    token-for-token identical to the NON-speculative paged engine and
+    the dense control, across MHA+GQA and bf16+int8 pages — the
+    verify step reads every byte (prior context AND intra-draft) back
+    from the pool in pool dtype, exactly what sequential steps read.
+    A repetitive prompt makes prompt-lookup drafts actually accept
+    (asserted), so the multi-token path is exercised for real."""
+    from torchbooster_tpu.serving import PagedEngine
+
+    params, cfg = _decisive_model(n_kv_heads=kv)
+    prompt = _repetitive_prompt(np.random.RandomState(0))
+    n_new = 12
+    want = GPT.generate(params, jnp.asarray(prompt)[None], cfg,
+                        n_new=n_new, temperature=0.0,
+                        compute_dtype=compute_dtype,
+                        cache_dtype=cache_dtype)
+    want = np.asarray(want)[0, len(prompt):]
+    kw = dict(page_size=4, n_pages=16, max_slots=2,
+              cache_dtype=cache_dtype, compute_dtype=compute_dtype)
+    cold = PagedEngine(params, cfg, **kw)
+    got_cold = _paged_tokens(cold, prompt, n_new)
+    spec = PagedEngine(params, cfg, speculative=True, draft_len=3,
+                       **kw)
+    got_spec = _spec_tokens(spec, prompt, n_new)
+    np.testing.assert_array_equal(want, got_cold)
+    np.testing.assert_array_equal(want, got_spec)
+    assert spec.spec_accepted > 0, (
+        "the repetitive stream never accepted a draft — the "
+        "multi-token path was not exercised")
+    assert spec.verify_compiles == 1
+    assert spec.decode_compiles == 0    # spec decode never traces it
+    assert cold.verify_compiles == 0    # no verify artifact when off
+    spec.tables.check()
+
+
+def test_spec_one_verify_compile_accept_length_churn():
+    """The zero-recompile acceptance: one verify executable across a
+    randomized trace of admits/retires with wildly varying accept
+    lengths (repetitive prompts accept multi-token bursts, random
+    prompts draft nothing and sentinel-pad, near-horizon slots cap
+    their drafts) — draft_len is a trace-time constant, everything
+    else is values."""
+    from torchbooster_tpu.serving import PagedEngine
+
+    params, cfg = _decisive_model()                 # seq_len = 32
+    rs = np.random.RandomState(5)
+    engine = PagedEngine(params, cfg, page_size=4, n_pages=24,
+                         max_slots=3, compute_dtype=jnp.float32,
+                         speculative=True, draft_len=3)
+    accept_lens = set()
+    for trial in range(4):
+        prompts = [_repetitive_prompt(rs),
+                   rs.randint(0, 97, int(rs.randint(3, 9))
+                              ).astype(np.int32)]
+        slots = {engine.admit(p)[0] for p in prompts}
+        for _ in range(5):
+            assert engine.grow_slots() == []
+            out = engine.spec_step()
+            accept_lens.update(len(v) for v in out.values())
+            engine.tables.check()
+        for slot in slots:
+            engine.retire(slot)
+        engine.tables.check()
+    assert len(accept_lens) > 1, (
+        "every step emitted the same burst length — churn too tame "
+        "to prove accept-length independence")
+    assert engine.verify_compiles == 1, (
+        "accept-length/slot churn recompiled the verify step")
+    assert engine.decode_compiles == 0
+
+
+@pytest.mark.slow
+def test_spec_near_horizon_caps_draft_and_retires_clean():
+    """A slot whose remaining horizon is smaller than draft_len must
+    sentinel-cap its draft (the verify step diverts overflow writes
+    to the null page) and never advance past seq_len."""
+    from torchbooster_tpu.serving import PagedEngine
+
+    params, cfg = _decisive_model()                 # seq_len = 32
+    rs = np.random.RandomState(8)
+    prompt = np.tile(rs.randint(0, 97, 2).astype(np.int32), 13)  # 26
+    engine = PagedEngine(params, cfg, page_size=4, n_pages=16,
+                         max_slots=1, compute_dtype=jnp.float32,
+                         speculative=True, draft_len=3)
+    slot, first = engine.admit(prompt)
+    toks = [first]
+    while int(engine.tables.lengths[slot]) < cfg.seq_len:
+        assert engine.grow_slots() == []
+        toks.extend(engine.spec_step()[slot])
+        engine.tables.check()
+    assert int(engine.tables.lengths[slot]) == cfg.seq_len
+    want = np.asarray(GPT.generate(
+        params, jnp.asarray(prompt)[None], cfg,
+        n_new=cfg.seq_len - len(prompt), temperature=0.0,
+        compute_dtype=jnp.float32))[0, len(prompt):]
+    np.testing.assert_array_equal(want, toks[:len(want)])
+    engine.retire(slot)
+    engine.tables.check()
+    assert engine.verify_compiles == 1
+
+
+@pytest.mark.slow
+def test_spec_with_prefix_cache_batcher_end_to_end():
+    """Speculation composes with the prefix cache: shared-prompt
+    requests hit cached pages AND decode speculatively — every
+    request matches its dense reference, the rewind never touches a
+    shared page (check() asserts the copy-on-write boundary), and
+    the metrics dict carries the n_spec_* stable keys with real
+    values."""
+    from torchbooster_tpu.serving import (ContinuousBatcher,
+                                          PagedEngine, Request)
+
+    params, cfg = _decisive_model()
+    rs = np.random.RandomState(2)
+    shared = np.tile(rs.randint(0, 97, 4).astype(np.int32), 2)  # 8
+    prompts = [np.concatenate([shared,
+                               rs.randint(0, 97, n).astype(np.int32)])
+               for n in (3, 5, 3)]
+    n_new = 8
+
+    def dense(prompt):
+        out = GPT.generate(params, jnp.asarray(prompt)[None], cfg,
+                           n_new=n_new, temperature=0.0,
+                           compute_dtype=jnp.float32)
+        return np.asarray(out)[0, len(prompt):]
+
+    engine = PagedEngine(params, cfg, page_size=4, n_pages=24,
+                         max_slots=2, compute_dtype=jnp.float32,
+                         prefix_cache=True, prefill_chunk_pages=1,
+                         speculative=True, draft_len=3)
+    reqs = [Request(prompt=p, max_new_tokens=n_new) for p in prompts]
+    metrics = ContinuousBatcher(engine).run(reqs)
+    for p, r in zip(prompts, reqs):
+        np.testing.assert_array_equal(dense(p), r.tokens)
+    assert metrics["n_spec_steps"] > 0
+    assert metrics["n_spec_proposed"] >= metrics["n_spec_accepted"] > 0
+    assert 0 < metrics["spec_accept_rate"] <= 1
+    assert metrics["spec_mean_accepted"] > 0
+    assert metrics["prefix_hit_pages"] > 0   # the cache really hit
+    assert engine.verify_compiles == 1
+    assert engine.decode_compiles == 0
+    engine.tables.check()
+
+
+def test_spec_fit_check_reserves_write_ahead():
+    """Admission must reserve the speculative write-ahead:
+    ``grow_slots`` demands ``1 + draft_len`` positions past the
+    cursor before EVERY step, so a request whose worst-case output
+    fits the pool exactly would starve on its last page and
+    preempt-thrash itself (one full re-prefill per emitted token).
+    ``_check_fits`` rejects it loudly; one page more and the same
+    request completes with zero preemptions and greedy parity."""
+    from torchbooster_tpu.serving import (ContinuousBatcher,
+                                          PagedEngine, Request)
+
+    params, cfg = _decisive_model()                 # seq_len = 32
+    prompt = _repetitive_prompt(np.random.RandomState(3), reps=4)
+    kw = dict(page_size=4, max_slots=1, compute_dtype=jnp.float32,
+              speculative=True, draft_len=3)
+    # worst = 12 prompt + 4 output = 16 tokens = exactly the 4 usable
+    # pages — but the write-ahead peaks at 16 + 3 = 19 positions
+    tight = ContinuousBatcher(PagedEngine(params, cfg, n_pages=5,
+                                          **kw))
+    with pytest.raises(ValueError, match="write-ahead"):
+        tight.run([Request(prompt=prompt, max_new_tokens=4)])
+    roomy = ContinuousBatcher(PagedEngine(params, cfg, n_pages=6,
+                                          **kw))
+    req = Request(prompt=prompt, max_new_tokens=4)
+    m = roomy.run([req])
+    assert m["n_preemptions"] == 0
+    want = np.asarray(GPT.generate(
+        params, jnp.asarray(prompt)[None], cfg, n_new=4,
+        temperature=0.0, compute_dtype=jnp.float32))[0, len(prompt):]
+    np.testing.assert_array_equal(want, req.tokens)
+
+
+def test_batcher_max_new_tokens_1_retires_on_prefill_token():
+    """Batcher edge regression: a max_new_tokens=1 request must
+    retire on the token the PREFILL produced — the decode sweep (and,
+    with speculation on, the drafter and verify step) must never run:
+    the compiled-executable counts stay 0. The metrics dict still
+    carries the full stable key set including the n_spec_* fields,
+    as does the empty trace."""
+    from torchbooster_tpu.serving import (ContinuousBatcher,
+                                          PagedEngine, Request)
+
+    params, cfg = _decisive_model()
+    ids = np.asarray(jax.random.randint(jax.random.PRNGKey(3), (5,),
+                                        0, cfg.vocab))
+    want = np.asarray(GPT.generate(params, ids[None], cfg, n_new=1,
+                                   temperature=0.0,
+                                   compute_dtype=jnp.float32))[0, 5:]
+    spec_keys = ("n_spec_steps", "n_spec_proposed", "n_spec_accepted",
+                 "spec_accept_rate", "spec_mean_accepted")
+    for speculative in (False, True):
+        engine = PagedEngine(params, cfg, page_size=4, n_pages=16,
+                             max_slots=2, compute_dtype=jnp.float32,
+                             speculative=speculative, draft_len=3)
+        batcher = ContinuousBatcher(engine)
+        req = Request(prompt=ids, max_new_tokens=1)
+        metrics = batcher.run([req])
+        np.testing.assert_array_equal(want, req.tokens)
+        assert engine.decode_compiles == 0, (
+            "a 1-token request entered the decode sweep")
+        assert engine.verify_compiles == 0, (
+            "a 1-token request entered the verify step")
+        assert engine.spec_proposed == 0, (
+            "the drafter ran for a request that never decoded")
+        for key in spec_keys:
+            assert key in metrics
+            assert metrics[key] == 0
+        empty = batcher.run([])
+        for key in spec_keys:
+            assert key in empty and empty[key] == 0
+        engine.tables.check()
+
+
+def test_block_tables_write_ahead_and_rewind():
+    """ensure_write_pages allocates every page the verify write-ahead
+    needs in one shot; rewind resets the length without freeing the
+    draft-ahead pages and refuses to cross the prompt (and with it
+    the copy-on-write) floor."""
+    from torchbooster_tpu.serving import BlockTables
+
+    cfg = GPTConfig(seq_len=64)
+    bt = BlockTables(cfg, page_size=4, n_pages=20, max_slots=2)
+    bt.seat(0, np.arange(6, dtype=np.int32))        # 2 pages, len 6
+    bt.activate(0, 1)
+    # write-ahead of 4 from length 6 covers positions 6..9 -> page 2
+    assert bt.ensure_write_pages(0, 4)
+    assert bt.tables[0, 2] != 0 and bt.tables[0, 3] == 0
+    bt.check()
+    n_free = bt.n_free_pages
+    for t in (7, 8, 9):
+        bt.advance(0, t)                            # accept 3 of 4
+    bt.check()
+    # dropping positions invalidates last_ids (it points at dropped
+    # token 9) — rewind demands the accepted pending token back
+    with pytest.raises(ValueError, match="last_id"):
+        bt.rewind(0, 7)
+    bt.rewind(0, 7, last_id=7)                      # drop 2 of them
+    assert bt.lengths[0] == 7 and bt.last_ids[0] == 7
+    assert bt.n_free_pages == n_free                # pages kept
+    bt.check()
+    with pytest.raises(ValueError, match="rewind"):
+        bt.rewind(0, 5, last_id=5)                  # below the prompt
+    with pytest.raises(ValueError, match="rewind"):
+        bt.rewind(0, 8, last_id=8)                  # past the length
+    with pytest.raises(ValueError, match="not seated"):
+        bt.rewind(1, 1, last_id=1)
+    bt.retire(0)
+    bt.check()
+    # the horizon clamp: write-ahead at the cache edge allocates only
+    # the in-range pages and reports success
+    bt.seat(1, np.arange(62, dtype=np.int32))
+    bt.activate(1, 1)
+    assert bt.ensure_write_pages(1, 8)
+    assert bt.pages_for(64) == bt.max_pages_per_slot
+    bt.check()
+
+
+def test_block_tables_spec_rewind_churn_invariants():
+    """Satellite acceptance: randomized accept/reject/REWIND churn
+    with the prefix cache on — speculative write-ahead allocation,
+    partial advances, rewinds back to the accept boundary, retires
+    and re-seats over a tight pool. check() after every op asserts
+    the rewind invariants: slot length never below the copy-on-write
+    boundary, draft-ahead pages private and never index-reachable,
+    refcounts/partition exact."""
+    from torchbooster_tpu.serving import BlockTables, NULL_PAGE
+
+    cfg = GPTConfig(seq_len=64)
+    bt = BlockTables(cfg, page_size=4, n_pages=24, max_slots=4,
+                     prefix_cache=True)
+    rng = np.random.RandomState(13)
+    shared = rng.randint(0, 97, 12).astype(np.int32)   # 3 full pages
+    K = 3
+    live = {}
+    saw_rewind = saw_shared = False
+    for op in range(400):
+        roll = rng.rand()
+        slot = bt.free_slot()
+        if roll < 0.35 and slot is not None:
+            tail = rng.randint(0, 97,
+                               int(rng.randint(1, 14))).astype(np.int32)
+            prompt = (np.concatenate([shared, tail])
+                      if rng.rand() < 0.6 else tail)
+            if bt.pages_for(len(prompt)) <= bt.n_available_pages:
+                bt.seat(slot, prompt)
+                bt.activate(slot, int(rng.randint(0, 97)))
+                bt.register_prefix(slot, prompt)
+                live[slot] = True
+        elif roll < 0.8 and live:
+            slot = int(rng.choice(sorted(live)))
+            room = cfg.seq_len - int(bt.lengths[slot])
+            if room >= 1 and bt.ensure_write_pages(slot,
+                                                   min(1 + K, room)):
+                # a verify step: up to K+1 written, a+1 advanced —
+                # modeled as advance-through-the-draft then rewind
+                # to the accept boundary
+                n_adv = int(rng.randint(1, min(1 + K, room) + 1))
+                for _ in range(n_adv):
+                    bt.advance(slot, int(rng.randint(0, 97)))
+                back = int(rng.randint(0, n_adv))
+                if back and rng.rand() < 0.5:
+                    bt.rewind(slot, int(bt.lengths[slot]) - back,
+                              last_id=int(rng.randint(0, 97)))
+                    saw_rewind = True
+        elif live:
+            slot = int(rng.choice(sorted(live)))
+            bt.retire(slot)
+            del live[slot]
+        saw_shared |= bool((bt.refcount > 1).any())
+        bt.check()
+    assert saw_rewind, "churn never exercised a rewind"
+    assert saw_shared, "churn never shared a prefix page"
+    for slot in list(live):
+        bt.retire(slot)
+    bt.check()
+    assert bt.n_available_pages == bt.n_pages - 1
+    assert (bt.tables == NULL_PAGE).all()
+
+
+def test_prompt_lookup_drafter():
+    """Drafting mechanics: longest-suffix n-gram match, most recent
+    occurrence wins, sentinel padding when nothing matches (or the
+    continuation is short), and loud validation."""
+    from torchbooster_tpu.serving import NO_DRAFT, PromptLookupDrafter
+
+    d = PromptLookupDrafter(draft_len=3, ngram_min=2)
+    d.begin(0, np.array([1, 2, 3, 4, 1, 2], np.int32))
+    # suffix [1, 2] matched at position 0 -> continuation [3, 4, 1]
+    np.testing.assert_array_equal(d.draft(0), [3, 4, 1])
+    # most recent match wins: a LATER [1, 2] with a different
+    # continuation shadows the first
+    d.observe(0, [9, 1, 2])
+    np.testing.assert_array_equal(d.draft(0), [9, 1, 2])
+    # short continuation sentinel-pads
+    d.begin(1, np.array([5, 6, 5, 6], np.int32))
+    np.testing.assert_array_equal(d.draft(1), [5, 6, NO_DRAFT])
+    # no match at ngram_min or above -> all sentinel
+    d.begin(2, np.array([1, 2, 3, 4, 5], np.int32))
+    assert (d.draft(2) == NO_DRAFT).all()
+    # unknown/reset slots never draft
+    d.reset(0)
+    assert (d.draft(0) == NO_DRAFT).all()
+    assert (d.draft(7) == NO_DRAFT).all()
+    with pytest.raises(ValueError, match="draft_len"):
+        PromptLookupDrafter(draft_len=0)
+    with pytest.raises(ValueError, match="ngram_min"):
+        PromptLookupDrafter(draft_len=2, ngram_min=3, ngram_max=2)
+
+
+def test_spec_pick_mechanics():
+    """The per-position accept/token rule (_make_spec_pick): greedy
+    accepts exactly argmax==draft; sampling accepts with probability
+    p(draft) over the FILTERED distribution (certain for a
+    near-point-mass, never for a filtered-out token), the rejection
+    fallback never re-emits the rejected token, and sentinel
+    positions never accept."""
+    from torchbooster_tpu.models.gpt import _make_spec_pick
+
+    # greedy: logits with argmax [7, 3, 5] over 3 verify positions
+    logits = np.full((1, 3, 10), -5.0, np.float32)
+    for j, t in enumerate((7, 3, 5)):
+        logits[0, j, t] = 5.0
+    verify = _make_spec_pick(0.0, None, None, jnp.int32)
+    accept, token = verify(jax.random.PRNGKey(0),
+                           jnp.asarray(logits),
+                           jnp.asarray([[7, 9]], np.int32))
+    np.testing.assert_array_equal(np.asarray(accept), [[True, False]])
+    np.testing.assert_array_equal(np.asarray(token), [[7, 3, 5]])
+    # sentinel never accepts, even where argmax would continue
+    accept, _ = verify(jax.random.PRNGKey(0), jnp.asarray(logits),
+                       jnp.asarray([[7, -1]], np.int32))
+    np.testing.assert_array_equal(np.asarray(accept), [[True, False]])
+
+    # sampling: position 0's mass is ~all on token 7 -> always
+    # accepted; position 1 drafts token 9, which top_k=2 filters out
+    # (ranks 3rd) -> never accepted, and the fallback must not be 9
+    logits = np.zeros((1, 3, 10), np.float32)
+    logits[0, 0, 7] = 50.0
+    logits[0, 1, 3] = 5.0
+    logits[0, 1, 4] = 4.0
+    logits[0, 1, 9] = 3.0
+    verify = _make_spec_pick(1.0, 2, None, jnp.int32)
+    for seed in range(8):
+        accept, token = verify(jax.random.PRNGKey(seed),
+                               jnp.asarray(logits),
+                               jnp.asarray([[7, 9]], np.int32))
+        accept = np.asarray(accept)
+        token = np.asarray(token)
+        assert accept[0, 0], "p(draft) ~= 1 was rejected"
+        assert not accept[0, 1], "a filtered-out draft was accepted"
+        assert token[0, 1] in (3, 4), (
+            "rejection fallback left the filtered support or "
+            "re-emitted the rejected token")
+
+
+def test_engine_spec_validation():
+    from torchbooster_tpu.serving import PagedEngine
+
+    params, cfg = _decisive_model()
+    with pytest.raises(ValueError, match="draft_len"):
+        PagedEngine(params, cfg, page_size=4, speculative=True,
+                    draft_len=4)       # must stay < page_size
+    with pytest.raises(ValueError, match="draft_len"):
+        PagedEngine(params, cfg, page_size=4, speculative=True,
+                    draft_len=0)
+    engine = PagedEngine(params, cfg, page_size=4, n_pages=8,
+                         max_slots=1, compute_dtype=jnp.float32)
+    with pytest.raises(RuntimeError, match="speculative"):
+        engine.spec_step()
+
+
 def test_serving_config_builds_batcher():
     """config.py serving block → engine + batcher from typed YAML
     fields (the ``serving:`` section of docs/config.md)."""
@@ -709,3 +1161,12 @@ def test_serving_config_builds_batcher():
     strict = sc.make(params, cfg, compute_dtype=jnp.float32,
                      on_recompile="raise")
     assert strict.on_recompile == "raise"
+
+    # the speculative keys reach the engine; the default stays off
+    # (the cold engine carries NO verify artifact at all)
+    assert not batcher.engine.speculative
+    scs = ServingConfig(page_size=4, n_pages=16, max_slots=2,
+                        speculative=True, draft_len=3, ngram_min=2)
+    es = scs.make(params, cfg, compute_dtype=jnp.float32).engine
+    assert es.speculative and es.draft_len == 3
+    assert es.verify_compiles == 0          # built, never traced yet

@@ -644,6 +644,16 @@ class ServingConfig(BaseConfig):
     interleaves between decode steps: one compiled chunk shape serves
     every prompt length, and decode latency stays bounded by one
     chunk while long prompts stream in.
+
+    ``speculative: true`` switches decode to draft + batched-verify
+    (serving/speculative.py): model-free prompt-lookup drafting
+    proposes up to ``draft_len`` tokens per slot, one compiled verify
+    step scores them all, and each slot emits ``accepted + 1`` tokens
+    per pool read — greedy output stays token-identical to the cold
+    engine; ``temperature > 0`` uses distribution-exact rejection
+    sampling. ``ngram_min`` is the shortest history n-gram the
+    drafter will match. ``draft_len`` must stay below ``page_size``
+    (the engine validates loudly).
     """
 
     page_size: int = 64
@@ -655,6 +665,9 @@ class ServingConfig(BaseConfig):
     top_p: float = 0.0                 # 0 = off
     prefix_cache: bool = False         # share resident prompt prefixes
     prefill_chunk_pages: int = 4       # chunked-prefill granularity
+    speculative: bool = False          # draft + batched-verify decode
+    draft_len: int = 4                 # drafted tokens per verify step
+    ngram_min: int = 2                 # shortest prompt-lookup n-gram
 
     def make(self, params: Any, model_cfg: Any,
              compute_dtype: Any = None,
@@ -680,7 +693,9 @@ class ServingConfig(BaseConfig):
             temperature=self.temperature,
             top_k=self.top_k or None, top_p=self.top_p or None,
             prefix_cache=self.prefix_cache,
-            prefill_chunk_pages=self.prefill_chunk_pages)
+            prefill_chunk_pages=self.prefill_chunk_pages,
+            speculative=self.speculative,
+            draft_len=self.draft_len, ngram_min=self.ngram_min)
         return ContinuousBatcher(engine, on_recompile=on_recompile)
 
 

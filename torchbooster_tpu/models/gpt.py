@@ -986,41 +986,124 @@ def _lm_head(params: dict, x: jax.Array) -> jax.Array:
     return x @ params["wte"]["table"].astype(x.dtype).T
 
 
+def _filter_logits(logits: jax.Array, temperature: float,
+                   top_k: int | None, top_p: float | None) -> jax.Array:
+    """Temperature-scaled, top-k/top-p-filtered fp32 logits — THE
+    sampling distribution every decode flavor draws from, factored out
+    of :func:`_make_pick` so the speculative verify step
+    (serving/speculative.py) can compute acceptance probabilities over
+    the SAME filtered distribution it samples fallbacks from. Filters
+    compose in the fixed order the dense path always used: top-k caps
+    the candidate set first, then top-p's cumulative mass is measured
+    over the top-k-FILTERED distribution (so ``top_k=2, top_p=0.9``
+    can keep fewer tokens than either alone, never more). Works on any
+    ``(..., vocab)`` shape — the verify step filters a whole
+    ``(slots, draft+1, vocab)`` block at once; requires
+    ``temperature > 0`` (greedy never builds a distribution)."""
+    logits = logits.astype(jnp.float32) / temperature
+    if top_k is not None or top_p is not None:
+        # ONE descending sort serves both filters (this runs per
+        # token inside the decode scan)
+        desc = jnp.sort(logits, axis=-1)[..., ::-1]
+        if top_k is not None:
+            logits = jnp.where(logits < desc[..., top_k - 1:top_k],
+                               -jnp.inf, logits)
+            desc = jnp.where(
+                jnp.arange(desc.shape[-1]) < top_k,
+                desc, -jnp.inf)
+        if top_p is not None:
+            probs = jax.nn.softmax(desc, axis=-1)
+            # keep while the mass BEFORE a token is < p (top-1
+            # always in)
+            keep = jnp.cumsum(probs, axis=-1) - probs < top_p
+            thresh = jnp.min(jnp.where(keep, desc, jnp.inf),
+                             axis=-1, keepdims=True)
+            logits = jnp.where(logits >= thresh, logits, -jnp.inf)
+    return logits
+
+
 def _make_pick(temperature: float, top_k: int | None,
                top_p: float | None, dtype: Any):
     """``pick(rng_step, logits) -> ids`` — the next-token rule, shared
     by :func:`generate`'s decode scan and the serving engine's paged
     step (serving/engine.py) so filtering semantics cannot drift.
-    Greedy at ``temperature=0``; otherwise categorical over the
-    temperature-scaled logits with optional top-k and/or top-p
-    (nucleus) filtering — top_p keeps the smallest set of tokens whose
-    probability mass reaches p (always at least the top token)."""
+    Greedy at ``temperature=0`` (plain argmax: ties resolve to the
+    LOWEST token id, whatever the logits dtype); otherwise categorical
+    over :func:`_filter_logits` — top_p keeps the smallest set of
+    tokens whose probability mass reaches p (always at least the top
+    token)."""
 
     def pick(rng_step: jax.Array, logits: jax.Array) -> jax.Array:
         if temperature == 0:
             return jnp.argmax(logits, axis=-1).astype(dtype)
-        logits = logits.astype(jnp.float32) / temperature
-        if top_k is not None or top_p is not None:
-            # ONE descending sort serves both filters (this runs per
-            # token inside the decode scan)
-            desc = jnp.sort(logits, axis=-1)[:, ::-1]
-            if top_k is not None:
-                logits = jnp.where(logits < desc[:, top_k - 1][:, None],
-                                   -jnp.inf, logits)
-                desc = jnp.where(
-                    jnp.arange(desc.shape[-1])[None] < top_k,
-                    desc, -jnp.inf)
-            if top_p is not None:
-                probs = jax.nn.softmax(desc, axis=-1)
-                # keep while the mass BEFORE a token is < p (top-1
-                # always in)
-                keep = jnp.cumsum(probs, axis=-1) - probs < top_p
-                thresh = jnp.min(jnp.where(keep, desc, jnp.inf),
-                                 axis=-1, keepdims=True)
-                logits = jnp.where(logits >= thresh, logits, -jnp.inf)
-        return jax.random.categorical(rng_step, logits).astype(dtype)
+        return jax.random.categorical(
+            rng_step,
+            _filter_logits(logits, temperature, top_k, top_p)
+        ).astype(dtype)
 
     return pick
+
+
+def _make_spec_pick(temperature: float, top_k: int | None,
+                    top_p: float | None, dtype: Any):
+    """``verify(rng_step, logits, draft) -> (accept, token)`` — the
+    PER-POSITION pick + acceptance rule of speculative decoding
+    (serving/speculative.py), built from the same knobs as
+    :func:`_make_pick` so the two cannot drift.
+
+    ``logits`` is ``(S, K+1, vocab)``: position ``j``'s next-token
+    logits after consuming verify input ``j`` (input 0 is the slot's
+    pending token, inputs 1..K the drafted tokens). ``draft`` is
+    ``(S, K)`` proposed ids, ``-1`` = no proposal (sentinel padding —
+    short or absent drafts ride the same fixed-``K`` executable).
+
+    Greedy (``temperature == 0``): ``accept[s, j] = (argmax_j ==
+    draft[s, j])`` and ``token`` is the argmax chain — emitting
+    ``draft[:a] + [token[a]]`` (``a`` = longest accepted prefix)
+    reproduces the non-speculative greedy stream EXACTLY, because each
+    position's argmax is conditioned on a confirmed prefix.
+
+    Sampling: standard speculative rejection sampling (Leviathan et
+    al. 2023) against the deterministic point-mass prompt-lookup
+    draft, over the FILTERED distribution ``p = softmax(
+    _filter_logits(...))``: accept ``d_j`` with probability
+    ``p_j(d_j)`` (``u < p``); on rejection emit a sample from the
+    residual ``max(p_j - q_j, 0)`` renormalized — ``q`` a point mass,
+    so that is ``p_j`` with ``d_j`` removed — and a fully-accepted
+    chain emits a bonus sample from the untouched ``p_K``. The output
+    distribution is exactly the autoregressive sampling distribution.
+    Sentinel positions never accept and their fallback token is an
+    UNMASKED sample (no proposal to exclude)."""
+
+    def verify(rng_step: jax.Array, logits: jax.Array,
+               draft: jax.Array) -> tuple[jax.Array, jax.Array]:
+        k = draft.shape[1]
+        valid = draft >= 0
+        if temperature == 0:
+            picks = jnp.argmax(logits, axis=-1).astype(dtype)
+            accept = valid & (picks[:, :k] == draft)
+            return accept, picks
+        f = _filter_logits(logits, temperature, top_k, top_p)
+        probs = jax.nn.softmax(f, axis=-1)
+        d_c = jnp.clip(draft, 0, logits.shape[-1] - 1)
+        p_d = jnp.take_along_axis(probs[:, :k], d_c[..., None],
+                                  axis=-1)[..., 0]
+        k_u, k_r, k_b = jax.random.split(rng_step, 3)
+        # u in [0, 1): p_d == 1 always accepts, p_d == 0 (draft token
+        # filtered out, or sentinel via the valid mask) never does
+        u = jax.random.uniform(k_u, draft.shape)
+        accept = valid & (u < p_d)
+        # residual: the draft token masked OUT of the filtered logits
+        # (only where a real proposal exists — sentinels fall back to
+        # the plain filtered sample)
+        hit_d = (jnp.arange(logits.shape[-1]) == d_c[..., None]) \
+            & valid[..., None]
+        resid = jax.random.categorical(
+            k_r, jnp.where(hit_d, -jnp.inf, f[:, :k])).astype(dtype)
+        bonus = jax.random.categorical(k_b, f[:, k]).astype(dtype)
+        return accept, jnp.concatenate([resid, bonus[:, None]], axis=1)
+
+    return verify
 
 
 def _prefill_forward(params: dict, ids: jax.Array, cfg: GPTConfig,

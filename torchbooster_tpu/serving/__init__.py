@@ -16,7 +16,11 @@ package stops streaming dead bytes:
   ONE compiled prefill chunk serving every prompt length;
 - :mod:`batcher` — FCFS admission, one prefill chunk interleaved per
   decode step, preemption under pool pressure,
-  latency/TTFT/tokens-per-second + prefix-hit metrics.
+  latency/TTFT/tokens-per-second + prefix-hit + speculation metrics;
+- :mod:`speculative` — draft → batched-verify → accept/rewind decode
+  (``speculative: true``): model-free prompt-lookup drafting plus ONE
+  compiled multi-token verify step, so each pool read yields
+  ``accepted + 1`` tokens instead of one (greedy-parity-exact).
 
 Entry points: build a :class:`~torchbooster_tpu.serving.engine.
 PagedEngine` (or via ``ServingConfig.make`` from YAML), wrap it in a
@@ -30,6 +34,11 @@ from torchbooster_tpu.serving.kv_pages import (
     NULL_PAGE,
     make_pool,
 )
+from torchbooster_tpu.serving.speculative import (
+    NO_DRAFT,
+    PromptLookupDrafter,
+)
 
-__all__ = ["BlockTables", "ContinuousBatcher", "NULL_PAGE",
-           "PagedEngine", "Request", "make_pool"]
+__all__ = ["BlockTables", "ContinuousBatcher", "NO_DRAFT", "NULL_PAGE",
+           "PagedEngine", "PromptLookupDrafter", "Request",
+           "make_pool"]

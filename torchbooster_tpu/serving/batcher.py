@@ -109,11 +109,25 @@ class ContinuousBatcher:
                 f"prompt ({req.base_len}) + max_new_tokens "
                 f"({req.max_new_tokens}) exceeds cfg.seq_len "
                 f"({self.engine.cfg.seq_len})")
-        if self.engine.tables.pages_for(worst) > \
+        reserve = worst
+        if self.engine.speculative:
+            # grow_slots demands 1 + draft_len write positions ahead
+            # of the cursor on EVERY step, so a speculative request's
+            # page footprint peaks draft_len positions past its final
+            # token (clamped to the horizon) — admit against that
+            # peak, or a request sized exactly to the pool starves on
+            # its last page and preempt-thrashes itself (one full
+            # re-prefill per emitted token)
+            reserve = min(worst + self.engine.draft_len,
+                          self.engine.cfg.seq_len)
+        if self.engine.tables.pages_for(reserve) > \
                 (self.engine.n_pages - 1):
             raise ValueError(
-                f"request needs {worst} tokens of pages but the pool "
-                f"holds {self._capacity}; grow serving.n_pages")
+                f"request needs {reserve} tokens of pages "
+                + (f"({worst} prompt+output + the speculative "
+                   "write-ahead) " if reserve > worst else "")
+                + f"but the pool holds {self._capacity}; grow "
+                f"serving.n_pages")
 
     def run(self, requests: list[Request]) -> dict:
         if not requests:
@@ -122,11 +136,14 @@ class ContinuousBatcher:
                     "latency_mean_s": 0.0, "latency_p95_s": 0.0,
                     "ttft_mean_s": 0.0,
                     # stable key set: the preemption/admission/prefill
-                    # stats exist on EVERY return path, not just busy
-                    # ones
+                    # /speculation stats exist on EVERY return path,
+                    # not just busy ones
                     "n_admissions": 0, "n_preemptions": 0,
                     "n_prefill_chunks": 0, "prefix_hit_pages": 0,
-                    "prefix_hit_rate": 0.0}
+                    "prefix_hit_rate": 0.0,
+                    "n_spec_steps": 0, "n_spec_proposed": 0,
+                    "n_spec_accepted": 0, "spec_accept_rate": 0.0,
+                    "spec_mean_accepted": 0.0}
         for r in requests:
             self._check_fits(r)
         # a previous run that aborted mid-loop (engine error,
@@ -163,6 +180,15 @@ class ContinuousBatcher:
         hit_rate_gauge = reg.gauge(
             "serving_prefix_hit_rate",
             "prefix-cache page hit rate over this run")
+        spec_prop_ctr = reg.counter(
+            "serving_spec_proposed_total",
+            "draft tokens proposed to the speculative verify step")
+        spec_acc_ctr = reg.counter(
+            "serving_spec_accepted_total",
+            "draft tokens the verify step accepted")
+        spec_rate_gauge = reg.gauge(
+            "serving_spec_accept_rate",
+            "accepted/proposed draft tokens over this run")
         queue = sorted(requests, key=lambda r: r.arrival)
         live: dict[int, Request] = {}        # decoding
         filling: dict[int, Request] = {}     # seated, prefill streaming
@@ -176,6 +202,9 @@ class ContinuousBatcher:
         hits0 = self.engine.prefix_hit_pages
         lookups0 = self.engine.prefix_lookup_pages
         chunks0 = self.engine.prefill_chunks
+        spec_steps0 = self.engine.spec_steps
+        spec_prop0 = self.engine.spec_proposed
+        spec_acc0 = self.engine.spec_accepted
 
         def finish(slot: int) -> None:
             req = live.pop(slot)
@@ -198,13 +227,18 @@ class ContinuousBatcher:
             if hit_eos or len(req.tokens) >= req.max_new_tokens or full:
                 finish(slot)
 
-        # expected compiles in the watched region: the decode step's
-        # very first compile is legitimate; anything after is a broken
-        # geometry contract (engine.py's zero-recompile design)
+        # expected compiles in the watched region: the decode (or, in
+        # speculative mode, verify) step's very first compile is
+        # legitimate; anything after is a broken geometry contract
+        # (engine.py's zero-recompile design). One watch covers both
+        # executables — a spec engine must not quietly recompile its
+        # never-used decode step either.
+        step_compiles = lambda: (self.engine.decode_compiles
+                                 + self.engine.verify_compiles)
         sentinel = RecompileSentinel(
-            lambda: self.engine.decode_compiles,
+            step_compiles,
             on_recompile=self.on_recompile,
-            expected=0 if self.engine.decode_compiles else 1,
+            expected=0 if step_compiles() else 1,
             name="serving_decode", registry=reg)
         try:
             # `with sentinel` (not manual enter/exit): an exception
@@ -277,12 +311,35 @@ class ContinuousBatcher:
                         continue
                     # --- one compiled step over every live slot ---
                     t_step = self.clock()
-                    tokens = self.engine.step()
-                    decode_time += self.clock() - t_step
-                    decoded += len(live)
-                    tokens_ctr.inc(len(live))
-                    for slot in list(live):
-                        maybe_stop(slot, int(tokens[slot]))
+                    if self.engine.speculative:
+                        # draft → batched verify → accept: each slot
+                        # emits 1..draft_len+1 tokens per step; stop
+                        # checks run per token IN ORDER, so EOS or
+                        # max_new_tokens mid-burst truncates exactly
+                        # where sequential decode would have stopped
+                        emitted = self.engine.spec_step()
+                        decode_time += self.clock() - t_step
+                        # count DELIVERED tokens only: a burst tail
+                        # past EOS/max_new_tokens never reaches
+                        # req.tokens, and counting it would inflate
+                        # decode_tok_s vs the non-speculative arm
+                        # (whose every counted token is appended)
+                        delivered = 0
+                        for slot in sorted(emitted):
+                            for tok in emitted[slot]:
+                                if slot not in live:
+                                    break
+                                delivered += 1
+                                maybe_stop(slot, int(tok))
+                        decoded += delivered
+                        tokens_ctr.inc(delivered)
+                    else:
+                        tokens = self.engine.step()
+                        decode_time += self.clock() - t_step
+                        decoded += len(live)
+                        tokens_ctr.inc(len(live))
+                        for slot in list(live):
+                            maybe_stop(slot, int(tokens[slot]))
         finally:
             # exception or not, the gauges land on engine truth at
             # exit (an aborted run may leave seated slots — report
@@ -293,9 +350,15 @@ class ContinuousBatcher:
             hit_pages = self.engine.prefix_hit_pages - hits0
             lookups = self.engine.prefix_lookup_pages - lookups0
             n_chunks = self.engine.prefill_chunks - chunks0
+            n_spec_steps = self.engine.spec_steps - spec_steps0
+            n_spec_prop = self.engine.spec_proposed - spec_prop0
+            n_spec_acc = self.engine.spec_accepted - spec_acc0
             hit_pages_ctr.inc(hit_pages)
             chunks_ctr.inc(n_chunks)
             hit_rate_gauge.set(hit_pages / max(lookups, 1))
+            spec_prop_ctr.inc(n_spec_prop)
+            spec_acc_ctr.inc(n_spec_acc)
+            spec_rate_gauge.set(n_spec_acc / max(n_spec_prop, 1))
 
         elapsed = now()
         lat = [r.finished_at - r.arrival for r in requests]
@@ -321,6 +384,16 @@ class ContinuousBatcher:
             "n_prefill_chunks": n_chunks,
             "prefix_hit_pages": hit_pages,
             "prefix_hit_rate": round(hit_pages / max(lookups, 1), 4),
+            # speculation stats (all zero on a non-speculative
+            # engine): mean accepted DRAFT tokens per verify step —
+            # tokens/step is that + 1 (the fallback/bonus pick)
+            "n_spec_steps": n_spec_steps,
+            "n_spec_proposed": n_spec_prop,
+            "n_spec_accepted": n_spec_acc,
+            "spec_accept_rate": round(
+                n_spec_acc / max(n_spec_prop, 1), 4),
+            "spec_mean_accepted": round(
+                n_spec_acc / max(n_spec_steps, 1), 4),
         }
 
 

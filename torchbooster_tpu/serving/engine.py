@@ -76,6 +76,11 @@ from torchbooster_tpu.serving.kv_pages import (
     BlockTables,
     make_pool,
 )
+from torchbooster_tpu.serving.speculative import (
+    PromptLookupDrafter,
+    accept_count,
+    make_verify_fn,
+)
 
 
 class PagedEngine:
@@ -104,6 +109,17 @@ class PagedEngine:
     page per slot, so the identical compiled step streams the dense
     cache's bytes — the control row for the occupancy-proportional
     serving claim.
+
+    ``speculative=True`` switches decode to draft → batched-verify →
+    accept/rewind (serving/speculative.py): host-side prompt-lookup
+    drafting proposes up to ``draft_len`` tokens per slot and ONE
+    compiled multi-token verify step scores them all, emitting
+    ``accepted + 1`` tokens per pool read — greedy output stays
+    token-for-token identical to the non-speculative engine. Drive it
+    with :meth:`spec_step` (the batcher does); ``draft_len`` /
+    ``ngram_min`` tune the drafter. Off (the default), no verify
+    executable exists and the engine is bit-for-bit the
+    non-speculative one.
     """
 
     def __init__(self, params: dict, cfg: GPTConfig, *,
@@ -114,7 +130,10 @@ class PagedEngine:
                  top_p: float | None = None,
                  rng: jax.Array | None = None,
                  prefix_cache: bool = False,
-                 prefill_chunk_pages: int = 4):
+                 prefill_chunk_pages: int = 4,
+                 speculative: bool = False,
+                 draft_len: int = 4,
+                 ngram_min: int = 2):
         if cfg.seq_len % page_size:
             # a last partial page per slot would shift page_pos math;
             # geometry is static, so fail loudly at construction
@@ -125,6 +144,16 @@ class PagedEngine:
             raise ValueError(
                 f"prefill_chunk_pages must be >= 1, got "
                 f"{prefill_chunk_pages}")
+        if speculative and not 1 <= draft_len < page_size:
+            # the verify step writes 1 + draft_len positions per slot
+            # per step; draft_len < page_size bounds the write-ahead
+            # to at most ONE page past the cursor's, keeping the
+            # grow/preempt pressure of a speculative slot within one
+            # page of the non-speculative engine's
+            raise ValueError(
+                f"speculative decoding needs 1 <= draft_len < "
+                f"page_size, got draft_len={draft_len} with "
+                f"page_size={page_size}")
         # same params/config positional-encoding guard the dense
         # generate() applies — a rope checkpoint served with
         # pos="learned" (or vice versa, or a tp-major-permuted tree)
@@ -149,6 +178,9 @@ class PagedEngine:
         self.pool = make_pool(cfg, page_size, n_pages,
                               cache_dtype=cache_dtype,
                               compute_dtype=compute_dtype)
+        self.temperature = temperature
+        self.top_k = top_k
+        self.top_p = top_p
         self._pick = _make_pick(temperature, top_k, top_p, jnp.int32)
         self._rng = jax.random.PRNGKey(0) if rng is None else rng
         # in-flight chunked prefills, oldest first: dicts of
@@ -158,6 +190,9 @@ class PagedEngine:
         self.prefill_chunks = 0
         self.prefix_hit_pages = 0
         self.prefix_lookup_pages = 0
+        self.spec_proposed = 0
+        self.spec_accepted = 0
+        self.spec_steps = 0
         # the pool crosses the jit boundary EVERY call — donate it so
         # XLA updates the pages in place; an undonated pool would copy
         # pool-sized bytes per step, re-taxing exactly the HBM traffic
@@ -165,6 +200,20 @@ class PagedEngine:
         self._chunk_jit = jax.jit(self._chunk_fn, donate_argnums=(1, 2))
         self._decode_jit = jax.jit(self._decode_fn,
                                    donate_argnums=(1, 2))
+        # speculative mode (serving/speculative.py): the drafter and
+        # the ONE multi-token verify executable exist only when it is
+        # on — the cold engine's compiled artifacts and per-step work
+        # are BIT-FOR-BIT the non-speculative engine's (the same
+        # collapse contract as n_ref_lanes for the prefix cache)
+        self.speculative = bool(speculative)
+        self.draft_len = draft_len
+        self._drafter = None
+        self._verify_jit = None
+        if self.speculative:
+            self._drafter = PromptLookupDrafter(draft_len,
+                                                ngram_min=ngram_min)
+            self._verify_jit = jax.jit(make_verify_fn(self),
+                                       donate_argnums=(1, 2))
 
     @classmethod
     def dense_control(cls, params: dict, cfg: GPTConfig, *,
@@ -460,6 +509,10 @@ class PagedEngine:
             return None
         self.prefix_lookup_pages += (s0 - 1) // self.page_size
         self.prefix_hit_pages += n_matched
+        if self._drafter is not None:
+            # the prompt seeds the slot's lookup stream — prompt
+            # tokens are exactly what prompt-lookup drafting mines
+            self._drafter.begin(slot, prompt)
         # chunking starts at the matched boundary (page-aligned by
         # construction) — the cache hit's whole point is skipping the
         # matched pages' chunks; pad the tail to a whole chunk
@@ -513,6 +566,8 @@ class PagedEngine:
         first = int(np.asarray(tok)[0])
         self.tables.activate(p["slot"], first)
         self.tables.register_prefix(p["slot"], p["ids"][:p["s0"]])
+        if self._drafter is not None:
+            self._drafter.observe(p["slot"], [first])
         return p["slot"], first
 
     def admit(self, prompt_ids: np.ndarray) -> tuple[int, int] | None:
@@ -530,13 +585,17 @@ class PagedEngine:
                 return done
 
     def grow_slots(self) -> list[int]:
-        """Pre-allocate each active slot's next write page (evicting
-        cached prefixes under pressure); returns the slots that could
-        NOT get one (pool exhausted — the batcher preempts). Call
-        before every :meth:`step`."""
+        """Pre-allocate each active slot's upcoming write pages
+        (evicting cached prefixes under pressure): one position ahead
+        normally, ``1 + draft_len`` in speculative mode (the verify
+        step writes every drafted position, accepted or not). Returns
+        the slots that could NOT get their pages (pool exhausted —
+        the batcher preempts). Call before every :meth:`step` /
+        :meth:`spec_step`."""
+        ahead = 1 + (self.draft_len if self.speculative else 0)
         starved = []
         for slot in np.flatnonzero(self.tables.active):
-            if not self.tables.ensure_next_page(int(slot)):
+            if not self.tables.ensure_write_pages(int(slot), ahead):
                 starved.append(int(slot))
         return starved
 
@@ -562,7 +621,82 @@ class PagedEngine:
             tokens = np.asarray(tokens)
         for slot in np.flatnonzero(active):
             self.tables.advance(int(slot), int(tokens[slot]))
+            if self._drafter is not None:
+                self._drafter.observe(int(slot), [int(tokens[slot])])
         return tokens
+
+    def spec_step(self) -> dict[int, list[int]]:
+        """One speculative decode step over every ACTIVE slot: draft
+        (host-side prompt lookup), verify all ``1 + draft_len``
+        positions in the ONE compiled multi-token scoring step, accept
+        the longest confirmed prefix, and advance each slot by its
+        accepted tokens plus the fallback/bonus pick — between 1 and
+        ``draft_len + 1`` tokens per slot per step. Rejected draft
+        positions REWIND by simply not being advanced over: their
+        poisoned K/V sits past ``lengths`` (invisible to every mask)
+        and the next step's writes cover it; their pages are private
+        and never enter the prefix index (kv_pages.check()).
+
+        Returns ``{slot: [tokens]}`` in slot order — multi-token
+        emission is why this cannot share :meth:`step`'s fixed
+        ``(max_slots,)`` return. Requires ``speculative=True``."""
+        if not self.speculative:
+            raise RuntimeError(
+                "spec_step() needs a PagedEngine(speculative=True); "
+                "the cold engine decodes through step()")
+        active = self.tables.active.copy()
+        if active.any():
+            full = self.tables.lengths[active] >= self.cfg.seq_len
+            if full.any():
+                raise RuntimeError(
+                    "a slot reached cfg.seq_len; the batcher must "
+                    "retire sequences at the cache horizon")
+        k = self.draft_len
+        drafts = np.full((self.max_slots, k), -1, np.int32)
+        for slot in np.flatnonzero(active):
+            slot = int(slot)
+            d = self._drafter.draft(slot)
+            # horizon cap: drafted position j writes at lengths+1+j,
+            # which must stay inside the slot's table — positions
+            # past it are sentinelled out (the verify step ALSO
+            # diverts overflow writes to the null page, so this is
+            # belt and braces, not the only guard)
+            room = int(self.cfg.seq_len - self.tables.lengths[slot]) - 1
+            if room < k:
+                d[max(room, 0):] = -1
+            drafts[slot] = d
+            self.spec_proposed += int((d >= 0).sum())
+        self._rng, sub = jax.random.split(self._rng)
+        args = self.tables.device_args()
+        in_ids = jnp.concatenate(
+            [args["last_ids"][:, None], jnp.asarray(drafts)], axis=1)
+        with span("spec_verify_step"):
+            accept, token, pool_k, pool_v = self._verify_jit(
+                self.params, self.pool["k"], self.pool["v"],
+                args["tables"], args["lengths"], args["refs"],
+                args["page_pos"], args["active"], in_ids, sub)
+            self.pool = {"k": pool_k, "v": pool_v}
+            # ONE batched device->host sync for both results (two
+            # np.asarray calls would serialize two round-trips into
+            # the decode loop)
+            accept, token = jax.device_get((accept, token))
+        self.spec_steps += 1
+        out: dict[int, list[int]] = {}
+        for slot in np.flatnonzero(active):
+            slot = int(slot)
+            a = accept_count(accept[slot])
+            emitted = [int(t) for t in drafts[slot, :a]] \
+                + [int(token[slot, a])]
+            # a request retiring AT the horizon may accept its way
+            # right up to seq_len — never past it
+            room = int(self.cfg.seq_len - self.tables.lengths[slot])
+            emitted = emitted[:room]
+            self.spec_accepted += min(a, len(emitted))
+            for t in emitted:
+                self.tables.advance(slot, t)
+            self._drafter.observe(slot, emitted)
+            out[slot] = emitted
+        return out
 
     def retire(self, slot: int) -> None:
         """Release the slot (cancelling any in-flight prefill); shared
@@ -570,6 +704,8 @@ class PagedEngine:
         frees (kv_pages.py refcount/evict lifetime)."""
         self._pending = [p for p in self._pending
                          if p["slot"] != slot]
+        if self._drafter is not None:
+            self._drafter.reset(slot)
         self.tables.retire(slot)
 
     @property
@@ -591,6 +727,22 @@ class PagedEngine:
         lengths arrive (chunk position/length/page-ids are traced
         values, never shapes)."""
         return self._chunk_jit._cache_size()
+
+    @property
+    def verify_compiles(self) -> int:
+        """Compiled speculative verify-step count — exactly ONE
+        whatever accept lengths, draft availability, and slot churn a
+        trace produces (``draft_len`` is fixed at trace time, short
+        drafts sentinel-pad); always 0 with ``speculative=False``
+        (the verify executable does not exist on the cold engine)."""
+        return (self._verify_jit._cache_size()
+                if self._verify_jit is not None else 0)
+
+    @property
+    def spec_accept_rate(self) -> float:
+        """Fraction of proposed draft tokens the verify step
+        accepted."""
+        return self.spec_accepted / max(self.spec_proposed, 1)
 
 
 __all__ = ["PagedEngine"]

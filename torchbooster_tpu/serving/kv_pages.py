@@ -146,6 +146,15 @@ class BlockTables:
         self.tables = np.full((max_slots, self.max_pages_per_slot),
                               NULL_PAGE, np.int32)
         self.lengths = np.zeros(max_slots, np.int32)
+        # rewind floors (speculative decoding, serving/speculative.py):
+        # cow_len is the copy-on-write boundary — the shared/cached
+        # prefix pages mapped at seat time end here, so the write
+        # cursor (== lengths) must never drop below it; prompt_len is
+        # the stricter floor rewind enforces (registered prefix pages
+        # all sit inside the prompt, so a rewind can never strand an
+        # index entry past the live length)
+        self.cow_len = np.zeros(max_slots, np.int32)
+        self.prompt_len = np.zeros(max_slots, np.int32)
         self.refcount = np.zeros(n_pages, np.int32)
         # reference lanes: with the prefix cache every slot may share
         # one page, so a page needs max_slots lanes; without it no
@@ -268,6 +277,8 @@ class BlockTables:
                     self._lru[p] = tick
             raise
         self.lengths[slot] = len(prompt)
+        self.prompt_len[slot] = len(prompt)
+        self.cow_len[slot] = n_matched * self.page_size
         self.last_ids[slot] = 0
         return self.tables[slot, :n_total].copy(), n_matched
 
@@ -303,18 +314,87 @@ class BlockTables:
 
     def ensure_next_page(self, slot: int) -> bool:
         """Make sure the page that position ``lengths[slot]`` (the
-        next write) lands in exists; allocates one page at a page
-        boundary, evicting a cached prefix page if the free list is
-        empty. Returns False when the pool is truly exhausted (the
-        batcher then preempts) — the slot is untouched."""
+        next write) lands in exists — the ``n_tokens=1`` case of
+        :meth:`ensure_write_pages`."""
+        return self.ensure_write_pages(slot, 1)
+
+    def ensure_write_pages(self, slot: int, n_tokens: int = 1) -> bool:
+        """Make sure pages exist for the next ``n_tokens`` write
+        positions ``[lengths, lengths + n_tokens)`` (clamped to the
+        cache horizon); allocates every missing table entry in one
+        shot, evicting cached prefix pages under pressure. The
+        speculative verify step writes ``1 + draft_len`` positions
+        per step, so it needs up to two pages ahead (``draft_len <
+        page_size``); positions past a rejected draft keep their
+        pages — always PRIVATE ones (the write cursor sits past the
+        copy-on-write boundary), overwritten by the next step's
+        writes before any visibility mask can reach them. Returns
+        False when the pool is truly exhausted (the batcher then
+        preempts) — the slot is untouched (:meth:`_alloc` checks
+        capacity before evicting anything)."""
         length = int(self.lengths[slot])
-        idx = length // self.page_size
-        if length % self.page_size or self.tables[slot, idx] != NULL_PAGE:
+        last = min(length + n_tokens, self.seq_len) - 1
+        if last < length:
             return True
-        if not self._free and not self._evict(1):
+        idx = [i for i in range(length // self.page_size,
+                                last // self.page_size + 1)
+               if self.tables[slot, i] == NULL_PAGE]
+        if not idx:
+            return True
+        try:
+            self._alloc(slot, np.asarray(idx))
+        except RuntimeError:
             return False
-        self._alloc(slot, np.array([idx]))
         return True
+
+    def rewind(self, slot: int, new_length: int,
+               last_id: int | None = None) -> None:
+        """Explicitly reset the slot's length to drop speculatively
+        written positions. ``PagedEngine.spec_step`` itself never
+        needs this call — it only ever :meth:`advance`\\ s over
+        ACCEPTED tokens, so rejected draft K/V is born past
+        ``lengths`` (the rewind is implicit) — but a custom driver
+        that advances optimistically, or anything else that must
+        shrink a slot, goes through here so the floors below are
+        enforced in ONE place (and ``check()`` asserts them for every
+        slot, however its length got there). The device wrote K/V for
+        every drafted position, but only the accepted prefix is real —
+        dropping ``lengths`` back to ``new_length`` makes the poisoned
+        tail invisible (every mask reads ``tok_pos <= lengths``) and
+        the next step's writes land on top of it before it can ever
+        surface. Pages past ``new_length`` stay allocated (they are
+        the slot's PRIVATE draft-ahead pages — about to be re-used)
+        and are never registered into the prefix index (only prompt
+        pages register, at prefill time). The floor is the prompt: a
+        rewind below ``prompt_len`` would re-open registered prefix
+        pages — and below ``cow_len`` shared/cached pages — to decode
+        writes, so both are rejected loudly. A rewind that actually
+        drops positions leaves ``last_ids`` pointing at a DROPPED
+        token — the next step would embed a rejected token as the
+        slot's pending input and generate from it silently — so the
+        caller must pass ``last_id``, the accepted pending token at
+        position ``new_length`` (the tables don't store the token
+        stream and cannot restore it themselves)."""
+        if not self.lengths[slot]:
+            raise ValueError(f"slot {slot} is not seated")
+        floor = int(self.prompt_len[slot])
+        if not floor <= new_length <= int(self.lengths[slot]):
+            raise ValueError(
+                f"rewind target {new_length} outside "
+                f"[prompt_len={floor}, lengths="
+                f"{int(self.lengths[slot])}] for slot {slot} — a "
+                "rewind below the prompt (and the copy-on-write "
+                f"boundary at {int(self.cow_len[slot])}) would expose "
+                "registered/shared prefix pages to decode writes")
+        if new_length < int(self.lengths[slot]):
+            if last_id is None:
+                raise ValueError(
+                    f"rewinding slot {slot} drops the token last_ids "
+                    "points at; pass last_id (the accepted pending "
+                    f"token at position {new_length}) or the next "
+                    "step decodes from a rejected token")
+            self.last_ids[slot] = last_id
+        self.lengths[slot] = new_length
 
     def advance(self, slot: int, token_id: int) -> None:
         """Record one decoded token (already written on device at
@@ -336,6 +416,8 @@ class BlockTables:
                 self._unref(slot, int(p))
         self.tables[slot] = NULL_PAGE
         self.lengths[slot] = 0
+        self.cow_len[slot] = 0
+        self.prompt_len[slot] = 0
         self.active[slot] = False
         self.last_ids[slot] = 0
 
@@ -448,9 +530,33 @@ class BlockTables:
                 want[p] += 1
                 assert self.page_pos[p] == idx, (slot, idx, p)
                 assert slot in set(self.refs[p].tolist()), (slot, p)
-            if not self.lengths[slot]:
+                if idx >= n_live:
+                    # draft-ahead pages past a rewound length: PRIVATE
+                    # (a shared page past the live range would serve
+                    # poisoned K/V to its sharers) and never reachable
+                    # through the prefix index (a cached/registered
+                    # page there would replay rejected drafts into a
+                    # later request's context)
+                    assert self.refcount[p] == 1, (
+                        f"page {p} shared past slot {slot}'s length")
+                    assert p not in self._page_key, (
+                        f"registered prefix page {p} reachable past "
+                        f"slot {slot}'s rewound length")
+            if self.lengths[slot]:
+                # the rewind floors: the write cursor (== lengths)
+                # never re-enters the shared/cached prefix region, nor
+                # the registered prompt pages
+                assert self.lengths[slot] >= self.cow_len[slot], (
+                    f"slot {slot} length {int(self.lengths[slot])} "
+                    f"below the copy-on-write boundary "
+                    f"{int(self.cow_len[slot])}")
+                assert self.lengths[slot] >= self.prompt_len[slot], (
+                    f"slot {slot} rewound below its prompt")
+            else:
                 assert not self.active[slot]
                 assert (self.tables[slot] == NULL_PAGE).all()
+                assert self.cow_len[slot] == 0
+                assert self.prompt_len[slot] == 0
         assert (want == self.refcount).all(), "refcount drift vs tables"
         assert (self.refcount >= 0).all(), "negative refcount"
         for p in range(self.n_pages):

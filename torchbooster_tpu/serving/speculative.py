@@ -1,0 +1,315 @@
+"""Speculative decoding for the paged serving engine: draft →
+batched-verify → accept/rewind.
+
+The decode roofline (docs/performance.md) is BYTES-bound: every
+non-speculative step streams the whole page pool once to produce ONE
+token per slot, leaving the MXU mostly idle. Speculative decoding
+converts that idle compute into extra tokens per pool read (Leviathan
+et al., *Fast Inference from Transformers via Speculative Decoding*;
+Fu et al., *Lookahead Decoding*): propose ``k`` tokens per slot,
+score all ``k + 1`` positions in ONE multi-token verify step, keep the
+longest model-confirmed prefix, and emit one extra fallback/bonus
+token — ``E[accepted] + 1`` tokens per step for roughly one step's
+pool bytes.
+
+Three cooperating pieces, all slotting into the existing engine
+lifecycle (serving/engine.py drives them from ``spec_step``):
+
+- :class:`PromptLookupDrafter` — MODEL-FREE drafting by prompt lookup
+  (n-gram match over the slot's own prompt + emitted tokens, the
+  trick behind `prompt-lookup decoding`): host-side numpy over tokens
+  the engine already tracks, zero extra HBM, no draft model to load
+  or keep resident. Repetitive traffic (code, extraction, few-shot
+  continuations, self-repeating chat) drafts well; novel text simply
+  drafts nothing and the engine degrades to ordinary one-token decode
+  THROUGH THE SAME compiled executable (sentinel padding).
+- :func:`make_verify_fn` — the ONE compiled multi-token scoring step:
+  the decode pool sweep generalized from one query per (page, lane)
+  to ``k + 1`` (the draft positions ride the query axis exactly like
+  the PR 4 refs lanes do), with per-position causal visibility
+  ``tok_pos <= lengths + j``. All ``k + 1`` tokens' K/V are written
+  to the slot's (always private) pages FIRST, then the sweep reads
+  them back in pool dtype — so every verified position attends
+  bitwise the same bytes the non-speculative engine would have read
+  on its own step (including the int8 quantize→dequantize round
+  trip), which is what makes greedy parity exact rather than
+  approximate. ``k`` is FIXED at trace time and short drafts are
+  sentinel-padded, so accept-length churn can never recompile
+  (``PagedEngine.verify_compiles`` stays 1 — test- and
+  sentinel-guarded).
+- acceptance — :func:`accept_count` (host) over the per-position rule
+  built by ``models/gpt.py::_make_spec_pick``: longest-prefix under
+  greedy (token-for-token identical to the non-speculative engine),
+  standard rejection sampling against the point-mass draft under
+  ``temperature > 0`` (distribution-exact). The REWIND of rejected
+  positions is ``BlockTables`` bookkeeping: the engine only advances
+  ``lengths`` over accepted tokens, so the poisoned tail K/V sits
+  past the slot's length — invisible to every mask (they all read
+  ``tok_pos <= lengths``) and overwritten by the next step's writes,
+  which start at the new length and always extend past the old
+  draft horizon. Rejected positions' pages are PRIVATE by
+  construction (the write cursor never re-enters the copy-on-write
+  prefix region) and never enter the prefix index
+  (``kv_pages.check()`` asserts both).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from torchbooster_tpu.models import layers as L
+from torchbooster_tpu.models.gpt import (
+    _block_core,
+    _grouped_cache_attention,
+    _lm_head,
+    _make_spec_pick,
+    _quantize_kv,
+)
+from torchbooster_tpu.serving.kv_pages import NULL_PAGE
+
+# "no proposal" marker in a fixed-width draft row: the verify step
+# never accepts it (ids are non-negative) and its fallback pick is an
+# ordinary sample, so an empty draft IS a plain one-token decode
+# through the same executable
+NO_DRAFT = -1
+
+
+class PromptLookupDrafter:
+    """Per-slot prompt-lookup drafting state.
+
+    ``begin(slot, prompt)`` seeds a slot's token stream at admission,
+    ``observe(slot, tokens)`` appends emitted tokens, ``reset(slot)``
+    drops the stream at retirement, ``draft(slot)`` proposes up to
+    ``draft_len`` continuation tokens: the longest suffix n-gram of
+    the stream (``ngram_max`` down to ``ngram_min`` tokens) is
+    searched for an EARLIER occurrence, most recent match wins, and
+    the tokens that followed it are the draft. Unfilled positions are
+    ``NO_DRAFT`` sentinels. Pure host-side integer matching — the
+    "draft model" is the sequence's own history, so drafting costs no
+    HBM, no weights, and no device step. The match scans at most the
+    last ``lookback`` stream tokens (serving/ is an obs_lint hot
+    path: this bounds the per-step host work to O(lookback) however
+    long a slot has been generating; matches older than the window —
+    none, at the default, for any stream the cache horizon admits —
+    are simply not proposed)."""
+
+    def __init__(self, draft_len: int, ngram_min: int = 2,
+                 ngram_max: int = 8, lookback: int = 4096):
+        if draft_len < 1:
+            raise ValueError(
+                f"draft_len must be >= 1, got {draft_len}")
+        if not 1 <= ngram_min <= ngram_max:
+            raise ValueError(
+                f"need 1 <= ngram_min <= ngram_max, got "
+                f"ngram_min={ngram_min}, ngram_max={ngram_max}")
+        if lookback < ngram_max + draft_len:
+            raise ValueError(
+                f"lookback ({lookback}) shorter than one match + "
+                f"continuation (ngram_max={ngram_max} + "
+                f"draft_len={draft_len}) can never draft")
+        self.draft_len = draft_len
+        self.ngram_min = ngram_min
+        self.ngram_max = ngram_max
+        self.lookback = lookback
+        self._streams: dict[int, list[int]] = {}
+
+    def begin(self, slot: int, prompt: np.ndarray) -> None:
+        self._streams[slot] = [int(t) for t in np.asarray(prompt)]
+
+    def observe(self, slot: int, tokens) -> None:
+        if slot in self._streams:
+            self._streams[slot].extend(int(t) for t in tokens)
+
+    def reset(self, slot: int) -> None:
+        self._streams.pop(slot, None)
+
+    def draft(self, slot: int) -> np.ndarray:
+        """``(draft_len,)`` int32 proposal for the slot's NEXT tokens
+        (``NO_DRAFT``-padded)."""
+        out = np.full(self.draft_len, NO_DRAFT, np.int32)
+        stream = self._streams.get(slot)
+        if not stream or len(stream) < self.ngram_min + 1:
+            return out
+        h = np.asarray(stream[-self.lookback:], np.int32)
+        hi = min(self.ngram_max, len(h) - 1)
+        for n in range(hi, self.ngram_min - 1, -1):
+            # candidate starts 0 .. len-n-1: the window must END
+            # before the stream's last token so at least one
+            # continuation token exists (and the suffix itself —
+            # start len-n — is excluded)
+            m = len(h) - n
+            if m <= 0:
+                continue
+            win = np.lib.stride_tricks.sliding_window_view(h, n)[:m]
+            hits = np.flatnonzero((win == h[-n:]).all(axis=1))
+            if hits.size:
+                s = int(hits[-1])
+                cont = h[s + n:s + n + self.draft_len]
+                out[:len(cont)] = cont
+                return out
+        return out
+
+
+def accept_count(accept_row: np.ndarray) -> int:
+    """Length of the leading accepted prefix of one slot's verify
+    result — the ``a`` of draft → verify → emit ``draft[:a] +
+    [token[a]]``."""
+    rej = np.flatnonzero(~np.asarray(accept_row, bool))
+    return int(rej[0]) if rej.size else len(accept_row)
+
+
+def make_verify_fn(engine):
+    """Build the engine's ONE compiled multi-token verify step.
+
+    ``fn(params, pool_k, pool_v, tables, lengths, refs, page_pos,
+    active, in_ids, rng) -> (accept, token, pool_k, pool_v)`` where
+    ``in_ids`` is ``(max_slots, 1 + draft_len)``: column 0 each slot's
+    pending token, columns 1.. the draft (``NO_DRAFT``-padded). Shapes
+    depend ONLY on pool geometry, the model config, and the
+    trace-time-fixed ``draft_len`` — slot churn, accept-length churn,
+    and draft availability all change VALUES, so this compiles exactly
+    once (the same zero-recompile contract as the decode step, and
+    the engine's ``verify_compiles`` observable).
+
+    Structure: embed every slot's ``k + 1`` inputs at its own depths,
+    write all their K/V into the slot's pages (position ``lengths +
+    j`` — always past the copy-on-write boundary; horizon-overflow
+    and dead-slot writes divert to the reserved null page), then run
+    the decode pool sweep with the draft positions riding the query
+    axis beside the refs lanes: page × lane × position partials merge
+    per (slot, position) with the same online-softmax segment combine,
+    and every read comes back in POOL dtype — the intra-draft causal
+    part included, which is exactly what a sequence of non-speculative
+    steps would have read (greedy parity is therefore exact, int8
+    pages included). The per-position pick/accept rule is
+    ``_make_spec_pick`` (models/gpt.py) over the final logits."""
+    cfg, ps = engine.cfg, engine.page_size
+    k = engine.draft_len
+    S = k + 1
+    head_dim = cfg.d_model // cfg.n_heads
+    spec_pick = _make_spec_pick(engine.temperature, engine.top_k,
+                                engine.top_p, jnp.int32)
+
+    def verify_fn(params, pool_k, pool_v, tables, lengths, refs,
+                  page_pos, active, in_ids, rng):
+        n_slots = in_ids.shape[0]
+        mp = tables.shape[1]
+        positions = lengths[:, None] + jnp.arange(S)     # (B, S)
+        # clipped twins for table lookups: sentinel ids embed as 0 and
+        # horizon-overflow positions rope/embed at the last row — both
+        # produce garbage that acceptance (host) and the null-page
+        # write diversion below keep out of every live value
+        pos_c = jnp.minimum(positions, cfg.seq_len - 1)
+        ids_c = jnp.clip(in_ids, 0, cfg.vocab - 1)
+
+        x = L.embedding(params["wte"], ids_c,
+                        dtype=engine.compute_dtype)
+        if "wpe" in params:
+            x = x + L.embedding(params["wpe"], pos_c,
+                                dtype=engine.compute_dtype)
+
+        # write targets per (slot, position): the page holding
+        # ``lengths + j`` — private by construction (the cursor sits
+        # past every shared prefix page); beyond the table (horizon)
+        # or on a dead slot, the reserved null page absorbs the write
+        pidx = positions // ps
+        w_page = jnp.where(
+            (pidx < mp) & active[:, None],
+            tables[jnp.arange(n_slots)[:, None],
+                   jnp.clip(pidx, 0, mp - 1)],
+            NULL_PAGE)
+        w_off = positions % ps
+
+        # sweep bookkeeping, one (page, lane, position) partial per
+        # element: exactly decode's (page, lane) routing with the S
+        # verify positions riding the query axis — segment ids key
+        # (slot, position) so the combine lands each position's output
+        # in its own row; empty lanes divert to the trash segment
+        refs_t = refs[1:]                                 # (P, R)
+        n_lanes = refs_t.shape[1]
+        ref_c = jnp.clip(refs_t, 0, n_slots - 1)
+        seg = jnp.where(refs_t[:, :, None] >= 0,
+                        ref_c[:, :, None] * S + jnp.arange(S),
+                        n_slots * S).reshape(-1)
+        tok_pos = page_pos[1:, None] * ps + jnp.arange(ps)[None, :]
+        ref_len = jnp.where(refs_t >= 0, lengths[ref_c], -1)
+        # position j's query sees absolute positions <= lengths + j:
+        # j = 0 is exactly the decode step's mask (the pending token
+        # sees itself), each later draft position one more — the
+        # intra-draft causal structure falls out of the same rule
+        visible = (tok_pos[:, None, None, :]
+                   <= ref_len[:, :, None, None] + jnp.arange(S)[None, None, :, None]
+                   ).reshape(-1, n_lanes * S, ps)
+
+        def layer(x, inputs):
+            bp, pk, pv = inputs
+
+            def attend(q, k_new, v_new):
+                # q/k_new/v_new (n_slots, S, heads, Dh): write ALL
+                # S positions' K/V first, sweep after — every read
+                # (prior context AND intra-draft) comes back in pool
+                # dtype, byte-identical to what S sequential
+                # non-speculative steps would have read
+                if engine.quantized:
+                    (pkv, pks), (pvv, pvs) = pk, pv
+                    kq, k_s = _quantize_kv(k_new)
+                    vq, v_s = _quantize_kv(v_new)
+                    new_k = (pkv.at[w_page, w_off].set(kq),
+                             pks.at[w_page, w_off].set(k_s))
+                    new_v = (pvv.at[w_page, w_off].set(vq),
+                             pvs.at[w_page, w_off].set(v_s))
+                    rk = tuple(a[1:] for a in new_k)
+                    rv = tuple(a[1:] for a in new_v)
+                else:
+                    new_k = pk.at[w_page, w_off].set(
+                        k_new.astype(pk.dtype))
+                    new_v = pv.at[w_page, w_off].set(
+                        v_new.astype(pv.dtype))
+                    rk, rv = new_k[1:], new_v[1:]
+                # ONE pool read serves all S positions of every lane:
+                # queries gather to (P, R·S, H, Dh) — the small side —
+                # while the pool stream stays exactly the decode
+                # step's bytes (minus the statically-sliced null page)
+                q_lanes = q[ref_c].reshape(
+                    ref_c.shape[0], n_lanes * S, cfg.n_heads, head_dim)
+                o_p, m_p, l_p = _grouped_cache_attention(
+                    q_lanes, rk, rv,
+                    visible[:, None, None, :, :], state=True)
+                n_pp = o_p.shape[0]
+                o_f = o_p.reshape(n_pp * n_lanes * S, *o_p.shape[2:])
+                m_f = jnp.moveaxis(m_p, -1, 1).reshape(
+                    n_pp * n_lanes * S, *m_p.shape[1:3])
+                l_f = jnp.moveaxis(l_p, -1, 1).reshape(
+                    n_pp * n_lanes * S, *l_p.shape[1:3])
+                m_s = jax.ops.segment_max(
+                    m_f, seg, num_segments=n_slots * S + 1)
+                w = jnp.exp(m_f - m_s[seg])
+                l_s = jax.ops.segment_sum(
+                    l_f * w, seg, num_segments=n_slots * S + 1)
+                o_s = jax.ops.segment_sum(
+                    o_f * w[..., None], seg,
+                    num_segments=n_slots * S + 1)
+                o = o_s[:n_slots * S] / jnp.maximum(
+                    l_s[:n_slots * S], 1e-30)[..., None]
+                o = o.reshape(n_slots, S, cfg.n_heads, head_dim)
+                return o.astype(q.dtype), (new_k, new_v)
+
+            x, _, (pk, pv) = _block_core(
+                bp, x, cfg, attend,
+                capacity_factor=max(cfg.capacity_factor,
+                                    float(cfg.n_experts)),
+                positions=pos_c)                # per-slot rope depths
+            return x, (pk, pv)
+
+        x, (pool_k, pool_v) = jax.lax.scan(
+            layer, x, (params["blocks"], pool_k, pool_v))
+        logits = _lm_head(params, x)            # (n_slots, S, vocab)
+        accept, token = spec_pick(rng, logits, in_ids[:, 1:])
+        return accept, token, pool_k, pool_v
+
+    return verify_fn
+
+
+__all__ = ["NO_DRAFT", "PromptLookupDrafter", "accept_count",
+           "make_verify_fn"]
