@@ -1,0 +1,3 @@
+"""Repo tooling namespace — makes ``python -m scripts.graftlint`` work
+from the repo root. Nothing here ships in the wheel (pyproject's
+packages.find includes ``torchbooster_tpu*`` only)."""

@@ -1,0 +1,59 @@
+"""graftlint — a multi-rule static analyzer for JAX/TPU
+performance-correctness hazards in torchbooster_tpu/.
+
+The stack's hardest invariants are invisible to tests: the
+zero-recompile contract, async dispatch with no step-cadence host
+syncs, exact donation discipline on the page pool and TrainState, and
+single-use PRNG keys. Break one and nothing fails — a step just costs
+10×, or the statistics quietly degenerate. graftlint pins each hazard
+class with an AST rule, a reasoned suppression file, and a tier-1 gate
+(tests/test_graftlint.py) so new findings fail CI.
+
+Run it::
+
+    python -m scripts.graftlint                 # scan the package
+    python -m scripts.graftlint --json          # machine-readable
+    python -m scripts.graftlint --explain prng-reuse
+    python -m scripts.graftlint --list-rules
+
+Rules: host-sync (ex-obs_lint, same allowlist), recompile-hazard,
+prng-reuse, use-after-donate, traced-branch, config-doc-drift. Full
+catalog + suppression policy: docs/static_analysis.md.
+"""
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+from typing import Sequence
+
+# scripts/ is importable from the repo root; make the package work when
+# loaded by path too (the obs_lint shim, direct script invocation)
+_REPO = Path(__file__).resolve().parents[2]
+if str(_REPO) not in sys.path:  # pragma: no cover - import-order guard
+    sys.path.insert(0, str(_REPO))
+
+from scripts.graftlint.core import (  # noqa: E402
+    Finding, Rule, ScanResult, Suppression, scan)
+
+
+def run_scan(rules: Sequence[Rule] | None = None,
+             paths: Sequence[Path] | None = None,
+             repo: Path | None = None,
+             suppression_path: Path | None = None) -> ScanResult:
+    """Scan with the registered rules (default: all), the graftlint
+    suppression file, AND the host-sync obs allowlist lifted into the
+    same suppression model — the one entry point the CLI, the tier-1
+    gate, and the obs_lint shim all share."""
+    from scripts.graftlint.rules import ALL_RULES
+    from scripts.graftlint.rules.host_sync import allowlist_suppressions
+
+    return scan(
+        rules=list(ALL_RULES) if rules is None else list(rules),
+        paths=paths,
+        repo=_REPO if repo is None else repo,
+        suppression_path=suppression_path,
+        extra_suppressions=allowlist_suppressions())
+
+
+__all__ = ["Finding", "Rule", "ScanResult", "Suppression", "run_scan",
+           "scan"]

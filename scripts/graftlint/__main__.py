@@ -1,0 +1,9 @@
+"""``python -m scripts.graftlint`` entry point."""
+from __future__ import annotations
+
+import sys
+
+from scripts.graftlint.cli import main
+
+if __name__ == "__main__":
+    sys.exit(main())

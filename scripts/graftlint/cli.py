@@ -1,0 +1,107 @@
+"""graftlint CLI: ``python -m scripts.graftlint [options] [paths...]``.
+
+Exit codes: 0 clean, 1 findings, 2 usage error — the same contract
+obs_lint always had, extended with ``--json`` (machine-readable
+findings for CI) and ``--explain RULE`` (the rule's full rationale).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+from scripts.graftlint import run_scan
+from scripts.graftlint.core import iter_python_files
+from scripts.graftlint.rules import ALL_RULES, RULES_BY_ID
+
+
+def _build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="python -m scripts.graftlint",
+        description=("Static analyzer for JAX/TPU performance-"
+                     "correctness hazards in torchbooster_tpu/."))
+    parser.add_argument(
+        "paths", nargs="*", type=Path,
+        help="files or directories to scan (default: torchbooster_tpu/)")
+    parser.add_argument(
+        "--json", action="store_true", dest="as_json",
+        help="emit findings as a JSON document on stdout")
+    parser.add_argument(
+        "--explain", metavar="RULE",
+        help="print a rule's full rationale and exit")
+    parser.add_argument(
+        "--rules", metavar="ID[,ID...]",
+        help="run only these rule ids (default: all)")
+    parser.add_argument(
+        "--list-rules", action="store_true",
+        help="list registered rule ids with one-line summaries")
+    return parser
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = _build_parser().parse_args(argv)
+
+    if args.list_rules:
+        for rule in ALL_RULES:
+            print(f"{rule.id:20s} {rule.summary}")
+        return 0
+
+    if args.explain is not None:
+        rule = RULES_BY_ID.get(args.explain)
+        if rule is None:
+            print(f"graftlint: unknown rule {args.explain!r} "
+                  f"(known: {', '.join(sorted(RULES_BY_ID))})",
+                  file=sys.stderr)
+            return 2
+        print(f"{rule.id} — {rule.summary}\n\n{rule.doc}")
+        return 0
+
+    rules = list(ALL_RULES)
+    if args.rules:
+        wanted = [r.strip() for r in args.rules.split(",") if r.strip()]
+        unknown = [r for r in wanted if r not in RULES_BY_ID]
+        if unknown:
+            print(f"graftlint: unknown rule id(s) {unknown} "
+                  f"(known: {', '.join(sorted(RULES_BY_ID))})",
+                  file=sys.stderr)
+            return 2
+        rules = [RULES_BY_ID[r] for r in wanted]
+
+    # a typo'd or non-python path must not report "clean (0 files)"
+    # and exit 0 — scanning nothing the caller named is a usage error
+    missing = [str(p) for p in args.paths if not p.exists()]
+    if missing:
+        print(f"graftlint: no such path(s): {', '.join(missing)}",
+              file=sys.stderr)
+        return 2
+    if args.paths and not iter_python_files(args.paths):
+        print("graftlint: no python files under: "
+              f"{', '.join(str(p) for p in args.paths)}",
+              file=sys.stderr)
+        return 2
+
+    result = run_scan(rules=rules, paths=args.paths or None)
+
+    if args.as_json:
+        print(json.dumps(result.as_json(), indent=2))
+        return 0 if result.clean else 1
+
+    for finding in result.findings:
+        print(finding.render())
+    if result.findings:
+        print(f"\ngraftlint: {len(result.findings)} finding(s) across "
+              f"{result.n_files} file(s). Fix them, or suppress WITH a "
+              "reason in scripts/graftlint_suppressions.txt "
+              "(host-sync: scripts/obs_allowlist.txt). "
+              "`--explain <rule>` has the rationale.")
+        return 1
+    print(f"graftlint: clean ({result.n_files} files, "
+          f"{len(rules)} rules, "
+          f"{sum(s.used for s in result.suppressions)} reasoned "
+          "suppressions)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,319 @@
+"""graftlint core: shared scanner, findings, reasoned suppressions.
+
+The framework half of the analyzer (the rules live in
+``scripts/graftlint/rules/``). Design constraints, in order:
+
+- **AST-based, zero runtime imports of the package under scan.** Every
+  rule reads source through one shared parse per file — comments and
+  docstrings can never trip a rule, and scanning never imports jax (the
+  tier-1 gate runs the scan in-process on every pytest run).
+- **Structured findings.** A finding is ``(rule id, path, line,
+  message, source line)`` — renderable as text or ``--json``, stable
+  enough for CI to diff.
+- **Reasoned suppressions.** A deliberate hazard is suppressed in
+  ``scripts/graftlint_suppressions.txt`` with a WRITTEN reason (the
+  comment block above the entry). An entry with no reason is itself a
+  finding (``suppression-format``); an entry that no longer suppresses
+  anything is a finding too (``stale-suppression``) — the suppression
+  file can only shrink honestly, never rot into a blanket waiver.
+  The host-sync rule keeps its historical file
+  (``scripts/obs_allowlist.txt``, same ``path:substring`` semantics)
+  so the obs_lint contract survives re-homing.
+
+Exit codes (CLI): 0 clean, 1 findings, 2 usage error.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import re
+from pathlib import Path
+from typing import Iterable, Sequence
+
+REPO = Path(__file__).resolve().parents[2]
+PACKAGE = REPO / "torchbooster_tpu"
+SUPPRESSIONS = REPO / "scripts" / "graftlint_suppressions.txt"
+
+# Meta rule ids raised by the framework itself (never suppressible —
+# they are findings ABOUT the suppression machinery).
+STALE_SUPPRESSION = "stale-suppression"
+SUPPRESSION_FORMAT = "suppression-format"
+SYNTAX_ERROR = "syntax-error"
+
+_JIT_NAMES = {"jit", "pjit"}
+
+
+def is_jit_ref(node: ast.AST) -> bool:
+    """``jit``/``pjit`` bare or under a ``jax.`` base — THE shared
+    definition of "a reference to jax's jit" for every rule that needs
+    one (a per-rule copy would accept e.g. ``nb.jit`` in one rule and
+    not another, and fork silently on the next tweak)."""
+    if isinstance(node, ast.Name):
+        return node.id in _JIT_NAMES
+    if isinstance(node, ast.Attribute) and node.attr in _JIT_NAMES:
+        return isinstance(node.value, ast.Name) and node.value.id == "jax"
+    return False
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One structured finding: where, which rule, why, and the line."""
+
+    rule: str
+    path: str          # repo-relative posix path
+    line: int
+    message: str
+    source: str        # stripped source line (or '' for file-level)
+
+    def render(self) -> str:
+        out = f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+        if self.source:
+            out += f"\n    {self.source}"
+        return out
+
+    def as_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass
+class Suppression:
+    """One reasoned suppression-file entry.
+
+    Matches a finding when rule id and path are equal and ``pattern``
+    is a substring of the flagged source line — the same semantics
+    obs_lint's allowlist always had, now carrying the rule id and a
+    required reason.
+    """
+
+    rule: str
+    path: str
+    pattern: str
+    reason: str
+    file: str          # which suppression file, repo-relative
+    lineno: int        # entry's line in that file
+    used: int = 0
+
+    def matches(self, finding: Finding) -> bool:
+        return (finding.rule == self.rule
+                and finding.path == self.path
+                and self.pattern in finding.source)
+
+
+class FileContext:
+    """One parsed python file shared by every per-file rule: source,
+    split lines, AST, and a child→parent map (ast has no parent links;
+    rules need ancestry for loop/function-scope questions)."""
+
+    def __init__(self, rel: str, source: str, tree: ast.AST):
+        self.rel = rel
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = tree
+        self.parents: dict[ast.AST, ast.AST] = {}
+        for node in ast.walk(tree):
+            for child in ast.iter_child_nodes(node):
+                self.parents[child] = node
+
+    def src(self, node: ast.AST) -> str:
+        lineno = getattr(node, "lineno", 0)
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1].strip()
+        return ""
+
+    def ancestors(self, node: ast.AST) -> Iterable[ast.AST]:
+        cur = self.parents.get(node)
+        while cur is not None:
+            yield cur
+            cur = self.parents.get(cur)
+
+    def finding(self, rule: str, node: ast.AST, message: str) -> Finding:
+        return Finding(rule, self.rel, getattr(node, "lineno", 0),
+                       message, self.src(node))
+
+
+class Rule:
+    """Base rule. Subclasses set ``id``/``summary``/``doc`` and
+    implement ``check_file`` (per python file under scan) and/or
+    ``check_repo`` (once per scan — for cross-file rules like the
+    config/doc drift check)."""
+
+    id: str = ""
+    summary: str = ""
+    doc: str = ""
+
+    def check_file(self, ctx: FileContext) -> list[Finding]:
+        return []
+
+    def check_repo(self, repo: Path) -> list[Finding]:
+        return []
+
+
+# =========================================================================
+# Suppression file parsing
+# =========================================================================
+
+_ENTRY = re.compile(r"^(?P<rule>[a-z][a-z0-9-]*)\s+(?P<path>[^\s:]+):(?P<pattern>.+)$")
+
+
+def load_suppressions(path: Path = SUPPRESSIONS) -> tuple[
+        list[Suppression], list[Finding]]:
+    """Parse the suppression file.
+
+    Format — one entry per line, its reason in the contiguous comment
+    block directly above (shared by consecutive entries, reset by a
+    blank line)::
+
+        # one-shot init; jit exists only to apply out_shardings
+        recompile-hazard torchbooster_tpu/comms/zero.py:jax.jit(tx.init
+
+    Returns ``(entries, format_findings)`` — a reasonless or
+    unparseable entry becomes a ``suppression-format`` finding rather
+    than being silently honored.
+    """
+    entries: list[Suppression] = []
+    problems: list[Finding] = []
+    if not path.exists():
+        return entries, problems
+    try:
+        rel = path.relative_to(REPO).as_posix()
+    except ValueError:
+        rel = path.as_posix()
+    reason_lines: list[str] = []
+    for lineno, raw in enumerate(path.read_text().splitlines(), 1):
+        line = raw.strip()
+        if not line:
+            reason_lines = []
+            continue
+        if line.startswith("#"):
+            reason_lines.append(line.lstrip("#").strip())
+            continue
+        match = _ENTRY.match(line)
+        if not match:
+            problems.append(Finding(
+                SUPPRESSION_FORMAT, rel, lineno,
+                "unparseable suppression (want: '<rule-id> "
+                "<path>:<substring>' with a reason comment above)",
+                line))
+            continue
+        reason = " ".join(part for part in reason_lines if part)
+        if not reason:
+            problems.append(Finding(
+                SUPPRESSION_FORMAT, rel, lineno,
+                f"suppression for rule {match.group('rule')!r} has no "
+                "reason — add a comment line above saying WHY this "
+                "hazard is deliberate", line))
+            continue
+        entries.append(Suppression(
+            rule=match.group("rule"), path=match.group("path"),
+            pattern=match.group("pattern").strip(), reason=reason,
+            file=rel, lineno=lineno))
+    return entries, problems
+
+
+# =========================================================================
+# Scan driver
+# =========================================================================
+
+@dataclasses.dataclass
+class ScanResult:
+    findings: list[Finding]        # unsuppressed + meta findings
+    raw: list[Finding]             # every rule finding pre-suppression
+    suppressions: list[Suppression]
+    n_files: int
+
+    @property
+    def clean(self) -> bool:
+        return not self.findings
+
+    def as_json(self) -> dict:
+        return {
+            "version": 1,
+            "clean": self.clean,
+            "n_files": self.n_files,
+            "n_suppressed": sum(s.used for s in self.suppressions),
+            "findings": [f.as_json() for f in self.findings],
+        }
+
+
+def iter_python_files(paths: Sequence[Path]) -> list[Path]:
+    files: list[Path] = []
+    for path in paths:
+        if path.is_dir():
+            files.extend(sorted(path.rglob("*.py")))
+        elif path.suffix == ".py":
+            files.append(path)
+    return files
+
+
+def scan(rules: Sequence[Rule],
+         paths: Sequence[Path] | None = None,
+         repo: Path = REPO,
+         suppression_path: Path | None = None,
+         extra_suppressions: Sequence[Suppression] = (),
+         check_stale: bool | None = None,
+         check_repo: bool | None = None) -> ScanResult:
+    """Run ``rules`` over ``paths`` (default: the package), apply
+    suppressions, and report stale/reasonless suppression entries as
+    findings of their own.
+
+    Stale detection (``check_stale``) and repo-wide rules
+    (``check_repo`` — cross-file checks like config/doc drift) both
+    default to on only for the full default scan — a partial scan (one
+    file on the command line, a fixture dir in a test) legitimately
+    leaves entries unused, and must not surface findings in files the
+    caller never asked about.
+    """
+    if check_stale is None:
+        check_stale = paths is None
+    if check_repo is None:
+        check_repo = paths is None
+    if paths is None:
+        paths = [repo / "torchbooster_tpu"]
+    entries, meta = load_suppressions(
+        SUPPRESSIONS if suppression_path is None else suppression_path)
+    entries = [*entries, *extra_suppressions]
+
+    raw: list[Finding] = []
+    files = iter_python_files(paths)
+    for path in files:
+        try:
+            rel = path.relative_to(repo).as_posix()
+        except ValueError:
+            rel = path.as_posix()
+        source = path.read_text()
+        try:
+            tree = ast.parse(source)
+        except SyntaxError as exc:
+            raw.append(Finding(SYNTAX_ERROR, rel, exc.lineno or 0,
+                               str(exc), ""))
+            continue
+        ctx = FileContext(rel, source, tree)
+        for rule in rules:
+            raw.extend(rule.check_file(ctx))
+    if check_repo:
+        for rule in rules:
+            raw.extend(rule.check_repo(repo))
+
+    kept: list[Finding] = []
+    for finding in raw:
+        hit = next((s for s in entries if s.matches(finding)), None)
+        if hit is None:
+            kept.append(finding)
+        else:
+            hit.used += 1
+
+    active = {rule.id for rule in rules}
+    for entry in entries:
+        if check_stale and entry.rule in active and not entry.used:
+            kept.append(Finding(
+                STALE_SUPPRESSION, entry.file, entry.lineno,
+                f"suppression for rule {entry.rule!r} no longer matches "
+                f"any finding in {entry.path} — the code moved on; "
+                "delete the entry",
+                f"{entry.rule} {entry.path}:{entry.pattern}"))
+
+    kept.extend(meta)
+    kept.sort(key=lambda f: (f.path, f.line, f.rule))
+    return ScanResult(findings=kept, raw=raw, suppressions=entries,
+                      n_files=len(files))

@@ -1,0 +1,30 @@
+"""graftlint rule registry.
+
+Adding a rule: implement :class:`scripts.graftlint.core.Rule` in a
+module here, instantiate it in :data:`ALL_RULES`, document it in
+docs/static_analysis.md, and give it fixture tests (a deliberate
+positive, a near-miss negative, a suppression round-trip) in
+tests/test_graftlint.py — the meta-test there pins that every
+registered rule has all three.
+"""
+from __future__ import annotations
+
+from scripts.graftlint.rules.config_doc_drift import ConfigDocDriftRule
+from scripts.graftlint.rules.host_sync import HostSyncRule
+from scripts.graftlint.rules.prng_reuse import PrngReuseRule
+from scripts.graftlint.rules.recompile_hazard import RecompileHazardRule
+from scripts.graftlint.rules.traced_branch import TracedBranchRule
+from scripts.graftlint.rules.use_after_donate import UseAfterDonateRule
+
+ALL_RULES = (
+    HostSyncRule(),
+    RecompileHazardRule(),
+    PrngReuseRule(),
+    UseAfterDonateRule(),
+    TracedBranchRule(),
+    ConfigDocDriftRule(),
+)
+
+RULES_BY_ID = {rule.id: rule for rule in ALL_RULES}
+
+__all__ = ["ALL_RULES", "RULES_BY_ID"]

@@ -1,0 +1,267 @@
+"""config-doc-drift rule: config dataclasses vs docs/config.md.
+
+The YAML config system is the framework's front door, and its doc page
+is the contract users actually read. PRs 2–5 each added config fields
+by hand (`serving:` grew 8 keys, `comms:` and `observability:`
+appeared wholesale) and nothing checked that docs/config.md kept up —
+a field missing from the doc is a feature nobody can discover, and a
+doc key the dataclass dropped is a YAML line that silently warns
+"extra config parameter (ignored)" at load time.
+
+Both directions are checked statically (AST + the doc's yaml fences;
+nothing is imported):
+
+- **forward**: every field of every ``@dataclass ... class *Config``
+  in ``torchbooster_tpu/config.py`` must appear in docs/config.md as
+  code — backtick-quoted (a field-table row, inline code) or as a
+  ``name:`` key inside a yaml fence. A bare prose mention doesn't
+  count: common field names (``warmup``, ``eps``, ``name``) ride on
+  unrelated sentences and would void the guarantee;
+- **reverse**: inside every ``\\`\\`\\`yaml`` fence of docs/config.md,
+  the sub-keys of a documented block (``serving:``, ``comms:``,
+  ``observability:``, ``env:``, ``loader:``, ``optim:``,
+  ``scheduler:``, ``dataset:``) must each be a real field of the
+  corresponding config class; and every row of a markdown field table
+  introduced by the ``\\`block:\\` (\\`Class\\`):`` convention must
+  name a real field — a stale row is the same drift as a dead fence
+  key. Fences that aren't parseable YAML on their own (e.g. the
+  ``#include`` example) are skipped.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+
+from scripts.graftlint.core import Finding, Rule
+
+RULE_ID = "config-doc-drift"
+
+CONFIG_REL = "torchbooster_tpu/config.py"
+DOC_REL = "docs/config.md"
+
+# documented YAML block name -> config class
+BLOCKS = {
+    "env": "EnvConfig",
+    "loader": "LoaderConfig",
+    "optim": "OptimizerConfig",
+    "scheduler": "SchedulerConfig",
+    "dataset": "DatasetConfig",
+    "serving": "ServingConfig",
+    "comms": "CommsConfig",
+    "observability": "ObservabilityConfig",
+}
+
+_FENCE = re.compile(r"^```yaml\s*$")
+_FENCE_END = re.compile(r"^```\s*$")
+
+
+def config_fields(config_path: Path) -> dict[str, dict[str, int]]:
+    """``{class name: {field name: lineno}}`` for every dataclass
+    ``*Config`` in the config module (annotation-style fields only —
+    exactly what the YAML loader sees through dataclasses.fields)."""
+    tree = ast.parse(config_path.read_text())
+    out: dict[str, dict[str, int]] = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ClassDef) \
+                or not node.name.endswith("Config"):
+            continue
+        is_dataclass = any(
+            (isinstance(d, ast.Name) and d.id == "dataclass")
+            or (isinstance(d, ast.Attribute) and d.attr == "dataclass")
+            or (isinstance(d, ast.Call)
+                and isinstance(d.func, (ast.Name, ast.Attribute))
+                and (getattr(d.func, "id", None) == "dataclass"
+                     or getattr(d.func, "attr", None) == "dataclass"))
+            for d in node.decorator_list)
+        if not is_dataclass:
+            continue
+        fields: dict[str, int] = {}
+        for stmt in node.body:
+            if isinstance(stmt, ast.AnnAssign) \
+                    and isinstance(stmt.target, ast.Name):
+                fields[stmt.target.id] = stmt.lineno
+        out[node.name] = fields
+    return out
+
+
+def yaml_fences(doc_text: str) -> list[tuple[int, list[str]]]:
+    """``(first content lineno, lines)`` of each ```yaml fence."""
+    fences: list[tuple[int, list[str]]] = []
+    lines = doc_text.splitlines()
+    i = 0
+    while i < len(lines):
+        if _FENCE.match(lines[i]):
+            start = i + 1
+            body: list[str] = []
+            i += 1
+            while i < len(lines) and not _FENCE_END.match(lines[i]):
+                body.append(lines[i])
+                i += 1
+            fences.append((start + 1, body))  # 1-based doc lineno
+        i += 1
+    return fences
+
+
+_SEGMENT_START = re.compile(
+    r"^#{1,6}\s|^`[a-z_]+:`\s*\(`\w*Config`\)")
+
+# the field-table intro convention and a table row's first cell
+_TABLE_INTRO = re.compile(
+    r"^`(?P<block>[a-z_]+):`\s*\(`(?P<cls>\w*Config)`\):?\s*$")
+_TABLE_ROW = re.compile(r"^\|\s*`(?P<field>\w+)`\s*\|")
+
+
+def _doc_segments(doc_text: str) -> list[str]:
+    """Split the doc at markdown headings AND at the field-table intro
+    convention (a line like ``\\`env:\\` (\\`EnvConfig\\`):``) so each
+    class's table lands in its own segment — the per-class attribution
+    unit for the forward check."""
+    segments: list[list[str]] = [[]]
+    for line in doc_text.splitlines():
+        if _SEGMENT_START.match(line):
+            segments.append([])
+        segments[-1].append(line)
+    return ["\n".join(seg) for seg in segments if seg]
+
+
+class ConfigDocDriftRule(Rule):
+    id = RULE_ID
+    summary = ("*Config dataclass fields and docs/config.md YAML keys "
+               "must agree both ways")
+    doc = """\
+Why: the YAML front door is only as usable as its doc page. An
+undocumented field is invisible to users; a documented key the
+dataclass no longer has turns into a silent "extra config parameter
+(ignored)" warning at load time — both are drift, and PRs 2-5 proved
+it accumulates whenever keys are added by hand.
+
+Flags:
+- forward: a field of a `@dataclass` `*Config` in
+  torchbooster_tpu/config.py that never appears in docs/config.md as
+  code (backticked, or a yaml-fence key — prose mentions don't count)
+  — finding anchored at the field's definition line;
+- reverse: a sub-key of a documented block (`serving:`, `comms:`,
+  `observability:`, `env:`, `loader:`, `optim:`, `scheduler:`,
+  `dataset:`) inside a yaml fence of docs/config.md that is not a
+  field of the corresponding config class, and any field-table row
+  (the `block:` (`Class`): convention) naming a dropped field —
+  finding anchored at the doc line. Unparseable fences (the
+  `#include` example) are skipped.
+
+The fix is almost always the doc: docs/config.md carries a per-config
+field table precisely so this rule stays green.
+"""
+
+    # test seam: repo-relative paths the rule reads
+    config_rel = CONFIG_REL
+    doc_rel = DOC_REL
+
+    def check_repo(self, repo: Path) -> list[Finding]:
+        config_path = repo / self.config_rel
+        doc_path = repo / self.doc_rel
+        if not config_path.exists() or not doc_path.exists():
+            return []
+        import yaml
+
+        findings: list[Finding] = []
+        fields_by_class = config_fields(config_path)
+        config_lines = config_path.read_text().splitlines()
+        doc_text = doc_path.read_text()
+
+        # "documented" means the field appears as code — a backticked
+        # `name` / `name:` or a yaml-fence key — inside doc content
+        # attributable to ITS class. Neither bare prose nor another
+        # class's section counts: common names (`warmup`, `eps`,
+        # `enabled`) would otherwise ride on unrelated text and void
+        # the forward guarantee.
+        block_by_class = {cls: blk for blk, cls in BLOCKS.items()}
+        fence_keys: dict[str, set[str]] = {}
+        for _, body in yaml_fences(doc_text):
+            try:
+                data = yaml.safe_load("\n".join(body))
+            except yaml.YAMLError:
+                continue
+            if isinstance(data, dict):
+                for blk, val in data.items():
+                    if isinstance(val, dict):
+                        fence_keys.setdefault(blk, set()).update(
+                            str(k) for k in val)
+        segments = _doc_segments(doc_text)
+
+        def documented(cls: str, field: str) -> bool:
+            blk = block_by_class.get(cls)
+            if blk is not None and field in fence_keys.get(blk, ()):
+                return True
+            segs = [s for s in segments
+                    if f"`{cls}`" in s
+                    or (blk is not None and f"`{blk}:`" in s)]
+            if not segs and blk is None:
+                segs = [doc_text]  # unattributable class: global match
+            pattern = rf"`{re.escape(field)}:?`"
+            return any(re.search(pattern, s) for s in segs)
+
+        for cls, fields in fields_by_class.items():
+            for field, lineno in fields.items():
+                if not documented(cls, field):
+                    source = config_lines[lineno - 1].strip() \
+                        if lineno - 1 < len(config_lines) else ""
+                    findings.append(Finding(
+                        self.id, self.config_rel, lineno,
+                        f"{cls}.{field} is not documented in "
+                        f"{self.doc_rel} — add it to the field table",
+                        source))
+
+        doc_lines = doc_text.splitlines()
+        for start, body in yaml_fences(doc_text):
+            try:
+                data = yaml.safe_load("\n".join(body))
+            except yaml.YAMLError:
+                continue
+            if not isinstance(data, dict):
+                continue
+            for block, value in data.items():
+                cls = BLOCKS.get(block)
+                if cls is None or not isinstance(value, dict) \
+                        or cls not in fields_by_class:
+                    continue
+                for key in value:
+                    if key in fields_by_class[cls]:
+                        continue
+                    lineno = start
+                    for off, line in enumerate(body):
+                        if re.match(rf"\s*{re.escape(str(key))}\s*:",
+                                    line):
+                            lineno = start + off
+                            break
+                    source = doc_lines[lineno - 1].strip() \
+                        if lineno - 1 < len(doc_lines) else ""
+                    findings.append(Finding(
+                        self.id, self.doc_rel, lineno,
+                        f"{self.doc_rel} documents `{block}.{key}` but "
+                        f"{cls} has no such field — the loader would "
+                        "warn and ignore it", source))
+
+        # reverse, field-table form: a markdown table introduced by the
+        # `` `block:` (`Class`): `` convention documents fields too — a
+        # row whose field the dataclass dropped is the same stale-doc
+        # drift as a dead fence key
+        for idx, line in enumerate(doc_lines):
+            intro = _TABLE_INTRO.match(line)
+            if intro is None or intro.group("cls") not in fields_by_class:
+                continue
+            cls = intro.group("cls")
+            for off, row in enumerate(doc_lines[idx + 1:], idx + 2):
+                if _TABLE_INTRO.match(row) or row.startswith("#"):
+                    break  # next table / next section
+                cell = _TABLE_ROW.match(row)
+                if cell is None:
+                    continue
+                field = cell.group("field")
+                if field not in fields_by_class[cls]:
+                    findings.append(Finding(
+                        self.id, self.doc_rel, off,
+                        f"{self.doc_rel}'s {cls} field table documents "
+                        f"`{field}` but the dataclass has no such field "
+                        "— stale row; delete it", row.strip()))
+        return findings

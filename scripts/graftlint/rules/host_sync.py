@@ -1,0 +1,185 @@
+"""host-sync rule: per-step device→host sync smells (ex-obs_lint).
+
+The repo's core perf discipline (SURVEY §3.3, metrics.py docstring) is
+that nothing on a step-cadence code path forces a device→host sync:
+``.item()``, ``float()`` of a just-computed device value, and
+wall-clock reads between jitted calls all serialize the dispatch
+pipeline, and one careless line erases the async-dispatch win the
+whole stack is built around. Tests can't see this class of regression
+(the numbers stay correct, only the overlap dies), so it's linted.
+
+This module IS the old ``scripts/obs_lint.py`` (PR 2), re-homed as a
+graftlint rule with its semantics intact: same three smells, same
+``scripts/obs_allowlist.txt`` ``path:substring`` allowlist, same
+HOT_PATHS prefix set. ``scripts/obs_lint.py`` remains as a thin shim
+re-exporting this module's legacy surface (``scan``, ``_Finder``,
+``HOT_PATHS``, ``allowed``, ``load_allowlist``) so its tier-1 test and
+every doc reference keep working.
+"""
+from __future__ import annotations
+
+import ast
+
+from scripts.graftlint.core import (
+    PACKAGE, REPO, FileContext, Finding, Rule, Suppression)
+
+ALLOWLIST = REPO / "scripts" / "obs_allowlist.txt"
+
+RULE_ID = "host-sync"
+
+# step-cadence code paths where float(<call>) is treated as a sync
+HOT_PATHS = (
+    "torchbooster_tpu/utils.py",
+    "torchbooster_tpu/metrics.py",
+    "torchbooster_tpu/scheduler.py",
+    # the whole serving package is step-cadence: engine decode/prefill,
+    # the batcher loop, AND speculative.py (host-side drafting runs
+    # between every verify dispatch — a stray sync there stalls the
+    # multi-token pipeline exactly like one in the decode loop;
+    # tests/test_obs_lint.py pins the coverage)
+    "torchbooster_tpu/serving/",
+    "torchbooster_tpu/observability/",
+    "torchbooster_tpu/data/pipeline.py",
+    # the gradient-sync hook runs INSIDE the compiled step and its
+    # byte counters on the step cadence — one stray host sync there
+    # serializes every dispatch
+    "torchbooster_tpu/comms/",
+)
+
+
+def _iter_allowlist() -> list[tuple[int, str, str]]:
+    """One parser for the allowlist file: ``(lineno, path, pattern)``
+    per entry. Both the legacy 2-tuple surface and the graftlint
+    suppression lift derive from this — a format tweak applied to one
+    cannot silently fork the other."""
+    entries: list[tuple[int, str, str]] = []
+    if not ALLOWLIST.exists():
+        return entries
+    for lineno, raw in enumerate(ALLOWLIST.read_text().splitlines(), 1):
+        line = raw.strip()
+        if not line or line.startswith("#"):
+            continue
+        path, _, pattern = line.partition(":")
+        entries.append((lineno, path.strip(), pattern.strip()))
+    return entries
+
+
+def load_allowlist() -> list[tuple[str, str]]:
+    """The historical ``path:substring`` allowlist, verbatim."""
+    return [(path, pattern) for _, path, pattern in _iter_allowlist()]
+
+
+def allowed(rel: str, source_line: str,
+            entries: list[tuple[str, str]]) -> bool:
+    return any(rel == path and pattern in source_line
+               for path, pattern in entries)
+
+
+def allowlist_suppressions() -> list[Suppression]:
+    """The obs allowlist lifted into graftlint's suppression model so
+    the unified scan applies (and stale-checks) it like any other
+    suppression source. Reasons live in the file's comment blocks; the
+    legacy format doesn't attach them per entry, so the lifted reason
+    just names the file."""
+    rel = ALLOWLIST.relative_to(REPO).as_posix()
+    out: list[Suppression] = []
+    lineno_by_entry: dict[tuple[str, str], int] = {}
+    for lineno, path, pattern in _iter_allowlist():
+        lineno_by_entry.setdefault((path, pattern), lineno)
+    for (path, pattern), lineno in lineno_by_entry.items():
+        out.append(Suppression(
+            rule=RULE_ID, path=path, pattern=pattern,
+            reason=f"reasoned allowlist entry in {rel}",
+            file=rel, lineno=lineno))
+    return out
+
+
+class _Finder(ast.NodeVisitor):
+    """The original obs_lint visitor, signature-stable: findings are
+    ``(rel, lineno, smell, source line)`` 4-tuples."""
+
+    def __init__(self, rel: str, lines: list[str], hot: bool):
+        self.rel = rel
+        self.lines = lines
+        self.hot = hot
+        self.findings: list[tuple[str, int, str, str]] = []
+
+    def _flag(self, node: ast.AST, smell: str) -> None:
+        line = self.lines[node.lineno - 1].strip()
+        self.findings.append((self.rel, node.lineno, smell, line))
+
+    def visit_Call(self, node: ast.Call) -> None:
+        fn = node.func
+        # <expr>.item()
+        if isinstance(fn, ast.Attribute) and fn.attr == "item" \
+                and not node.args and not node.keywords:
+            self._flag(node, ".item() host sync")
+        # time.time()
+        if isinstance(fn, ast.Attribute) and fn.attr == "time" \
+                and isinstance(fn.value, ast.Name) \
+                and fn.value.id == "time":
+            self._flag(node, "time.time() (use perf_counter for "
+                             "durations; allowlist timestamps)")
+        # float(<call>) in hot paths
+        if self.hot and isinstance(fn, ast.Name) and fn.id == "float" \
+                and len(node.args) == 1 \
+                and isinstance(node.args[0], ast.Call):
+            self._flag(node, "float(<call>) likely device sync in a "
+                             "step-cadence path")
+        self.generic_visit(node)
+
+
+def scan() -> list[tuple[str, int, str, str]]:
+    """Legacy obs_lint entry point: scan the package with ONLY this
+    rule and the obs allowlist, returning the historical 4-tuples.
+    (The shim's ``main`` and tests/test_obs_lint.py call this.)"""
+    entries = load_allowlist()
+    findings: list[tuple[str, int, str, str]] = []
+    for path in sorted(PACKAGE.rglob("*.py")):
+        rel = path.relative_to(REPO).as_posix()
+        hot = any(rel.startswith(h) for h in HOT_PATHS)
+        source = path.read_text()
+        try:
+            tree = ast.parse(source)
+        except SyntaxError as exc:
+            findings.append((rel, exc.lineno or 0, "syntax error", str(exc)))
+            continue
+        finder = _Finder(rel, source.splitlines(), hot)
+        finder.visit(tree)
+        findings.extend(
+            f for f in finder.findings if not allowed(f[0], f[3], entries))
+    return findings
+
+
+class HostSyncRule(Rule):
+    id = RULE_ID
+    summary = (".item() / time.time() / float(<call>) host syncs on "
+               "step-cadence paths")
+    doc = """\
+Why: the whole stack's throughput story is async dispatch — the host
+runs ahead of the device, queueing compiled steps. `.item()`,
+`float(<device call>)`, and wall-clock reads between dispatches each
+block the host on the device queue, collapsing the overlap. The
+numbers stay correct, so no functional test can see it; only a lint
+can.
+
+Flags (AST-based — comments/docstrings never trip it):
+- `<expr>.item()` anywhere in the package;
+- `time.time()` anywhere (durations must use `perf_counter`;
+  wall-clock event TIMESTAMPS are legitimate and suppressed per line);
+- `float(<call>)` in HOT paths only (train/serve/step code) where the
+  argument is itself a call — the `float(loss_fn(...))` shape that
+  materializes a device value.
+
+Suppress in scripts/obs_allowlist.txt (`path:substring` per line, '#'
+comment above = the reason) — the file obs_lint always used; a
+deliberate sync (a drain point, post-run aggregation) is suppressed
+WITH a reason, so every exception stays documented.
+"""
+
+    def check_file(self, ctx: FileContext) -> list[Finding]:
+        hot = any(ctx.rel.startswith(h) for h in HOT_PATHS)
+        finder = _Finder(ctx.rel, ctx.lines, hot)
+        finder.visit(ctx.tree)
+        return [Finding(self.id, rel, lineno, smell, line)
+                for rel, lineno, smell, line in finder.findings]

@@ -1,0 +1,347 @@
+"""prng-reuse rule: the same PRNG key fed to two consumers.
+
+JAX's PRNG discipline is explicit: a key is single-use. Feeding the
+same key variable to two ``jax.random.*`` consumers produces
+*identical* (not independent) randomness — dropout masks equal to
+noise draws, correlated initializations, silently degenerate sampling.
+Nothing crashes and the statistics are subtly wrong, which is why this
+is a lint and not a test.
+
+Analysis (flow-insensitive across functions, lightly flow-sensitive
+inside one): each function body (and the module body) is walked in
+source order tracking, per key NAME, whether it has been consumed
+since its last (re)assignment. ``if``/``else`` branches — statement
+level, ternary ``IfExp``, and short-circuited ``and``/``or`` operands
+alike — are analyzed independently from the pre-branch state (two
+exclusive consumers of one key are fine) and merged conservatively. A consumer inside a
+loop whose key is never reassigned in the loop body is flagged too —
+the same key every iteration. ``fold_in`` is exempt (deriving
+``fold_in(key, i)`` per step IS the sanctioned counter pattern);
+``split`` counts as a consumer (``sub = split(key)[...]`` in a loop
+without reassigning ``key`` yields the same subkeys every pass).
+
+Only plain names are tracked — ``split(self._rng)`` / ``split(ks[2])``
+are invisible (the engine's ``self._rng, sub = split(self._rng)``
+idiom is self-correcting anyway). Lambda parameters and comprehension
+targets are their own scopes (``[normal(k) for k in keys]`` never
+aliases an outer ``k``). Keys smuggled through containers or closures
+are out of scope; the rule aims at the reuse shape humans actually
+write.
+"""
+from __future__ import annotations
+
+import ast
+
+from scripts.graftlint.core import FileContext, Finding, Rule
+
+RULE_ID = "prng-reuse"
+
+# jax.random attrs that do NOT consume a key argument
+_NON_CONSUMING = {"PRNGKey", "key", "key_data", "wrap_key_data",
+                  "key_impl", "clone", "fold_in"}
+
+
+def _random_aliases(tree: ast.AST) -> set[str]:
+    """Local names bound to the jax.random module (``from jax import
+    random``, ``import jax.random as jr``)."""
+    aliases: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module == "jax":
+            for alias in node.names:
+                if alias.name == "random":
+                    aliases.add(alias.asname or alias.name)
+        elif isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name == "jax.random" and alias.asname:
+                    aliases.add(alias.asname)
+    return aliases
+
+
+def _consumed_key_name(node: ast.Call, aliases: set[str]) -> str | None:
+    """If ``node`` is a jax.random consumer whose key argument is a
+    plain name, return that name."""
+    fn = node.func
+    if not isinstance(fn, ast.Attribute):
+        return None
+    base = fn.value
+    is_random = (
+        (isinstance(base, ast.Name) and base.id in aliases)
+        or (isinstance(base, ast.Attribute) and base.attr == "random"
+            and isinstance(base.value, ast.Name) and base.value.id == "jax"))
+    if not is_random or fn.attr in _NON_CONSUMING:
+        return None
+    key = node.args[0] if node.args else None
+    if key is None:
+        for kw in node.keywords:
+            if kw.arg in ("key", "rng"):
+                key = kw.value
+                break
+    return key.id if isinstance(key, ast.Name) else None
+
+
+def _terminates(body: list[ast.stmt]) -> bool:
+    """True when control cannot fall out of the bottom of ``body``."""
+    if not body:
+        return False
+    last = body[-1]
+    if isinstance(last, (ast.Return, ast.Raise, ast.Break, ast.Continue)):
+        return True
+    if isinstance(last, ast.If):
+        return bool(_terminates(last.body) and last.orelse
+                    and _terminates(last.orelse))
+    return False
+
+
+def _assigned_names(target: ast.AST) -> set[str]:
+    """Names bound by an assignment target (tuples/lists/stars walked)."""
+    out: set[str] = set()
+    for node in ast.walk(target):
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Store):
+            out.add(node.id)
+    return out
+
+
+class _ScopeWalker:
+    """Linear walk of one scope's statements with per-name consumption
+    state: ``consumed[name] = lineno`` of the consuming call."""
+
+    def __init__(self, ctx: FileContext, rule_id: str):
+        self.ctx = ctx
+        self.rule_id = rule_id
+        self.aliases = _random_aliases(ctx.tree)
+        self.findings: list[Finding] = []
+        # one finding per consumer site: the loop check and the linear
+        # walk can both reach the same call — report whichever fires
+        # first, not both
+        self._flagged: set[tuple[int, int]] = set()
+
+    def _flag(self, node: ast.AST, message: str) -> None:
+        pos = (node.lineno, node.col_offset)
+        if pos in self._flagged:
+            return
+        self._flagged.add(pos)
+        self.findings.append(self.ctx.finding(self.rule_id, node, message))
+
+    # ---- expressions -----------------------------------------------------
+    def eval_expr(self, expr: ast.AST | None,
+                  consumed: dict[str, int]) -> None:
+        """Source-order walk of one expression, skipping nested
+        function/lambda bodies (their parameters rebind per call —
+        ``tree.map(lambda k: normal(k), keys)`` must not alias an
+        outer ``k``)."""
+        if expr is None:
+            return
+        if isinstance(expr, (ast.Lambda, ast.FunctionDef,
+                             ast.AsyncFunctionDef)):
+            return
+        if isinstance(expr, (ast.ListComp, ast.SetComp, ast.DictComp,
+                             ast.GeneratorExp)):
+            # comprehension targets are their OWN scope in python 3 —
+            # `[normal(k) for k in keys]` must not alias an outer `k`
+            # (same reasoning as lambda parameters). Consumption of
+            # genuinely outer names still propagates back.
+            targets: set[str] = set()
+            for gen in expr.generators:
+                targets |= _assigned_names(gen.target)
+            inner = {name: line for name, line in consumed.items()
+                     if name not in targets}
+            for gen in expr.generators:
+                self.eval_expr(gen.iter, inner)
+                for cond in gen.ifs:
+                    self.eval_expr(cond, inner)
+            if isinstance(expr, ast.DictComp):
+                self.eval_expr(expr.key, inner)
+                self.eval_expr(expr.value, inner)
+            else:
+                self.eval_expr(expr.elt, inner)
+            consumed.update({name: line for name, line in inner.items()
+                             if name not in targets})
+            return
+        if isinstance(expr, ast.IfExp):
+            # `a if p else b`: exactly one arm evaluates — analyze each
+            # from the pre-expression state (the expression form of the
+            # statement-level if/else exemption) and merge by union
+            self.eval_expr(expr.test, consumed)
+            body_state = dict(consumed)
+            self.eval_expr(expr.body, body_state)
+            else_state = dict(consumed)
+            self.eval_expr(expr.orelse, else_state)
+            consumed.update(else_state)
+            consumed.update(body_state)
+            return
+        if isinstance(expr, ast.BoolOp):
+            # `a or b` / `a and b`: operands past the first may be
+            # skipped by short-circuit — same conditional treatment
+            self.eval_expr(expr.values[0], consumed)
+            states = []
+            for value in expr.values[1:]:
+                state = dict(consumed)
+                self.eval_expr(value, state)
+                states.append(state)
+            for state in states:
+                consumed.update(state)
+            return
+        if isinstance(expr, ast.Call):
+            name = _consumed_key_name(expr, self.aliases)
+            if name is not None:
+                if name in consumed:
+                    self._flag(
+                        expr,
+                        f"PRNG key {name!r} reused — already consumed "
+                        f"at line {consumed[name]} with no split/"
+                        "fold_in reassignment in between; the two "
+                        "draws are IDENTICAL, not independent")
+                else:
+                    consumed[name] = expr.lineno
+        for child in ast.iter_child_nodes(expr):
+            self.eval_expr(child, consumed)
+
+    # ---- statements ------------------------------------------------------
+    def run_block(self, stmts: list[ast.stmt],
+                  consumed: dict[str, int]) -> None:
+        for stmt in stmts:
+            self.run_stmt(stmt, consumed)
+
+    def run_stmt(self, stmt: ast.stmt, consumed: dict[str, int]) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return  # separate scope
+        if isinstance(stmt, ast.If):
+            self.eval_expr(stmt.test, consumed)
+            body_state = dict(consumed)
+            self.run_block(stmt.body, body_state)
+            else_state = dict(consumed)
+            self.run_block(stmt.orelse, else_state)
+            # merge: consumed on either SURVIVING path stays consumed;
+            # a branch that terminates (return/raise/break/continue)
+            # never reaches the code below, so its consumptions don't
+            # count — `if u: return uniform(rng)` + `return normal(rng)`
+            # is exclusive use, not reuse
+            consumed.clear()
+            if not _terminates(stmt.orelse):
+                consumed.update(else_state)
+            if not _terminates(stmt.body):
+                consumed.update(body_state)
+        elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self.eval_expr(stmt.iter, consumed)
+            for name in _assigned_names(stmt.target):
+                consumed.pop(name, None)
+            self._check_loop(stmt, stmt.body)
+            self.run_block(stmt.body, consumed)
+            self.run_block(stmt.orelse, consumed)
+        elif isinstance(stmt, ast.While):
+            self.eval_expr(stmt.test, consumed)
+            self._check_loop(stmt, stmt.body)
+            self.run_block(stmt.body, consumed)
+            self.run_block(stmt.orelse, consumed)
+        elif isinstance(stmt, ast.Try):
+            self.run_block(stmt.body, consumed)
+            for handler in stmt.handlers:
+                self.run_block(handler.body, dict(consumed))
+            self.run_block(stmt.orelse, consumed)
+            self.run_block(stmt.finalbody, consumed)
+        elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                self.eval_expr(item.context_expr, consumed)
+                if item.optional_vars is not None:
+                    for name in _assigned_names(item.optional_vars):
+                        consumed.pop(name, None)
+            self.run_block(stmt.body, consumed)
+        elif isinstance(stmt, ast.Assign):
+            self.eval_expr(stmt.value, consumed)
+            for target in stmt.targets:
+                for name in _assigned_names(target):
+                    consumed.pop(name, None)
+        elif isinstance(stmt, (ast.AnnAssign, ast.AugAssign)):
+            self.eval_expr(stmt.value, consumed)
+            for name in _assigned_names(stmt.target):
+                consumed.pop(name, None)
+        else:
+            for value in ast.iter_child_nodes(stmt):
+                if isinstance(value, ast.expr):
+                    self.eval_expr(value, consumed)
+
+    # ---- loops: same key every iteration ---------------------------------
+    def _check_loop(self, loop: ast.stmt, body: list[ast.stmt]) -> None:
+        assigned: set[str] = set()
+        if isinstance(loop, (ast.For, ast.AsyncFor)):
+            assigned |= _assigned_names(loop.target)
+        consumers: list[tuple[str, ast.Call]] = []
+
+        def walk(node: ast.AST, in_nested_loop: bool) -> None:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                return  # nested scope: its params rebind per call
+            if isinstance(node, (ast.Assign, ast.AugAssign,
+                                 ast.AnnAssign)):
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for target in targets:
+                    assigned.update(_assigned_names(target))
+            nested = in_nested_loop
+            if isinstance(node, (ast.For, ast.AsyncFor, ast.While)):
+                if isinstance(node, (ast.For, ast.AsyncFor)):
+                    assigned.update(_assigned_names(node.target))
+                nested = True  # inner loop runs its own _check_loop
+            if isinstance(node, (ast.ListComp, ast.SetComp, ast.DictComp,
+                                 ast.GeneratorExp)):
+                # comprehension targets rebind per element (own scope)
+                for gen in node.generators:
+                    assigned.update(_assigned_names(gen.target))
+            if isinstance(node, ast.Call) and not in_nested_loop:
+                name = _consumed_key_name(node, self.aliases)
+                if name is not None:
+                    consumers.append((name, node))
+            for child in ast.iter_child_nodes(node):
+                walk(child, nested)
+
+        if isinstance(loop, ast.While):
+            # the test re-evaluates every iteration — a consumer there
+            # (`while bernoulli(key):`) draws the same randomness each
+            # pass exactly like one in the body
+            walk(loop.test, False)
+        for stmt in body:
+            walk(stmt, False)
+        for name, node in consumers:
+            if name not in assigned:
+                self._flag(
+                    node,
+                    f"PRNG key {name!r} consumed inside a loop without "
+                    "per-iteration reassignment — every iteration draws "
+                    "the SAME randomness; split the key per iteration "
+                    "(or fold_in the loop counter)")
+
+
+class PrngReuseRule(Rule):
+    id = RULE_ID
+    summary = "the same PRNG key variable consumed twice without a split"
+    doc = """\
+Why: jax keys are single-use by contract. `normal(key)` twice returns
+the SAME numbers; `dropout(key)` reusing an init key correlates the
+mask with the weights. Nothing errors — the statistics just go wrong,
+invisibly, which is the worst failure class a training stack has.
+
+Flags, per function body (module body included), walked in source
+order with reassignment tracking:
+- a `jax.random.*` consumer whose key name was already consumed since
+  its last assignment (`if`/`else` branches analyzed independently —
+  exclusive consumers are fine; `fold_in` is exempt as the sanctioned
+  counter derivation; `split` itself counts as a consumer);
+- a consumer inside a `for`/`while` whose key is never reassigned in
+  the loop body — identical randomness every iteration.
+
+Near-misses that stay clean: `k1, k2 = split(key)` then one use each;
+`rng, sub = split(rng)` per loop iteration; branch-exclusive reuse.
+Only plain names are tracked (`self._rng` / `ks[i]` are invisible —
+those idioms carry their own reassignment discipline).
+"""
+
+    def check_file(self, ctx: FileContext) -> list[Finding]:
+        walker = _ScopeWalker(ctx, self.id)
+        scopes: list[list[ast.stmt]] = [ctx.tree.body]
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                scopes.append(node.body)
+        for body in scopes:
+            walker.run_block(body, {})
+        return walker.findings

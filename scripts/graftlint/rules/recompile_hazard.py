@@ -1,0 +1,198 @@
+"""recompile-hazard rule: jit executables constructed on a call cadence.
+
+``jax.jit`` keys its compilation cache on the *function object* it
+wraps (plus static args). Build the wrapper once and every call hits
+the cache; build it per call — inside a loop, inside a function that
+runs per step, or around a fresh ``lambda`` — and every single
+invocation traces and compiles a brand-new executable. That is exactly
+the failure mode the RecompileSentinel (PR 2) catches at runtime and
+the zero-recompile contract (PR 1/4/5) exists to forbid; this rule
+catches the shape statically, before it costs a 10× step time in
+production.
+"""
+from __future__ import annotations
+
+import ast
+
+from scripts.graftlint.core import FileContext, Finding, Rule, is_jit_ref
+
+RULE_ID = "recompile-hazard"
+
+_FUNC_SCOPES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+# comprehensions are loops too: `[jax.jit(f) for f in fns]` builds a
+# fresh executable per element exactly like the statement form
+_LOOPS = (ast.For, ast.AsyncFor, ast.While,
+          ast.ListComp, ast.SetComp, ast.DictComp, ast.GeneratorExp)
+
+
+def _in_decorators(child: ast.AST, fn: ast.AST) -> bool:
+    """Is ``child`` (a direct-ancestry link below ``fn``) part of
+    ``fn``'s decorator list rather than its body?"""
+    return any(child is dec for dec in fn.decorator_list)
+
+
+def _own_body_walk(fn: ast.AST):
+    """Walk a function's own body, not descending into nested defs or
+    lambdas (their bodies run on their own cadence)."""
+    stack = list(fn.body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _non_call_jit_decorator(dec: ast.AST) -> bool:
+    """``@jax.jit`` bare or ``@partial(jax.jit, ...)`` — jit-building
+    decorator shapes with no jit Call node of their own."""
+    if is_jit_ref(dec):
+        return True
+    return (isinstance(dec, ast.Call)
+            and isinstance(dec.func, (ast.Name, ast.Attribute))
+            and (getattr(dec.func, "id", None) == "partial"
+                 or getattr(dec.func, "attr", None) == "partial")
+            and bool(dec.args) and is_jit_ref(dec.args[0]))
+
+
+class RecompileHazardRule(Rule):
+    id = RULE_ID
+    summary = ("jax.jit/pjit constructed inside a loop, invoked inline "
+               "per call, or wrapping a fresh lambda")
+    doc = """\
+Why: jit's cache is keyed on the wrapped function OBJECT. A jit built
+inside a loop or built-and-called in one expression inside a function
+creates a fresh cache entry — a full retrace + XLA compile — on every
+iteration/call. The RecompileSentinel only sees this at runtime, after
+the step time explodes; statically the shape is unmistakable.
+
+Flags:
+- a `jax.jit(...)` / `pjit(...)` call lexically inside a `for`/`while`
+  body (stopping at an intervening `def` — a factory defined inside a
+  loop body runs when called, not per iteration);
+- `jax.jit(f)(...)` or `jax.jit(f).lower(...)` inside a function body:
+  the jitted object is consumed inline, never cached, so the enclosing
+  function pays a fresh trace per call;
+- `jax.jit(lambda ...: ...)` inside a function body: the lambda is a
+  new object every evaluation, so the jit cache can never hit across
+  calls of the enclosing function. Hoist the lambda to a module-level
+  `def` (or build the jit once at init and store it).
+
+Legitimate one-shot shapes (an init-time jit under out_shardings, an
+AOT cost probe) are suppressed with a reason in
+scripts/graftlint_suppressions.txt.
+"""
+
+    def check_file(self, ctx: FileContext) -> list[Finding]:
+        findings: list[Finding] = []
+        # non-Call decorator shapes: a bare `@jax.jit` / `@partial(
+        # jax.jit, ...)` on a def inside a loop builds a fresh
+        # executable per iteration just like the call form, but has no
+        # jit Call node for the walk below to visit (the call form
+        # `@jax.jit(...)` is covered there)
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            if not any(_non_call_jit_decorator(d)
+                       for d in node.decorator_list):
+                continue
+            for anc in ctx.ancestors(node):
+                if isinstance(anc, _FUNC_SCOPES):
+                    break
+                if isinstance(anc, _LOOPS):
+                    findings.append(ctx.finding(
+                        self.id, node,
+                        f"jit-decorated def {node.name!r} inside a loop "
+                        "— the decorator builds a fresh executable "
+                        "(full retrace + compile) every iteration; "
+                        "hoist the def out of the loop"))
+                    break
+        # local build-then-call: `def step(x): f = jax.jit(fn);
+        # return f(x)` pays the same fresh trace+compile per call of
+        # `step` as the inline `jax.jit(fn)(x)` — a two-line rewrite
+        # must not clear the lint. Build-and-RETURN (the factory
+        # pattern, caller caches the result) stays clean.
+        for fn_node in ast.walk(ctx.tree):
+            if not isinstance(fn_node, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                continue
+            local_jits: dict[str, int] = {}
+            for sub in _own_body_walk(fn_node):
+                if isinstance(sub, ast.Assign) and len(sub.targets) == 1 \
+                        and isinstance(sub.targets[0], ast.Name) \
+                        and isinstance(sub.value, ast.Call) \
+                        and is_jit_ref(sub.value.func):
+                    local_jits[sub.targets[0].id] = sub.lineno
+            if not local_jits:
+                continue
+            for sub in _own_body_walk(fn_node):
+                if isinstance(sub, ast.Call) \
+                        and isinstance(sub.func, ast.Name) \
+                        and sub.func.id in local_jits \
+                        and sub.lineno > local_jits[sub.func.id]:
+                    findings.append(ctx.finding(
+                        self.id, sub,
+                        f"{sub.func.id!r} was built by jit in this same "
+                        f"function body (line "
+                        f"{local_jits[sub.func.id]}) and is invoked "
+                        "here — a fresh executable per call of "
+                        f"{fn_node.name!r}; build the jit once outside "
+                        "and reuse it"))
+
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call) or not is_jit_ref(node.func):
+                continue
+
+            in_function = False
+            loop_before_function = False
+            prev: ast.AST = node
+            for anc in ctx.ancestors(node):
+                if isinstance(anc, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)) \
+                        and _in_decorators(prev, anc):
+                    # a decorator executes in the ENCLOSING scope, at
+                    # def-statement time — `for ...: @jax.jit def f()`
+                    # builds a fresh jit per iteration; keep walking
+                    prev = anc
+                    continue
+                if isinstance(anc, _FUNC_SCOPES):
+                    in_function = True
+                    break
+                if isinstance(anc, _LOOPS):
+                    loop_before_function = True
+                prev = anc
+            # one finding per jit call — each shape below is the same
+            # hazard (a fresh executable per call); report the most
+            # specific description, not several for one site
+            parent = ctx.parents.get(node)
+            if loop_before_function:
+                findings.append(ctx.finding(
+                    self.id, node,
+                    "jit constructed inside a loop — a fresh executable "
+                    "(full retrace + compile) every iteration; hoist the "
+                    "jit out of the loop"))
+            elif in_function and isinstance(parent, ast.Call) \
+                    and parent.func is node:
+                findings.append(ctx.finding(
+                    self.id, node,
+                    "jit built and invoked in one expression inside a "
+                    "function — the executable is never cached, so every "
+                    "call of the enclosing function recompiles; build "
+                    "the jit once and reuse it"))
+            elif in_function and isinstance(parent, ast.Attribute) \
+                    and parent.value is node:
+                findings.append(ctx.finding(
+                    self.id, node,
+                    f"fresh jit consumed inline via .{parent.attr} inside "
+                    "a function — the wrapper is rebuilt (and its cache "
+                    "lost) on every call of the enclosing function"))
+            elif in_function and node.args \
+                    and isinstance(node.args[0], ast.Lambda):
+                findings.append(ctx.finding(
+                    self.id, node,
+                    "lambda passed to jit inside a function — a new "
+                    "function object (new cache entry) every evaluation; "
+                    "hoist it to a module-level def"))
+        return findings

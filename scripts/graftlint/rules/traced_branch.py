@@ -1,0 +1,206 @@
+"""traced-branch rule: python control flow on traced values.
+
+Inside a jitted function (or a scan/vmap/while_loop body) every array
+is a tracer. ``if jnp.any(x):`` / ``while jnp.max(err) > tol:`` /
+``assert jnp.all(ok)`` force the tracer to a python bool — a trace-
+time error in the good case, and in the bad case (shape-dependent or
+weak-typed paths that happen to be concrete on the first trace) a
+silently BAKED-IN branch: the compiled executable keeps the decision
+the tracer took once, for every future input. The fix is structural
+(``jnp.where``, ``lax.cond``, ``lax.while_loop``, ``checkify`` for
+assertions), so the earlier it's caught the cheaper it is.
+
+Scope: flow-insensitive — only functions the scanner can SEE are
+traced are checked: ``def``s decorated with ``jit``/``pjit`` (bare,
+``jax.``-qualified, or under ``partial(...)``), and ``def``s whose
+name is passed to a known tracing transform (``jit``, ``vmap``,
+``pmap``, ``grad``, ``value_and_grad``, ``checkpoint``/``remat``,
+``lax.scan``/``while_loop``/``fori_loop``/``cond``/``switch``/
+``map``). Functions nested inside a traced function are traced too.
+Branches on static python values (``if self.training:``,
+``if x.ndim > 2:``) never trip the rule — only tests containing a
+call to a non-static ``jnp.*`` function are flagged.
+"""
+from __future__ import annotations
+
+import ast
+
+from scripts.graftlint.core import FileContext, Finding, Rule, is_jit_ref
+
+RULE_ID = "traced-branch"
+
+# transform attr/name -> positions of traced-function arguments
+_TRANSFORM_ARGPOS: dict[str, tuple[int, ...]] = {
+    "jit": (0,), "pjit": (0,), "vmap": (0,), "pmap": (0,),
+    "grad": (0,), "value_and_grad": (0,), "checkpoint": (0,),
+    "remat": (0,),
+    "scan": (0,), "while_loop": (0, 1), "fori_loop": (2,),
+    "cond": (1, 2), "switch": (1,), "map": (0,),
+}
+
+# jnp.* calls that resolve at trace time to static python values —
+# branching on them is fine (dtype/shape introspection)
+_STATIC_JNP = {"issubdtype", "isdtype", "result_type", "promote_types",
+               "iinfo", "finfo", "dtype", "ndim", "shape", "size"}
+
+
+def _callable_name(node: ast.AST) -> str | None:
+    """Final name of a (possibly dotted) callable reference."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    return None
+
+
+def _decorator_is_tracing(dec: ast.AST) -> bool:
+    """``@jit`` / ``@jax.jit`` / ``@jax.jit(...)`` /
+    ``@partial(jax.jit, ...)`` / ``@functools.partial(jit, ...)``
+    (the shared ``is_jit_ref`` — another library's ``.jit`` decorator
+    must not mark a def as jax-traced)."""
+    if is_jit_ref(dec):
+        return True
+    if isinstance(dec, ast.Call):
+        if is_jit_ref(dec.func):
+            return True
+        if _callable_name(dec.func) == "partial" and dec.args \
+                and is_jit_ref(dec.args[0]):
+            return True
+    return False
+
+
+def _traced_defs(ctx: FileContext) -> set[ast.AST]:
+    """FunctionDefs the scanner can prove are traced."""
+    by_name: dict[str, list[ast.AST]] = {}
+    traced: set[ast.AST] = set()
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # a bare-name transform reference (`jax.vmap(apply)`) can
+            # never resolve to a class METHOD — exclude direct methods
+            # (nearest enclosing scope is a ClassDef) from the by-name
+            # pool or an unrelated `Helper.apply` gets recruited by a
+            # module-level `apply`'s tracedness. A def nested inside a
+            # FUNCTION stays: a scan body defined in a method is still
+            # referenced by bare name in that scope.
+            scope = next((a for a in ctx.ancestors(node)
+                          if isinstance(a, (ast.ClassDef, ast.FunctionDef,
+                                            ast.AsyncFunctionDef))), None)
+            if not isinstance(scope, ast.ClassDef):
+                by_name.setdefault(node.name, []).append(node)
+            if any(_decorator_is_tracing(d) for d in node.decorator_list):
+                traced.add(node)
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _callable_name(node.func)
+        positions = _TRANSFORM_ARGPOS.get(name or "")
+        if not positions:
+            continue
+        # jit/pjit by CALL must be jax's (bare or `jax.`-qualified) —
+        # same discipline as the decorator path
+        if name in ("jit", "pjit") and not is_jit_ref(node.func):
+            continue
+        # lax-control-flow names are common words (`map`, `cond`,
+        # `scan`): only count them under an explicit `lax.` base —
+        # `jax.tree.map(fn, ...)` or a user `scan()` must not recruit
+        # their arguments. jit/vmap/grad-family names are unambiguous.
+        if name in ("scan", "while_loop", "fori_loop", "cond",
+                    "switch", "map"):
+            base = node.func.value if isinstance(node.func,
+                                                 ast.Attribute) else None
+            base_name = (base.id if isinstance(base, ast.Name)
+                         else base.attr if isinstance(base, ast.Attribute)
+                         else None)
+            if base_name != "lax":
+                continue
+        for pos in positions:
+            if pos < len(node.args) and isinstance(node.args[pos],
+                                                   ast.Name):
+                traced.update(by_name.get(node.args[pos].id, []))
+    # everything lexically nested in a traced def runs under the trace
+    nested: set[ast.AST] = set()
+    for root in traced:
+        for sub in ast.walk(root):
+            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and sub is not root:
+                nested.add(sub)
+    return traced | nested
+
+
+def _has_traced_jnp_call(expr: ast.AST) -> bool:
+    """True when the subtree contains a call to a non-static jnp.* /
+    jax.numpy.* function."""
+    for node in ast.walk(expr):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        if not isinstance(fn, ast.Attribute) or fn.attr in _STATIC_JNP:
+            continue
+        base = fn.value
+        if isinstance(base, ast.Name) and base.id == "jnp":
+            return True
+        if isinstance(base, ast.Attribute) and base.attr == "numpy" \
+                and isinstance(base.value, ast.Name) \
+                and base.value.id == "jax":
+            return True
+    return False
+
+
+class TracedBranchRule(Rule):
+    id = RULE_ID
+    summary = ("python if/while/assert on a jnp expression inside a "
+               "visibly jitted or scan/vmap body")
+    doc = """\
+Why: under jit, arrays are tracers. `if`/`while`/`assert` on a traced
+expression either errors at trace time (TracerBoolConversionError) or
+— when the value happens to be concrete on the first trace — bakes
+that one decision into the executable forever. The structural fixes
+are `jnp.where` (data choice), `lax.cond` (traced branch),
+`lax.while_loop` (traced loop), `checkify.check` (assertion).
+
+Flags: a python `if`, `while`, or `assert` whose test contains a call
+to a non-static `jnp.*` / `jax.numpy.*` function, inside a function
+the scanner can SEE is traced — decorated with jit (incl. under
+`partial`), passed by name to jit/vmap/pmap/grad/value_and_grad/
+checkpoint, or passed as a `lax.scan`/`while_loop`/`fori_loop`/
+`cond`/`switch`/`map` body; nested defs inherit tracedness.
+
+Stays clean: branches on static config (`if self.causal:`), shape/
+dtype introspection (`if x.ndim > 2:`, `if jnp.issubdtype(...)`), and
+methods jitted through unresolvable references (`jax.jit(self._fn)`)
+— the rule prefers silence to noise on those.
+"""
+
+    def check_file(self, ctx: FileContext) -> list[Finding]:
+        findings: list[Finding] = []
+        for fn in _traced_defs(ctx):
+            # walk fn's OWN body only — defs nested inside it are in
+            # the traced set themselves, so descending into them here
+            # would report each of their branches twice
+            stack: list[ast.AST] = list(ast.iter_child_nodes(fn))
+            nodes: list[ast.AST] = []
+            while stack:
+                node = stack.pop()
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    continue
+                nodes.append(node)
+                stack.extend(ast.iter_child_nodes(node))
+            for node in nodes:
+                if isinstance(node, (ast.If, ast.While)):
+                    test = node.test
+                    kind = "if" if isinstance(node, ast.If) else "while"
+                elif isinstance(node, ast.Assert):
+                    test = node.test
+                    kind = "assert"
+                else:
+                    continue
+                if _has_traced_jnp_call(test):
+                    findings.append(ctx.finding(
+                        self.id, node,
+                        f"python `{kind}` on a jnp expression inside "
+                        f"traced function {getattr(fn, 'name', '?')!r} "
+                        "— use jnp.where / lax.cond / lax.while_loop / "
+                        "checkify instead (a tracer here either errors "
+                        "or bakes one branch into the executable)"))
+        return findings

@@ -1,0 +1,326 @@
+"""use-after-donate rule: reading a buffer after jit donated it.
+
+``donate_argnums`` hands an argument's device buffer to XLA for
+in-place reuse — after the call the python object still exists but its
+buffer is DELETED. Reading it again raises at best
+(``RuntimeError: Array has been deleted``) and, through layers that
+defensively copy or re-place arrays, can silently alias stale memory.
+PR 3's ``create_state`` bug was exactly this shape (mesh placement
+aliased caller buffers; the donating step then deleted the user's
+originals) and was caught only in review — this rule pins it
+statically.
+
+Analysis: assignments of the form
+``name = jax.jit(f, donate_argnums=(i, j))`` (plain names and
+``self.attr`` targets; literal donate positions only) register a
+donating callable — module-level plain names for the whole file,
+``self.attr`` targets for their own class's methods, a plain name
+assigned inside a function for that function's body only. Each function body is then walked in source order:
+a call of a registered callable marks the root of every argument in a
+donated position (a name, ``self.attr``, or a subscript's base) as
+donated; a later read of that root before reassignment is flagged.
+Shadowing is respected: a body whose PARAMETER (or a local rebinding
+to a non-jit value) reuses a registered name is calling a different
+callable and drops the registration for that body.
+Non-literal ``donate_argnums`` (e.g. computed tuples) are out of
+scope — the engine/step factories that do that return the jitted fn
+to callers this rule cannot see anyway.
+"""
+from __future__ import annotations
+
+import ast
+
+from scripts.graftlint.core import FileContext, Finding, Rule, is_jit_ref
+
+RULE_ID = "use-after-donate"
+
+
+def _is_jit_call(node: ast.AST) -> bool:
+    return isinstance(node, ast.Call) and is_jit_ref(node.func)
+
+
+def _donated_positions(node: ast.Call) -> tuple[int, ...] | None:
+    """Literal donate_argnums of a jit call, or None."""
+    for kw in node.keywords:
+        if kw.arg != "donate_argnums":
+            continue
+        value = kw.value
+        if isinstance(value, ast.Constant) and isinstance(value.value, int):
+            return (value.value,)
+        if isinstance(value, (ast.Tuple, ast.List)):
+            out = []
+            for elt in value.elts:
+                if not (isinstance(elt, ast.Constant)
+                        and isinstance(elt.value, int)):
+                    return None
+                out.append(elt.value)
+            return tuple(out)
+        return None
+    return None
+
+
+def _target_key(node: ast.AST) -> str | None:
+    """A trackable root: ``name`` or ``self.attr`` (dotted)."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name):
+        return f"{node.value.id}.{node.attr}"
+    return None
+
+
+def _arg_root_key(node: ast.AST) -> str | None:
+    """The donated argument's trackable root — unwraps subscripts so
+    ``self.pool["k"]`` donates root ``self.pool``."""
+    while isinstance(node, ast.Subscript):
+        node = node.value
+    return _target_key(node)
+
+
+def _collect_donating_callables(
+        ctx: "FileContext") -> tuple[
+            dict[str, tuple[int, ...]],
+            dict[ast.AST, dict[str, tuple[int, ...]]],
+            dict[ast.AST, dict[str, tuple[int, ...]]]]:
+    """Donating-callable registries, scope-aware.
+
+    Returns ``(global_table, local_by_fn, attr_by_class)``:
+    module-level plain names register globally; a plain name assigned
+    INSIDE a function registers only for that function's own body (a
+    local ``step = jax.jit(...)`` must not recruit same-named calls in
+    unrelated functions); a ``self.attr`` target registers for the
+    methods of its OWN class only (the engine pattern — built in
+    ``__init__``, called in every method — without letting another
+    class's same-named non-donating ``self.attr`` be treated as
+    donating).
+    """
+    global_table: dict[str, tuple[int, ...]] = {}
+    local_by_fn: dict[ast.AST, dict[str, tuple[int, ...]]] = {}
+    attr_by_class: dict[ast.AST, dict[str, tuple[int, ...]]] = {}
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            target = node.targets[0]
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            target = node.target   # `step: Callable = jax.jit(...)`
+        else:
+            continue
+        if not _is_jit_call(node.value):
+            continue
+        positions = _donated_positions(node.value)
+        if not positions:
+            continue
+        key = _target_key(target)
+        if key is None:
+            continue
+        enclosing_fn = None
+        enclosing_class = None
+        for anc in ctx.ancestors(node):
+            if enclosing_fn is None and isinstance(
+                    anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                enclosing_fn = anc
+            if isinstance(anc, ast.ClassDef):
+                enclosing_class = anc
+                break
+        if "." in key:
+            if enclosing_class is not None:
+                attr_by_class.setdefault(enclosing_class, {})[key] = \
+                    positions
+            else:
+                global_table[key] = positions
+        elif enclosing_fn is None:
+            global_table[key] = positions
+        else:
+            local_by_fn.setdefault(enclosing_fn, {})[key] = positions
+    return global_table, local_by_fn, attr_by_class
+
+
+def _call_key(node: ast.Call) -> str | None:
+    return _target_key(node.func)
+
+
+def _param_names(args: ast.arguments) -> set[str]:
+    names = {a.arg for a in (*args.posonlyargs, *args.args,
+                             *args.kwonlyargs)}
+    if args.vararg is not None:
+        names.add(args.vararg.arg)
+    if args.kwarg is not None:
+        names.add(args.kwarg.arg)
+    return names
+
+
+class _BodyWalker:
+    """Source-order walk of one function body tracking donated roots."""
+
+    def __init__(self, ctx: FileContext, rule_id: str,
+                 table: dict[str, tuple[int, ...]]):
+        self.ctx = ctx
+        self.rule_id = rule_id
+        # per-body copy: a local assignment (or, see check_file, a
+        # parameter) shadowing a donating callable's name must stop
+        # recruiting the module-level donation table
+        self.table = dict(table)
+        self.donated: dict[str, int] = {}   # root -> donating call line
+        self.findings: list[Finding] = []
+
+    def walk(self, node: ast.AST) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            return  # nested scopes walked independently
+        if isinstance(node, ast.Assign):
+            self.walk_expr(node.value)
+            # `g = jax.jit(f, donate_argnums=...)` re-registers the
+            # callable (the module collector saw it); any OTHER value
+            # shadows the name
+            reregisters = bool(_is_jit_call(node.value)
+                               and _donated_positions(node.value))
+            for target in node.targets:
+                self._clear(target, drop_callable=not reregisters)
+            return
+        if isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            if node.value is not None:
+                self.walk_expr(node.value)
+            if isinstance(node, ast.AnnAssign):
+                reregisters = bool(node.value is not None
+                                   and _is_jit_call(node.value)
+                                   and _donated_positions(node.value))
+                self._clear(node.target, drop_callable=not reregisters)
+                return
+            if isinstance(node, ast.AugAssign):
+                # `state += x` READS state before writing it — a
+                # donated root here is the same deleted-buffer read as
+                # any other Load, not a clean reassignment
+                root = _arg_root_key(node.target)
+                if root is not None and root in self.donated:
+                    self.findings.append(self.ctx.finding(
+                        self.rule_id, node,
+                        f"{root!r} augmented-assigned after being "
+                        "donated to a jitted call at line "
+                        f"{self.donated[root]} — += reads the deleted "
+                        "buffer first; rebuild the value from the "
+                        "call's output instead"))
+            self._clear(node.target)
+            return
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                self.walk_expr(item.context_expr)
+                if item.optional_vars is not None:
+                    self._clear(item.optional_vars)
+            for stmt in node.body:
+                self.walk(stmt)
+            return
+        if isinstance(node, (ast.For, ast.AsyncFor)):
+            self.walk_expr(node.iter)
+            self._clear(node.target)
+            for stmt in (*node.body, *node.orelse):
+                self.walk(stmt)
+            return
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.expr):
+                self.walk_expr(child)
+            else:
+                self.walk(child)
+
+    def _clear(self, target: ast.AST, drop_callable: bool = True) -> None:
+        for sub in ast.walk(target):
+            key = _target_key(sub)
+            if key is not None:
+                self.donated.pop(key, None)
+                if drop_callable \
+                        and isinstance(sub, (ast.Name, ast.Attribute)) \
+                        and isinstance(sub.ctx, ast.Store):
+                    self.table.pop(key, None)  # rebound in this body
+
+    def walk_expr(self, expr: ast.AST) -> None:
+        if isinstance(expr, ast.Lambda):
+            return
+        if isinstance(expr, ast.Call):
+            callee = _call_key(expr)
+            positions = self.table.get(callee) if callee else None
+            if positions:
+                # args evaluate before the call: read-check them first
+                for arg in expr.args:
+                    self.walk_expr(arg)
+                for kw in expr.keywords:
+                    self.walk_expr(kw.value)
+                for pos in positions:
+                    if pos < len(expr.args):
+                        root = _arg_root_key(expr.args[pos])
+                        if root is not None:
+                            self.donated[root] = expr.lineno
+                return
+        key = _target_key(expr)
+        if key is not None and key in self.donated \
+                and isinstance(getattr(expr, "ctx", None), ast.Load):
+            self.findings.append(self.ctx.finding(
+                self.rule_id, expr,
+                f"{key!r} read after being donated to a jitted call at "
+                f"line {self.donated[key]} — its device buffer is "
+                "deleted by donation; reassign it from the call's "
+                "output (or drop donate_argnums for this argument)"))
+            # one report per donation site is enough
+            self.donated.pop(key, None)
+            return
+        for child in ast.iter_child_nodes(expr):
+            if isinstance(child, (ast.expr, ast.keyword)):
+                self.walk_expr(child.value
+                               if isinstance(child, ast.keyword) else child)
+
+
+class UseAfterDonateRule(Rule):
+    id = RULE_ID
+    summary = ("an argument read again after being passed in a "
+               "donate_argnums position")
+    doc = """\
+Why: donation is the serving/training stack's way to update the KV
+pool and TrainState without doubling HBM — and its contract is strict:
+the donated buffer is DELETED when the call returns. Code that keeps
+reading the old python name afterwards worked yesterday (no donation)
+and explodes today, or worse, reads through a defensive copy that
+silently diverges. PR 3's create_state use-after-donate was caught
+only in human review; this is the static version of that reviewer.
+
+Flags, flow-insensitively within each function body:
+- a module registers donating callables from literal assignments like
+  `step = jax.jit(f, donate_argnums=(0,))` (plain-name and
+  `self.attr` targets, literal positions only);
+- at a call `step(state, batch)`, the root of each donated-position
+  argument (`state`, `self.pool` for `self.pool["k"]`) is marked;
+- any read of that root before reassignment is a finding.
+
+The clean idiom the engine already follows everywhere:
+`tok, k, v = self._decode_jit(params, self.pool["k"], ...)` followed
+IMMEDIATELY by `self.pool = {"k": k, "v": v}`.
+"""
+
+    def check_file(self, ctx: FileContext) -> list[Finding]:
+        global_table, local_by_fn, attr_by_class = \
+            _collect_donating_callables(ctx)
+        if not global_table and not local_by_fn and not attr_by_class:
+            return []
+        findings: list[Finding] = []
+        scopes: list[tuple[list[ast.stmt], dict[str, tuple[int, ...]]]] \
+            = [(ctx.tree.body, dict(global_table))]
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # a parameter shadowing a donating callable's name is a
+                # DIFFERENT (possibly non-donating) callable inside
+                # this body — `def helper(step, state): step(state)`
+                # must not recruit a module-level donating `step`;
+                # the function's OWN local jit assignments and its OWN
+                # class's self.attr registrations add on top
+                params = _param_names(node.args)
+                scoped = {name: pos for name, pos in global_table.items()
+                          if name not in params}
+                owner = next((a for a in ctx.ancestors(node)
+                              if isinstance(a, ast.ClassDef)), None)
+                if owner is not None:
+                    scoped.update(attr_by_class.get(owner, {}))
+                scoped.update(local_by_fn.get(node, {}))
+                scopes.append((node.body, scoped))
+        for body, table in scopes:
+            if not table:
+                continue
+            walker = _BodyWalker(ctx, self.id, table)
+            for stmt in body:
+                walker.walk(stmt)
+            findings.extend(walker.findings)
+        return findings

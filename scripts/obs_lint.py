@@ -1,134 +1,33 @@
 #!/usr/bin/env python
-"""Static lint for per-step host-sync smells in torchbooster_tpu/.
+"""Compatibility shim: obs_lint is now graftlint's ``host-sync`` rule.
 
-The repo's core perf discipline (SURVEY §3.3, metrics.py docstring) is
-that nothing on a step-cadence code path forces a device→host sync:
-``.item()``, ``float()`` of a just-computed device value, and
-wall-clock reads between jitted calls all serialize the dispatch
-pipeline, and one careless line erases the async-dispatch win the
-whole stack is built around. Tests can't see this class of regression
-(the numbers stay correct, only the overlap dies), so it's linted.
+The original 3-smell host-sync lint (PR 2) was re-homed into the
+multi-rule analyzer at ``scripts/graftlint/rules/host_sync.py`` —
+semantics intact: same three smells (``.item()``, ``time.time()``,
+``float(<call>)`` in HOT paths), same ``scripts/obs_allowlist.txt``
+``path:substring`` allowlist, same exit codes (0 clean, 1 findings).
+This file keeps the historical entry points alive:
 
-Smells (AST-based — comments and docstrings never trip it):
+- ``python scripts/obs_lint.py`` still lints host syncs only;
+- ``scan()``, ``_Finder``, ``HOT_PATHS``, ``allowed``,
+  ``load_allowlist`` re-export unchanged for tests/test_obs_lint.py
+  and any local tooling.
 
-- ``<expr>.item()``           anywhere in the package (the torch-ism
-                              the reference used per step);
-- ``time.time()``             anywhere (durations must use
-                              ``perf_counter``; wall-clock event
-                              TIMESTAMPS are legitimate and
-                              allowlisted per line);
-- ``float(<call>)``           in HOT paths only (train/serve/step
-                              code), where the argument is itself a
-                              call — the ``float(loss_fn(...))`` /
-                              ``float(np.mean(device_value))`` shape
-                              that materializes a device result.
-
-Allowlist: scripts/obs_allowlist.txt — ``path:substring`` per line,
-matched against the flagged source line; '#' comments. A deliberate
-sync (a drain point, a post-run aggregation) gets allowlisted WITH a
-reason, so every exception is documented.
-
-Exit 0 clean, 1 with findings (wired as a tier-1 test:
-tests/test_obs_lint.py).
+For the full rule set (recompile-hazard, prng-reuse, use-after-donate,
+traced-branch, config-doc-drift) run ``python -m scripts.graftlint``;
+docs/static_analysis.md has the catalog.
 """
 from __future__ import annotations
 
-import ast
 import sys
 from pathlib import Path
 
 REPO = Path(__file__).resolve().parent.parent
-PACKAGE = REPO / "torchbooster_tpu"
-ALLOWLIST = REPO / "scripts" / "obs_allowlist.txt"
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
 
-# step-cadence code paths where float(<call>) is treated as a sync
-HOT_PATHS = (
-    "torchbooster_tpu/utils.py",
-    "torchbooster_tpu/metrics.py",
-    "torchbooster_tpu/scheduler.py",
-    # the whole serving package is step-cadence: engine decode/prefill,
-    # the batcher loop, AND speculative.py (host-side drafting runs
-    # between every verify dispatch — a stray sync there stalls the
-    # multi-token pipeline exactly like one in the decode loop;
-    # tests/test_obs_lint.py pins the coverage)
-    "torchbooster_tpu/serving/",
-    "torchbooster_tpu/observability/",
-    "torchbooster_tpu/data/pipeline.py",
-    # the gradient-sync hook runs INSIDE the compiled step and its
-    # byte counters on the step cadence — one stray host sync there
-    # serializes every dispatch
-    "torchbooster_tpu/comms/",
-)
-
-
-def load_allowlist() -> list[tuple[str, str]]:
-    entries: list[tuple[str, str]] = []
-    if not ALLOWLIST.exists():
-        return entries
-    for raw in ALLOWLIST.read_text().splitlines():
-        line = raw.strip()
-        if not line or line.startswith("#"):
-            continue
-        path, _, pattern = line.partition(":")
-        entries.append((path.strip(), pattern.strip()))
-    return entries
-
-
-def allowed(rel: str, source_line: str,
-            entries: list[tuple[str, str]]) -> bool:
-    return any(rel == path and pattern in source_line
-               for path, pattern in entries)
-
-
-class _Finder(ast.NodeVisitor):
-    def __init__(self, rel: str, lines: list[str], hot: bool):
-        self.rel = rel
-        self.lines = lines
-        self.hot = hot
-        self.findings: list[tuple[str, int, str, str]] = []
-
-    def _flag(self, node: ast.AST, smell: str) -> None:
-        line = self.lines[node.lineno - 1].strip()
-        self.findings.append((self.rel, node.lineno, smell, line))
-
-    def visit_Call(self, node: ast.Call) -> None:
-        fn = node.func
-        # <expr>.item()
-        if isinstance(fn, ast.Attribute) and fn.attr == "item" \
-                and not node.args and not node.keywords:
-            self._flag(node, ".item() host sync")
-        # time.time()
-        if isinstance(fn, ast.Attribute) and fn.attr == "time" \
-                and isinstance(fn.value, ast.Name) \
-                and fn.value.id == "time":
-            self._flag(node, "time.time() (use perf_counter for "
-                             "durations; allowlist timestamps)")
-        # float(<call>) in hot paths
-        if self.hot and isinstance(fn, ast.Name) and fn.id == "float" \
-                and len(node.args) == 1 \
-                and isinstance(node.args[0], ast.Call):
-            self._flag(node, "float(<call>) likely device sync in a "
-                             "step-cadence path")
-        self.generic_visit(node)
-
-
-def scan() -> list[tuple[str, int, str, str]]:
-    entries = load_allowlist()
-    findings: list[tuple[str, int, str, str]] = []
-    for path in sorted(PACKAGE.rglob("*.py")):
-        rel = path.relative_to(REPO).as_posix()
-        hot = any(rel.startswith(h) for h in HOT_PATHS)
-        source = path.read_text()
-        try:
-            tree = ast.parse(source)
-        except SyntaxError as exc:
-            findings.append((rel, exc.lineno or 0, "syntax error", str(exc)))
-            continue
-        finder = _Finder(rel, source.splitlines(), hot)
-        finder.visit(tree)
-        findings.extend(
-            f for f in finder.findings if not allowed(f[0], f[3], entries))
-    return findings
+from scripts.graftlint.rules.host_sync import (  # noqa: E402,F401
+    ALLOWLIST, HOT_PATHS, PACKAGE, _Finder, allowed, load_allowlist, scan)
 
 
 def main() -> int:

@@ -1,0 +1,1179 @@
+"""Tier-1 wiring + fixture coverage for the graftlint static analyzer
+(scripts/graftlint/): the package must scan clean under ALL rules, and
+every rule must provably keep its teeth — a deliberate positive it
+catches, a near-miss negative it stays silent on, and a suppression
+round-trip (reasoned entry silences exactly that finding; a reasonless
+or stale entry is itself a finding).
+
+Everything here is pure AST work — no jax import, so the whole module
+costs milliseconds inside tier-1.
+"""
+from __future__ import annotations
+
+import json
+import re
+import sys
+import textwrap
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parents[1]
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
+
+from scripts.graftlint import run_scan                      # noqa: E402
+from scripts.graftlint.core import Suppression, scan        # noqa: E402
+from scripts.graftlint.rules import ALL_RULES, RULES_BY_ID  # noqa: E402
+from scripts.graftlint.rules.config_doc_drift import (      # noqa: E402
+    ConfigDocDriftRule)
+
+
+def _scan_fixture(tmp_path: Path, source: str, rule_id: str,
+                  rel: str = "pkg/mod.py",
+                  suppressions: str | None = None,
+                  check_stale: bool = False):
+    """Write ``source`` at ``tmp_path/rel`` and scan it with one rule
+    (plus an optional suppression file), returning the ScanResult."""
+    target = tmp_path / rel
+    target.parent.mkdir(parents=True, exist_ok=True)
+    target.write_text(textwrap.dedent(source))
+    sup_path = None
+    if suppressions is not None:
+        sup_path = tmp_path / "suppressions.txt"
+        sup_path.write_text(textwrap.dedent(suppressions))
+    return scan([RULES_BY_ID[rule_id]], paths=[target], repo=tmp_path,
+                suppression_path=sup_path
+                if sup_path is not None else tmp_path / "absent.txt",
+                check_stale=check_stale)
+
+
+# =========================================================================
+# The tier-1 gate: the package scans clean, with every rule active
+# =========================================================================
+
+def test_package_scans_clean_under_all_rules():
+    """CI fails on any new unsuppressed finding, any stale suppression,
+    and any reasonless suppression — the acceptance bar of the
+    analyzer. (Fix the code, or suppress WITH a reason in
+    scripts/graftlint_suppressions.txt / scripts/obs_allowlist.txt.)"""
+    result = run_scan()
+    pretty = "\n".join(f.render() for f in result.findings)
+    assert result.clean, f"graftlint found:\n{pretty}"
+    assert result.n_files > 40, "package scan saw suspiciously few files"
+
+
+def test_at_least_five_rules_are_active_and_documented():
+    assert len(ALL_RULES) >= 5
+    for rule in ALL_RULES:
+        assert rule.id and rule.summary and rule.doc, (
+            f"rule {rule.id!r} is missing its summary/doc")
+
+
+def test_every_rule_has_positive_negative_and_suppression_fixtures():
+    """The fixture contract from scripts/graftlint/rules/__init__.py:
+    a registered rule without a deliberate positive, a near-miss
+    negative, AND a suppression round-trip in this module has no proof
+    it still has teeth — adding a rule means adding all three."""
+    this = Path(__file__).read_text()
+    for rule in ALL_RULES:
+        slug = rule.id.replace("-", "_")
+        for kind in ("positive", "near_miss", "suppression_round_trip"):
+            pattern = rf"def test_{slug}\w*_{kind}"
+            assert re.search(pattern, this), (
+                f"rule {rule.id!r} is missing its {kind} fixture test "
+                f"(want a test_{slug}_*{kind}* function)")
+
+
+def test_rule_catalog_documented():
+    """Every registered rule appears in docs/static_analysis.md's
+    catalog (and the doc page exists at the path README links)."""
+    doc = (REPO / "docs" / "static_analysis.md").read_text()
+    for rule in ALL_RULES:
+        assert f"`{rule.id}`" in doc, (
+            f"rule {rule.id!r} missing from docs/static_analysis.md")
+
+
+# =========================================================================
+# host-sync (the re-homed obs_lint; deep coverage in test_obs_lint.py)
+# =========================================================================
+
+def test_host_sync_positive_item_and_hot_float(tmp_path):
+    result = _scan_fixture(tmp_path, """\
+        def hot(metrics, loss_fn, x):
+            a = metrics['loss'].item()
+            b = float(loss_fn(x))
+            return a, b
+        """, "host-sync", rel="torchbooster_tpu/utils.py")
+    smells = [f.message for f in result.findings]
+    assert any(".item()" in s for s in smells)
+    assert any("float(<call>)" in s for s in smells)
+
+
+def test_host_sync_near_miss_cold_path_float_and_comment(tmp_path):
+    # float(<call>) outside HOT paths, and smells in comments/strings,
+    # must stay silent
+    result = _scan_fixture(tmp_path, """\
+        # metrics.item() in a comment never trips the AST
+        def cold(loss_fn, x):
+            '''float(loss_fn(x)) in a docstring neither'''
+            return float(loss_fn(x))
+        """, "host-sync", rel="torchbooster_tpu/models/custom.py")
+    assert not result.findings
+
+
+def test_host_sync_suppression_round_trip(tmp_path):
+    source = """\
+        def hot(v):
+            return v.item()
+        """
+    rel = "torchbooster_tpu/utils.py"
+    bare = _scan_fixture(tmp_path, source, "host-sync", rel=rel)
+    assert len(bare.findings) == 1
+    target = tmp_path / rel
+    silenced = scan(
+        [RULES_BY_ID["host-sync"]], paths=[target], repo=tmp_path,
+        suppression_path=tmp_path / "absent.txt",
+        extra_suppressions=[Suppression(
+            rule="host-sync", path=rel, pattern="v.item()",
+            reason="deliberate drain point", file="obs_allowlist.txt",
+            lineno=1)])
+    assert not silenced.findings
+
+
+# =========================================================================
+# recompile-hazard
+# =========================================================================
+
+def test_recompile_hazard_positives(tmp_path):
+    result = _scan_fixture(tmp_path, """\
+        import jax
+
+        def per_call(f, xs, x):
+            for _ in range(3):
+                g = jax.jit(f)          # fresh executable per iteration
+            y = jax.jit(f)(x)           # built-and-invoked inline
+            z = jax.jit(f).lower(x)     # fresh wrapper consumed inline
+            h = jax.jit(lambda a: a + 1)  # fresh lambda per call
+            return g, y, z, h
+        """, "recompile-hazard")
+    lines = sorted(f.line for f in result.findings)
+    assert lines == [5, 6, 7, 8], \
+        "\n".join(f.render() for f in result.findings)
+
+
+def test_recompile_hazard_near_misses(tmp_path):
+    # the factory pattern (build once, return), module-level jit, and a
+    # def nested inside a loop (runs when called, not per iteration)
+    result = _scan_fixture(tmp_path, """\
+        import jax
+
+        def make_step(step_fn):
+            jitted = jax.jit(step_fn, donate_argnums=(0,))
+            return jitted
+
+        eval_step = jax.jit(make_step)
+
+        for name in ("a", "b"):
+            def factory(f):
+                return jax.jit(f)
+        """, "recompile-hazard")
+    assert not result.findings, \
+        "\n".join(f.render() for f in result.findings)
+
+
+def test_recompile_hazard_positive_decorator_in_loop(tmp_path):
+    # decorators execute in the ENCLOSING scope: `@jax.jit(...)` on a
+    # def inside a loop builds a fresh executable per iteration
+    result = _scan_fixture(tmp_path, """\
+        import jax
+
+        for n in (1, 2, 3):
+            @jax.jit(static_argnums=(0,))
+            def step(a, x):
+                return x * a
+        """, "recompile-hazard")
+    assert len(result.findings) == 1
+    assert "inside a loop" in result.findings[0].message
+
+
+def test_recompile_hazard_positive_bare_decorator_in_loop(tmp_path):
+    # the bare and partial decorator forms have no jit Call node but
+    # build a fresh executable per iteration all the same
+    result = _scan_fixture(tmp_path, """\
+        import jax
+        from functools import partial
+
+        for n in (1, 2):
+            @jax.jit
+            def step(x):
+                return x * n
+
+        for m in (3, 4):
+            @partial(jax.jit, static_argnums=(0,))
+            def step2(a, x):
+                return x * a
+        """, "recompile-hazard")
+    assert len(result.findings) == 2, \
+        "\n".join(f.render() for f in result.findings)
+    assert all("inside a loop" in f.message for f in result.findings)
+
+
+def test_recompile_hazard_near_miss_module_level_decorator(tmp_path):
+    # the normal pattern — a jit-call decorator at module level (or a
+    # factory's one-per-call build) — stays clean
+    result = _scan_fixture(tmp_path, """\
+        import jax
+
+        @jax.jit(static_argnums=(0,))
+        def step(a, x):
+            return x * a
+        """, "recompile-hazard")
+    assert not result.findings, \
+        "\n".join(f.render() for f in result.findings)
+
+
+def test_recompile_hazard_positive_comprehension_build(tmp_path):
+    # the comprehension spelling of jit-in-a-loop is the same hazard —
+    # the rule must not be evadable by a one-line rewrite
+    result = _scan_fixture(tmp_path, """\
+        import jax
+
+        def build(fns):
+            return [jax.jit(f) for f in fns]
+        """, "recompile-hazard")
+    assert len(result.findings) == 1
+    assert "inside a loop" in result.findings[0].message
+
+
+def test_recompile_hazard_positive_local_build_then_call(tmp_path):
+    # the two-line rewrite of jit(f)(x) — build locally, call locally
+    # — pays the identical per-call recompile and must not clear CI;
+    # the factory (build and RETURN, caller caches) stays clean
+    result = _scan_fixture(tmp_path, """\
+        import jax
+
+        def per_call(fn, x):
+            f = jax.jit(fn)
+            return f(x)
+
+        def factory(fn):
+            jitted = jax.jit(fn, donate_argnums=(0,))
+            return jitted
+        """, "recompile-hazard")
+    assert len(result.findings) == 1, \
+        "\n".join(f.render() for f in result.findings)
+    assert result.findings[0].line == 5
+
+
+def test_recompile_hazard_one_finding_per_call_site(tmp_path):
+    # jit(lambda)(x) in a function is ONE hazard — the inline-invoke
+    # and lambda shapes must not both fire on the same call
+    result = _scan_fixture(tmp_path, """\
+        import jax
+
+        def f(x):
+            return jax.jit(lambda a: a)(x)
+        """, "recompile-hazard")
+    assert len(result.findings) == 1, \
+        "\n".join(f.render() for f in result.findings)
+
+
+def test_recompile_hazard_suppression_round_trip(tmp_path):
+    source = """\
+        import jax
+
+        def probe(f, x):
+            return jax.jit(f)(x)
+        """
+    bare = _scan_fixture(tmp_path, source, "recompile-hazard")
+    assert len(bare.findings) == 1
+    silenced = _scan_fixture(tmp_path, source, "recompile-hazard",
+                             suppressions="""\
+        # one-shot AOT probe, never on a step cadence
+        recompile-hazard pkg/mod.py:jax.jit(f)(x)
+        """)
+    assert not silenced.findings
+
+
+# =========================================================================
+# prng-reuse
+# =========================================================================
+
+def test_prng_reuse_positive_double_consumption(tmp_path):
+    result = _scan_fixture(tmp_path, """\
+        import jax
+
+        def bad(key, shape):
+            a = jax.random.normal(key, shape)
+            b = jax.random.uniform(key, shape)   # SAME numbers as a
+            return a, b
+        """, "prng-reuse")
+    assert len(result.findings) == 1
+    assert result.findings[0].line == 5
+    assert "reused" in result.findings[0].message
+
+
+def test_prng_reuse_positive_split_does_not_launder(tmp_path):
+    # consuming the key, then splitting the SAME key (without
+    # reassigning it) still correlates the streams
+    result = _scan_fixture(tmp_path, """\
+        import jax
+
+        def bad(key, shape):
+            a = jax.random.normal(key, shape)
+            sub = jax.random.split(key)[0]
+            return a, sub
+        """, "prng-reuse")
+    assert len(result.findings) == 1
+
+
+def test_prng_reuse_positive_while_test_consumer(tmp_path):
+    # the while TEST re-evaluates per iteration — a consumer there is
+    # the same same-randomness-every-pass hazard as one in the body
+    result = _scan_fixture(tmp_path, """\
+        import jax
+
+        def bad(key):
+            n = 0
+            while jax.random.bernoulli(key):
+                n += 1
+            return n
+        """, "prng-reuse")
+    assert len(result.findings) == 1
+    assert "inside a loop" in result.findings[0].message
+
+
+def test_prng_reuse_positive_loop_without_reassignment(tmp_path):
+    result = _scan_fixture(tmp_path, """\
+        import jax
+
+        def bad(key, shape, n):
+            out = []
+            for _ in range(n):
+                out.append(jax.random.normal(key, shape))
+            return out
+        """, "prng-reuse")
+    assert any("inside a loop" in f.message for f in result.findings)
+
+
+def test_prng_reuse_near_misses(tmp_path):
+    # every sanctioned idiom from the tree: split-then-use-once,
+    # per-iteration split, fold_in of the loop counter, and
+    # branch-EXCLUSIVE consumption with an early return — the exact
+    # torchbooster_tpu/models/layers.py _fan_in_scale shape that the
+    # analyzer's first cut false-positived on (terminating branches
+    # must not leak their consumption into the fall-through path)
+    result = _scan_fixture(tmp_path, """\
+        import jax
+
+        def fan_in(rng, shape, uniform):
+            if uniform:
+                return jax.random.uniform(rng, shape)
+            return jax.random.normal(rng, shape)
+
+        def good(rng, shape, n):
+            rng, k1, k2 = jax.random.split(rng, 3)
+            a = jax.random.normal(k1, shape)
+            b = jax.random.uniform(k2, shape)
+            out = []
+            for i in range(n):
+                rng, sub = jax.random.split(rng)
+                out.append(jax.random.normal(sub, shape))
+                out.append(jax.random.bernoulli(
+                    jax.random.fold_in(k1, i), 0.5, shape))
+            return a, b, out
+        """, "prng-reuse")
+    assert not result.findings, \
+        "\n".join(f.render() for f in result.findings)
+
+
+def test_prng_reuse_near_miss_ternary_and_short_circuit(tmp_path):
+    # expression-level exclusive use: `a if p else b` evaluates ONE
+    # arm (the ternary spelling of _fan_in_scale), and operands past
+    # the first of and/or may be skipped by short-circuit — neither is
+    # reuse
+    result = _scan_fixture(tmp_path, """\
+        import jax
+
+        def fan_in(rng, shape, uniform):
+            return (jax.random.uniform(rng, shape) if uniform
+                    else jax.random.normal(rng, shape))
+
+        def fallback(rng, shape, cached):
+            return cached or jax.random.normal(rng, shape)
+        """, "prng-reuse")
+    assert not result.findings, \
+        "\n".join(f.render() for f in result.findings)
+
+
+def test_prng_reuse_positive_across_ternary_boundary(tmp_path):
+    # ...but a ternary arm consuming a key already consumed BEFORE the
+    # expression is still reuse
+    result = _scan_fixture(tmp_path, """\
+        import jax
+
+        def bad(key, shape, u):
+            a = jax.random.normal(key, shape)
+            b = jax.random.uniform(key, shape) if u else 0.0
+            return a, b
+        """, "prng-reuse")
+    assert len(result.findings) == 1
+    assert result.findings[0].line == 5
+
+
+def test_prng_reuse_near_miss_comprehension_targets_own_scope(tmp_path):
+    # comprehension targets are their own scope in python 3: two comps
+    # reusing a target NAME over different key lists, and a comp
+    # consumer inside a for loop, are not key reuse
+    result = _scan_fixture(tmp_path, """\
+        import jax
+
+        def batched(keys1, keys2, shape):
+            a = [jax.random.normal(k, shape) for k in keys1]
+            b = [jax.random.uniform(k, shape) for k in keys2]
+            return a, b
+
+        def looped(rng, shape, n):
+            out = []
+            for i in range(n):
+                ks = jax.random.split(jax.random.fold_in(rng, i), 4)
+                out.append([jax.random.normal(k, shape) for k in ks])
+            return out
+        """, "prng-reuse")
+    assert not result.findings, \
+        "\n".join(f.render() for f in result.findings)
+
+
+def test_prng_reuse_positive_outer_key_inside_comprehension(tmp_path):
+    # ...but consuming an OUTER key in a comprehension still counts
+    result = _scan_fixture(tmp_path, """\
+        import jax
+
+        def bad(key, shape, n):
+            a = jax.random.normal(key, shape)
+            b = [jax.random.uniform(key, shape) for _ in range(n)]
+            return a, b
+        """, "prng-reuse")
+    assert len(result.findings) == 1
+    assert result.findings[0].line == 5
+
+
+def test_prng_reuse_one_finding_per_consumer_site(tmp_path):
+    # the loop check and the linear walk both reach these consumers —
+    # each bad line gets exactly one finding, not two
+    result = _scan_fixture(tmp_path, """\
+        import jax
+
+        def bad(key, shape, n):
+            for _ in range(n):
+                a = jax.random.normal(key, shape)
+                b = jax.random.uniform(key, shape)
+            return a, b
+        """, "prng-reuse")
+    assert sorted(f.line for f in result.findings) == [5, 6], \
+        "\n".join(f.render() for f in result.findings)
+
+
+def test_prng_reuse_suppression_round_trip(tmp_path):
+    source = """\
+        import jax
+
+        def antithetic(key, shape):
+            a = jax.random.normal(key, shape)
+            b = -jax.random.normal(key, shape)
+            return a, b
+        """
+    bare = _scan_fixture(tmp_path, source, "prng-reuse")
+    assert len(bare.findings) == 1
+    silenced = _scan_fixture(tmp_path, source, "prng-reuse",
+                             suppressions="""\
+        # deliberate antithetic pair: the correlation IS the estimator
+        prng-reuse pkg/mod.py:-jax.random.normal(key, shape)
+        """)
+    assert not silenced.findings
+
+
+# =========================================================================
+# use-after-donate
+# =========================================================================
+
+def test_use_after_donate_positive_name(tmp_path):
+    # the PR 3 create_state shape: state donated, then read
+    result = _scan_fixture(tmp_path, """\
+        import jax
+
+        step = jax.jit(lambda s, b: s, donate_argnums=(0,))
+
+        def train(state, batch):
+            new_state, metrics = step(state, batch)
+            return state.params, metrics   # state's buffer is GONE
+        """, "use-after-donate")
+    assert len(result.findings) == 1
+    assert result.findings[0].line == 7
+    assert "donated" in result.findings[0].message
+
+
+def test_use_after_donate_positive_self_attr(tmp_path):
+    result = _scan_fixture(tmp_path, """\
+        import jax
+
+        class Engine:
+            def __init__(self, fn):
+                self._decode = jax.jit(fn, donate_argnums=(1,))
+
+            def step(self, params):
+                toks, pool = self._decode(params, self.pool["k"])
+                stale = self.pool["k"].sum()   # donated above
+                self.pool = {"k": pool}
+                return toks, stale
+        """, "use-after-donate")
+    assert len(result.findings) == 1
+    assert result.findings[0].line == 9
+
+
+def test_use_after_donate_positive_annotated_binding(tmp_path):
+    # the typed spelling `step: Callable = jax.jit(...)` registers the
+    # donating callable exactly like the bare `=` form
+    result = _scan_fixture(tmp_path, """\
+        import jax
+        from typing import Callable
+
+        step: Callable = jax.jit(lambda s, b: s, donate_argnums=(0,))
+
+        def train(state, batch):
+            out = step(state, batch)
+            return state, out
+        """, "use-after-donate")
+    assert len(result.findings) == 1
+    assert result.findings[0].line == 8
+
+
+def test_use_after_donate_positive_augassign_reads_first(tmp_path):
+    # `state += x` READS the deleted buffer before writing — it is a
+    # use-after-donate, not a clean reassignment
+    result = _scan_fixture(tmp_path, """\
+        import jax
+
+        step = jax.jit(lambda s, b: s, donate_argnums=(0,))
+
+        def train(state, batch, delta):
+            out = step(state, batch)
+            state += delta
+            return state, out
+        """, "use-after-donate")
+    assert len(result.findings) == 1
+    assert result.findings[0].line == 7
+    assert "+=" in result.findings[0].message
+
+
+def test_use_after_donate_near_miss_reassignment(tmp_path):
+    # the engine idiom: donate, then IMMEDIATELY reassign the root
+    result = _scan_fixture(tmp_path, """\
+        import jax
+
+        step = jax.jit(lambda s, b: s, donate_argnums=(0,))
+
+        class Engine:
+            def __init__(self, fn):
+                self._decode = jax.jit(fn, donate_argnums=(1,))
+
+            def drive(self, state, batch, params):
+                state = step(state, batch)
+                loss = state.loss            # reassigned: fine
+                toks, pool = self._decode(params, self.pool["k"])
+                self.pool = {"k": pool}
+                return loss, self.pool, toks
+        """, "use-after-donate")
+    assert not result.findings, \
+        "\n".join(f.render() for f in result.findings)
+
+
+def test_use_after_donate_near_miss_shadowed_callable(tmp_path):
+    # a parameter or local rebinding named like a module-level
+    # donating jit is a DIFFERENT callable — neither may recruit the
+    # donation table for that body
+    result = _scan_fixture(tmp_path, """\
+        import jax
+
+        step = jax.jit(lambda s, b: s, donate_argnums=(0,))
+
+        def helper(step, state, batch):
+            out = step(state, batch)     # the PARAMETER, not the jit
+            return state, out
+
+        def local(state, batch, fn):
+            step = fn                    # rebound locally
+            out = step(state, batch)
+            return state, out
+        """, "use-after-donate")
+    assert not result.findings, \
+        "\n".join(f.render() for f in result.findings)
+
+
+def test_use_after_donate_positive_local_jit_rebind_still_tracked(
+        tmp_path):
+    # re-registering the same name from a donating jit call inside a
+    # function keeps the tracking alive (it IS the donating callable)
+    result = _scan_fixture(tmp_path, """\
+        import jax
+
+        def train(f, state, batch):
+            step = jax.jit(f, donate_argnums=(0,))
+            out = step(state, batch)
+            return state, out
+        """, "use-after-donate")
+    assert len(result.findings) == 1
+    assert result.findings[0].line == 6
+
+
+def test_use_after_donate_near_miss_local_jit_stays_local(tmp_path):
+    # a function-LOCAL `step = jax.jit(...)` must not recruit
+    # same-named calls in unrelated functions (where `step` resolves
+    # to a module global this rule never saw donate)
+    result = _scan_fixture(tmp_path, """\
+        import jax
+
+        def a(f, s, b):
+            step = jax.jit(f, donate_argnums=(0,))
+            return step(s, b)
+
+        def other(s, b2):
+            out = step(s, b2)    # the module-level non-donating step
+            return s, out
+        """, "use-after-donate")
+    assert not result.findings, \
+        "\n".join(f.render() for f in result.findings)
+
+
+def test_use_after_donate_near_miss_other_classes_attr(tmp_path):
+    # self.attr registrations are per-CLASS: an unrelated class's
+    # same-named NON-donating jitted attr must not be treated as
+    # donating
+    result = _scan_fixture(tmp_path, """\
+        import jax
+
+        class Trainer:
+            def __init__(self, f):
+                self._step = jax.jit(f, donate_argnums=(0,))
+
+        class Evaluator:
+            def __init__(self, f):
+                self._step = jax.jit(f)      # no donation
+
+            def run(self, batch):
+                out = self._step(batch)
+                return batch.mean() + out    # batch is NOT donated
+        """, "use-after-donate")
+    assert not result.findings, \
+        "\n".join(f.render() for f in result.findings)
+
+
+def test_use_after_donate_self_attr_registers_across_methods(tmp_path):
+    # ...but self.attr registration in __init__ must keep covering the
+    # other methods (the engine pattern the rule exists for)
+    result = _scan_fixture(tmp_path, """\
+        import jax
+
+        class Engine:
+            def __init__(self, fn):
+                self._decode = jax.jit(fn, donate_argnums=(1,))
+
+            def step(self, params):
+                toks, pool = self._decode(params, self.pool)
+                return toks, self.pool     # donated above
+        """, "use-after-donate")
+    assert len(result.findings) == 1
+    assert result.findings[0].line == 9
+
+
+def test_use_after_donate_suppression_round_trip(tmp_path):
+    source = """\
+        import jax
+
+        step = jax.jit(lambda s, b: s, donate_argnums=(0,))
+
+        def train(state, batch):
+            out = step(state, batch)
+            return state, out
+        """
+    bare = _scan_fixture(tmp_path, source, "use-after-donate")
+    assert len(bare.findings) == 1
+    silenced = _scan_fixture(tmp_path, source, "use-after-donate",
+                             suppressions="""\
+        # buffer alias audited by hand here
+        use-after-donate pkg/mod.py:return state, out
+        """)
+    assert not silenced.findings
+
+
+# =========================================================================
+# traced-branch
+# =========================================================================
+
+def test_traced_branch_positives(tmp_path):
+    result = _scan_fixture(tmp_path, """\
+        import jax
+        import jax.numpy as jnp
+        from functools import partial
+
+        @jax.jit
+        def decorated(x):
+            if jnp.any(x > 0):
+                x = x + 1
+            return x
+
+        @partial(jax.jit, static_argnums=(1,))
+        def under_partial(x, n):
+            assert jnp.all(jnp.isfinite(x))
+            return x * n
+
+        def scan_body(carry, x):
+            while jnp.max(carry) > 1.0:
+                carry = carry * 0.5
+            return carry, x
+
+        def run(xs):
+            return jax.lax.scan(scan_body, xs[0], xs)
+        """, "traced-branch")
+    lines = sorted(f.line for f in result.findings)
+    assert lines == [7, 13, 17], \
+        "\n".join(f.render() for f in result.findings)
+
+
+def test_traced_branch_near_misses(tmp_path):
+    # static introspection inside traced fns, jnp branches in
+    # UNtraced fns, and jax.tree.map must not recruit its callback
+    result = _scan_fixture(tmp_path, """\
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def static_ok(x):
+            if x.ndim > 2:
+                x = x.reshape(x.shape[0], -1)
+            if jnp.issubdtype(x.dtype, jnp.floating):
+                x = x * 2
+            return x
+
+        def host_side(x):
+            if jnp.any(x > 0):   # eager: a concrete bool, fine
+                return x + 1
+            return x
+
+        def mapper(tree):
+            return jax.tree.map(host_side, tree)
+        """, "traced-branch")
+    assert not result.findings, \
+        "\n".join(f.render() for f in result.findings)
+
+
+def test_traced_branch_near_miss_method_not_recruited_by_name(tmp_path):
+    # `jax.vmap(apply)` on a module-level def can never resolve to a
+    # class METHOD named `apply` — the method's eager branches stay
+    # clean; a scan body nested INSIDE a method is still recruited
+    result = _scan_fixture(tmp_path, """\
+        import jax
+        import jax.numpy as jnp
+
+        def apply(x):
+            return x * 2
+
+        mapped = jax.vmap(apply)
+
+        class Helper:
+            def apply(self, x):
+                if jnp.sum(x) > 0:    # eager method: fine
+                    return x + 1
+                return x
+
+            def run(self, xs):
+                def body(carry, x):
+                    while jnp.max(carry) > 1.0:   # traced: flagged
+                        carry = carry * 0.5
+                    return carry, x
+                return jax.lax.scan(body, xs[0], xs)
+        """, "traced-branch")
+    assert [f.line for f in result.findings] == [17], \
+        "\n".join(f.render() for f in result.findings)
+
+
+def test_traced_branch_near_miss_foreign_jit(tmp_path):
+    # another library's `.jit` (numba et al.) must not mark a def as
+    # jax-traced — jit references are jax's bare/`jax.`-qualified only
+    result = _scan_fixture(tmp_path, """\
+        import numba as nb
+        import jax.numpy as jnp
+
+        @nb.jit
+        def kernel(x):
+            if jnp.any(x > 0):
+                return x + 1
+            return x
+
+        def run(f, x):
+            return nb.jit(f)(x)
+        """, "traced-branch")
+    assert not result.findings, \
+        "\n".join(f.render() for f in result.findings)
+
+
+def test_traced_branch_nested_def_reports_once(tmp_path):
+    # a branch inside a def nested in a traced def belongs to the
+    # nested def alone — the outer walk must not report it a second
+    # time
+    result = _scan_fixture(tmp_path, """\
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def outer(x):
+            def inner(y):
+                if jnp.any(y > 0):
+                    y = y + 1
+                return y
+            return inner(x)
+        """, "traced-branch")
+    assert len(result.findings) == 1, \
+        "\n".join(f.render() for f in result.findings)
+    assert "'inner'" in result.findings[0].message
+
+
+def test_traced_branch_suppression_round_trip(tmp_path):
+    source = """\
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def f(x):
+            assert jnp.all(x > 0)
+            return x
+        """
+    bare = _scan_fixture(tmp_path, source, "traced-branch")
+    assert len(bare.findings) == 1
+    silenced = _scan_fixture(tmp_path, source, "traced-branch",
+                             suppressions="""\
+        # deliberate: fails fast at trace time on bad closure constants
+        traced-branch pkg/mod.py:assert jnp.all(x > 0)
+        """)
+    assert not silenced.findings
+
+
+# =========================================================================
+# config-doc-drift
+# =========================================================================
+
+def _drift_rule(config_rel: str, doc_rel: str) -> ConfigDocDriftRule:
+    rule = ConfigDocDriftRule()
+    rule.config_rel = config_rel
+    rule.doc_rel = doc_rel
+    return rule
+
+
+def _write_drift_fixture(tmp_path: Path, config_src: str, doc_src: str):
+    (tmp_path / "config.py").write_text(textwrap.dedent(config_src))
+    (tmp_path / "config.md").write_text(textwrap.dedent(doc_src))
+    return _drift_rule("config.py", "config.md")
+
+
+def test_config_doc_drift_positive_both_directions(tmp_path):
+    rule = _write_drift_fixture(tmp_path, """\
+        from dataclasses import dataclass
+
+        @dataclass
+        class ServingConfig:
+            page_size: int = 64
+            brand_new_knob: int = 0
+        """, """\
+        Serving docs mention page_size only.
+
+        ```yaml
+        serving:
+          page_size: 64
+          dropped_knob: 1
+        ```
+        """)
+    findings = rule.check_repo(tmp_path)
+    messages = [f.message for f in findings]
+    assert any("brand_new_knob" in m and "not documented" in m
+               for m in messages)
+    assert any("dropped_knob" in m and "no such field" in m
+               for m in messages)
+    assert len(findings) == 2
+    # findings anchor at real lines in each file
+    by_path = {f.path: f for f in findings}
+    assert by_path["config.py"].line == 6
+    assert by_path["config.md"].line == 6
+
+
+def test_config_doc_drift_near_miss_in_sync(tmp_path):
+    # agreeing docs, non-dataclass *Config classes, unparseable fences
+    # (the #include example), and non-block yaml keys all stay silent
+    rule = _write_drift_fixture(tmp_path, """\
+        from dataclasses import dataclass
+
+        @dataclass
+        class ServingConfig:
+            page_size: int = 64
+
+        class HyperParameterConfig:
+            ignored_not_a_dataclass: int = 0
+        """, """\
+        page_size is documented.
+
+        ```yaml
+        serving:
+          page_size: 64
+        my_experiment_key: 3
+        ```
+
+        ```yaml
+        #include base.yml
+        ```
+        """)
+    assert not rule.check_repo(tmp_path)
+
+
+def test_config_doc_drift_positive_other_classes_field_name(tmp_path):
+    """A field name documented for ANOTHER class must not count: the
+    forward check attributes doc content per class (segments mentioning
+    the class/block, or its block's fence keys)."""
+    rule = _write_drift_fixture(tmp_path, """\
+        from dataclasses import dataclass
+
+        @dataclass
+        class ServingConfig:
+            enabled: bool = False
+
+        @dataclass
+        class ObservabilityConfig:
+            enabled: bool = True
+        """, """\
+        ### The `observability:` block
+
+        | field | meaning |
+        |---|---|
+        | `enabled` | master switch |
+
+        ### The `serving:` block
+
+        Nothing documented here yet.
+        """)
+    findings = rule.check_repo(tmp_path)
+    assert len(findings) == 1
+    assert "ServingConfig.enabled" in findings[0].message
+
+
+def test_config_doc_drift_positive_stale_field_table_row(tmp_path):
+    """The reverse check covers markdown field tables too: a row whose
+    field the dataclass dropped is stale doc, same as a dead fence
+    key."""
+    rule = _write_drift_fixture(tmp_path, """\
+        from dataclasses import dataclass
+
+        @dataclass
+        class OptimizerConfig:
+            lr: float = 1e-3
+        """, """\
+        `optim:` (`OptimizerConfig`):
+
+        | field | default | meaning |
+        |---|---|---|
+        | `lr` | `1e-3` | learning rate |
+        | `dampening` | `0.0` | dropped from the dataclass |
+        """)
+    findings = rule.check_repo(tmp_path)
+    assert len(findings) == 1
+    assert "dampening" in findings[0].message
+    assert "stale row" in findings[0].message
+    assert findings[0].line == 6
+
+
+def test_config_doc_drift_suppression_round_trip(tmp_path):
+    rule = _write_drift_fixture(tmp_path, """\
+        from dataclasses import dataclass
+
+        @dataclass
+        class ServingConfig:
+            internal_knob: int = 0
+        """, "No yaml here.\n")
+    sup = tmp_path / "sup.txt"
+    sup.write_text(
+        "# internal-only knob, deliberately undocumented\n"
+        "config-doc-drift config.py:internal_knob: int = 0\n")
+    result = scan([rule], paths=[], repo=tmp_path, suppression_path=sup,
+                  check_stale=True, check_repo=True)
+    assert not result.findings, \
+        "\n".join(f.render() for f in result.findings)
+
+
+def test_config_doc_drift_positive_prose_mention_is_not_documentation(
+        tmp_path):
+    """A field name riding on unrelated prose (common names: warmup,
+    eps, name) must NOT count as documented — only a code-formatted
+    `field` or a yaml-fence `field:` key does."""
+    rule = _write_drift_fixture(tmp_path, """\
+        from dataclasses import dataclass
+
+        @dataclass
+        class SchedulerConfig:
+            warmup: int = 0
+        """, """\
+        The schedule has a warmup phase before the plateau.
+        """)
+    findings = rule.check_repo(tmp_path)
+    assert len(findings) == 1
+    assert "warmup" in findings[0].message
+
+
+def test_explicit_path_scan_skips_repo_wide_rules(tmp_path):
+    """Scanning one named file must never surface cross-file findings
+    in files the caller didn't ask about (mirrors the partial-scan
+    exemption for stale-suppression checks)."""
+    rule = _write_drift_fixture(tmp_path, """\
+        from dataclasses import dataclass
+
+        @dataclass
+        class ServingConfig:
+            undocumented: int = 0
+        """, "No yaml here.\n")
+    target = tmp_path / "other.py"
+    target.write_text("x = 1\n")
+    result = scan([rule], paths=[target], repo=tmp_path,
+                  suppression_path=tmp_path / "absent.txt")
+    assert not result.findings
+    # the default full scan still runs it
+    assert rule.check_repo(tmp_path)
+
+
+def test_config_doc_drift_live_rule_is_anchored_to_real_files():
+    """The registered instance must point at the real config module and
+    doc page — and both must exist (a rename without updating the rule
+    would silently disable both directions)."""
+    rule = RULES_BY_ID["config-doc-drift"]
+    assert (REPO / rule.config_rel).exists()
+    assert (REPO / rule.doc_rel).exists()
+
+
+# =========================================================================
+# suppression machinery: reasons required, stale entries flagged
+# =========================================================================
+
+def test_suppression_without_reason_is_a_finding_and_not_honored(tmp_path):
+    result = _scan_fixture(tmp_path, """\
+        def hot(v):
+            return v.item()
+        """, "host-sync", rel="torchbooster_tpu/utils.py",
+        suppressions="""\
+        host-sync torchbooster_tpu/utils.py:v.item()
+        """)
+    rules_hit = {f.rule for f in result.findings}
+    assert "suppression-format" in rules_hit   # reasonless entry flagged
+    assert "host-sync" in rules_hit            # ...and NOT honored
+
+
+def test_stale_suppression_is_a_finding(tmp_path):
+    result = _scan_fixture(tmp_path, """\
+        x = 1
+        """, "host-sync", rel="torchbooster_tpu/utils.py",
+        suppressions="""\
+        # the code this excused moved on long ago
+        host-sync torchbooster_tpu/utils.py:v.item()
+        """, check_stale=True)
+    assert [f.rule for f in result.findings] == ["stale-suppression"]
+    assert "no longer matches" in result.findings[0].message
+
+
+def test_unparseable_suppression_line_is_a_finding(tmp_path):
+    result = _scan_fixture(tmp_path, "x = 1\n", "host-sync",
+                           suppressions="""\
+        # reason present but the entry has no path:pattern split
+        host-sync just-some-words
+        """)
+    assert [f.rule for f in result.findings] == ["suppression-format"]
+
+
+def test_repo_suppression_files_parse_with_reasons():
+    """Every entry in the LIVE suppression files carries a reason —
+    the written-reason policy is enforced, not aspirational. (Stale
+    entries are covered by the full-scan gate above.)"""
+    from scripts.graftlint.core import SUPPRESSIONS, load_suppressions
+    from scripts.graftlint.rules.host_sync import allowlist_suppressions
+
+    entries, problems = load_suppressions(SUPPRESSIONS)
+    assert not problems, "\n".join(f.render() for f in problems)
+    assert entries, "graftlint suppression file unexpectedly empty"
+    for entry in entries:
+        assert entry.reason
+    assert allowlist_suppressions(), "obs allowlist lift broken"
+
+
+# =========================================================================
+# CLI surface: --json, --explain, --list-rules, --rules, exit codes
+# =========================================================================
+
+def _cli(capsys, *argv: str) -> tuple[int, str]:
+    from scripts.graftlint.cli import main
+
+    rc = main(list(argv))
+    return rc, capsys.readouterr().out
+
+
+def test_cli_json_output_schema(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text("import jax\n"
+                   "def f(g, x):\n"
+                   "    return jax.jit(g)(x)\n")
+    rc, out = _cli(capsys, "--json", str(bad))
+    assert rc == 1
+    doc = json.loads(out)
+    assert doc["version"] == 1 and doc["clean"] is False
+    (finding,) = [f for f in doc["findings"]
+                  if f["rule"] == "recompile-hazard"]
+    assert set(finding) == {"rule", "path", "line", "message", "source"}
+    assert finding["line"] == 3
+
+
+def test_cli_json_clean_package_scan_exits_zero(capsys):
+    rc, out = _cli(capsys, "--json")
+    assert rc == 0
+    doc = json.loads(out)
+    assert doc["clean"] is True and doc["findings"] == []
+    assert doc["n_suppressed"] > 0
+
+
+@pytest.mark.parametrize("rule_id", sorted(RULES_BY_ID))
+def test_cli_explain_every_rule(capsys, rule_id):
+    rc, out = _cli(capsys, "--explain", rule_id)
+    assert rc == 0
+    assert rule_id in out and "Why:" in out
+
+
+def test_cli_explain_unknown_rule_is_usage_error(capsys):
+    rc, _ = _cli(capsys, "--explain", "no-such-rule")
+    assert rc == 2
+
+
+def test_cli_nonexistent_path_is_usage_error(capsys):
+    # a typo'd path must NOT report "clean (0 files)" and exit 0
+    rc, _ = _cli(capsys, "no/such/path.py")
+    assert rc == 2
+
+
+def test_cli_non_python_path_is_usage_error(tmp_path, capsys):
+    # an existing path with nothing to scan is the same silent-clean
+    # hazard as a typo
+    (tmp_path / "notes.md").write_text("hello\n")
+    rc, _ = _cli(capsys, str(tmp_path / "notes.md"))
+    assert rc == 2
+    rc, _ = _cli(capsys, str(tmp_path))
+    assert rc == 2
+
+
+def test_cli_rules_filter_and_list(capsys):
+    rc, out = _cli(capsys, "--list-rules")
+    assert rc == 0
+    for rule in ALL_RULES:
+        assert rule.id in out
+    rc, _ = _cli(capsys, "--rules", "host-sync,no-such-rule")
+    assert rc == 2

@@ -1,8 +1,14 @@
-"""Tier-1 wiring for scripts/obs_lint.py: the package must stay free
-of per-step host-sync smells (.item(), time.time() for durations,
-float(<call>) in step-cadence paths) modulo the documented allowlist —
-a regression here silently kills async-dispatch overlap, which no
-functional test can see."""
+"""Tier-1 wiring for scripts/obs_lint.py — since PR 6 a compatibility
+shim over graftlint's host-sync rule (scripts/graftlint/rules/
+host_sync.py): the package must stay free of per-step host-sync smells
+(.item(), time.time() for durations, float(<call>) in step-cadence
+paths) modulo the documented allowlist — a regression here silently
+kills async-dispatch overlap, which no functional test can see.
+
+These tests deliberately keep loading obs_lint.py BY PATH with its
+historical surface (scan/_Finder/HOT_PATHS/allowed/load_allowlist):
+they are the contract the shim exists to honor. The full multi-rule
+analyzer is covered by tests/test_graftlint.py."""
 from __future__ import annotations
 
 import importlib.util
@@ -90,3 +96,43 @@ def test_allowlist_entries_still_match_something():
         source = (REPO / path).read_text()
         assert pattern in source, (
             f"stale allowlist entry: {path}:{pattern}")
+
+
+def test_shim_agrees_with_graftlint_host_sync_rule():
+    """The shim and the re-homed rule are ONE implementation: the
+    legacy scan()'s findings must equal graftlint's unsuppressed
+    host-sync findings over the package (same files, same allowlist
+    semantics). If the rule and the shim ever fork, this fails."""
+    import sys
+
+    if str(REPO) not in sys.path:
+        sys.path.insert(0, str(REPO))
+    from scripts.graftlint import run_scan
+    from scripts.graftlint.rules import RULES_BY_ID
+
+    legacy = {(r, n, ln) for r, n, _, ln in _load_lint().scan()}
+    result = run_scan(rules=[RULES_BY_ID["host-sync"]])
+    unified = {(f.path, f.line, f.source)
+               for f in result.findings if f.rule == "host-sync"}
+    assert legacy == unified
+
+    # tree-level equality alone is vacuous while the package is clean
+    # (set() == set() tells us nothing about a forked detector) — the
+    # two surfaces must also agree on a SEEDED fixture with known
+    # smells, non-emptily
+    import ast
+
+    from scripts.graftlint.core import FileContext
+
+    source = ("import time\n"
+              "def hot(m, loss_fn, x):\n"
+              "    return m.item(), time.time(), float(loss_fn(x))\n")
+    rel = "torchbooster_tpu/utils.py"   # a HOT path
+    ctx = FileContext(rel, source, ast.parse(source))
+    via_rule = {(f.line, f.message)
+                for f in RULES_BY_ID["host-sync"].check_file(ctx)}
+    finder = _load_lint()._Finder(rel, source.splitlines(), hot=True)
+    finder.visit(ast.parse(source))
+    via_shim = {(ln, smell) for _, ln, smell, _ in finder.findings}
+    assert via_rule == via_shim
+    assert len(via_rule) == 3
