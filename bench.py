@@ -50,6 +50,13 @@ geometry — decode tokens/s ratio, mean accepted draft length,
 accept rate, one-verify-compile proof, token parity;
 BENCH_SPEC_REQUESTS/SLOTS/PAGE/PAGES/SEQ/LAYERS/KV_HEADS/DRAFT/
 NGRAM_MIN/PERIOD/CACHE_DTYPE shape it, BENCH_SKIP_SERVE_SPEC skips);
+the serve_http sub-bench (the serving front door end to end: real
+asyncio HTTP clients streaming SSE from the live ServingFrontend
+over localhost — client-observed p50/p99 TTFT/TPOT per priority
+class, deadline hit + shed rates, greedy-token-parity vs
+jit_generate, zero-recompile proof; BENCH_HTTP_REQUESTS/RATE/SLOTS/
+PAGE/PAGES/SEQ/LAYERS/KV_HEADS/TTFT_MS shape it, BENCH_HTTP_PRIO=1
+adds the SLO-scheduler arm on the same trace);
 the obs sub-bench (telemetry-on vs telemetry-off A/B over the GPT
 step + recompile-sentinel verification; BENCH_SKIP_OBS skips);
 the comms sub-bench (gradient-sync A/B over the GPT step: implicit
@@ -701,6 +708,206 @@ def bench_serve_spec() -> dict:
     return out
 
 
+def bench_serve_http() -> dict:
+    """The serving FRONT DOOR end to end: real asyncio HTTP clients
+    stream SSE completions from a live ``ServingFrontend`` over
+    localhost — the first bench row that measures what a USER sees
+    (client-observed TTFT/TPOT including parse/queue/stream overhead)
+    instead of batcher-internal timings.
+
+    Workload: ``BENCH_HTTP_REQUESTS`` Poisson-arriving requests
+    (``BENCH_HTTP_RATE`` req/s) in TWO priority classes —
+    ``interactive`` (short prompts/outputs, a TTFT deadline of
+    ``BENCH_HTTP_TTFT_MS``) and ``batch`` (page-long prompts, longer
+    outputs, no deadline) — each one a real HTTP connection that
+    POSTs ``/v1/completions`` with ``stream: true`` and times its own
+    SSE events. Geometry mirrors the ``serve`` row (GPT-2 small at
+    ``BENCH_HTTP_SEQ``, paged pool knobs ``BENCH_HTTP_*``).
+
+    Emitted per arm (``fcfs`` always; ``BENCH_HTTP_PRIO=1`` adds the
+    ``slo`` arm on the SAME trace — the A/B the SLO scheduler claim
+    rides on): client p50/p99 TTFT and TPOT per class, the
+    interactive-class deadline hit rate, shed rate, and the
+    zero-recompile sentinel proof (decode+prefill compile counts
+    after concurrent mixed-priority traffic, cancels and shedding
+    included). Plus ``serve_http_token_parity``: a greedy unary HTTP
+    response must be token-exact vs dense ``jit_generate`` for the
+    same prompt — the front door may add scheduling, never change
+    tokens. The headline comparison in prio mode:
+    ``serve_http_prio_ttft_p99_win`` = FCFS/SLO interactive p99 TTFT
+    (> 1 means the SLO arm beat FCFS where it promised to)."""
+    import asyncio
+    import json as _json
+
+    from torchbooster_tpu.models.gpt import GPT, GPTConfig
+    from torchbooster_tpu.serving import ContinuousBatcher, PagedEngine
+    from torchbooster_tpu.serving.frontend import (
+        ServingFrontend, SLOPolicy, FCFSPolicy, parse_classes)
+
+    n_req = int(os.environ.get("BENCH_HTTP_REQUESTS", 24))
+    rate = float(os.environ.get("BENCH_HTTP_RATE", 16.0))
+    slots = int(os.environ.get("BENCH_HTTP_SLOTS", 8))
+    page = int(os.environ.get("BENCH_HTTP_PAGE", 64))
+    n_pages = int(os.environ.get("BENCH_HTTP_PAGES", 96))
+    seq = int(os.environ.get("BENCH_HTTP_SEQ", 2048))
+    n_layers = int(os.environ.get("BENCH_HTTP_LAYERS", 12))
+    kv = int(os.environ.get("BENCH_HTTP_KV_HEADS", 4))
+    ttft_ms = float(os.environ.get("BENCH_HTTP_TTFT_MS", 2000))
+    prio = os.environ.get("BENCH_HTTP_PRIO", "0") == "1"
+    if seq < 4 * page:
+        raise ValueError(
+            f"BENCH_HTTP_SEQ ({seq}) must be >= 4*BENCH_HTTP_PAGE "
+            f"({4 * page}): the batch class prompts span two pages "
+            "and need output room beside them")
+
+    rs = np.random.RandomState(0)
+    arrivals = np.cumsum(rs.exponential(1.0 / rate, n_req))
+    classes_spec = f"interactive:{ttft_ms:g}:0,batch:0:0"
+    workload = []
+    for i in range(n_req):
+        if i % 3 == 0:          # 1/3 interactive, 2/3 batch pressure
+            cls, plen, olen = "interactive", page // 2, 8
+        else:
+            cls, plen, olen = "batch", 2 * page, int(
+                rs.randint(16, min(65, seq - 2 * page)))
+        workload.append({
+            "cls": cls, "arrival": float(arrivals[i]),
+            "prompt": [int(t) for t in rs.randint(0, 50257, plen)],
+            "max_tokens": olen})
+    probe = [int(t) for t in rs.randint(0, 50257, page // 2)]
+    warm = [int(t) for t in rs.randint(0, 50257, 2 * page + 7)]
+
+    async def post(port, payload):
+        reader, writer = await asyncio.open_connection("127.0.0.1",
+                                                       port)
+        body = _json.dumps(payload).encode()
+        writer.write(
+            b"POST /v1/completions HTTP/1.1\r\nHost: b\r\n"
+            b"Content-Length: %d\r\n\r\n" % len(body) + body)
+        await writer.drain()
+        return reader, writer
+
+    async def client(port, item):
+        await asyncio.sleep(item["arrival"])
+        t0 = time.perf_counter()
+        reader, writer = await post(port, {
+            "prompt": item["prompt"], "max_tokens": item["max_tokens"],
+            "stream": True, "priority": item["cls"]})
+        head = await reader.readuntil(b"\r\n\r\n")
+        res = {"cls": item["cls"], "shed": b" 429 " in head,
+               "ttft": None, "tpot": None, "n": 0}
+        if res["shed"]:
+            writer.close()
+            return res
+        t_first = t_last = None
+        n = 0
+        while True:
+            line = await reader.readline()
+            if not line:
+                break
+            line = line.strip()
+            if not line.startswith(b"data: "):
+                continue
+            if line == b"data: [DONE]":
+                break
+            n += len(_json.loads(line[6:])["choices"][0]["token_ids"])
+            t_last = time.perf_counter()
+            if t_first is None:
+                t_first = t_last
+        writer.close()
+        if t_first is not None:
+            res["ttft"] = t_first - t0
+            res["n"] = n
+            if n > 1:
+                res["tpot"] = (t_last - t_first) / (n - 1)
+        return res
+
+    async def unary(port, prompt, max_tokens):
+        reader, writer = await post(port, {
+            "prompt": prompt, "max_tokens": max_tokens,
+            "stream": False})
+        await reader.readuntil(b"\r\n\r\n")
+        data = await reader.read()
+        writer.close()
+        return _json.loads(data)["choices"][0]["token_ids"]
+
+    cfg = GPTConfig(n_layers=n_layers, seq_len=seq, n_kv_heads=kv)
+    params = GPT.init(jax.random.PRNGKey(0), cfg)
+    # decisive head (the test-suite trick): random-init logits sit in
+    # near-ties a bf16 paged-vs-dense summation-order difference can
+    # flip — scaling the tied embeddings widens argmax margins so the
+    # parity bit measures the FRONT DOOR, not float tie-breaking; the
+    # per-step compute/bytes the timing measures are unchanged
+    params = {**params, "wte": {"table": params["wte"]["table"] * 4.0}}
+    want = np.asarray(GPT.generate(
+        params, jnp.asarray(probe, jnp.int32)[None], cfg, n_new=8,
+        temperature=0.0))[0, len(probe):]
+
+    async def drive(policy_name):
+        policy = (SLOPolicy(parse_classes(classes_spec),
+                            default="batch")
+                  if policy_name == "slo" else FCFSPolicy())
+        engine = PagedEngine(params, cfg, page_size=page,
+                             n_pages=n_pages, max_slots=slots)
+        batcher = ContinuousBatcher(engine, policy=policy)
+        fe = ServingFrontend(batcher, port=0, max_queue=4 * n_req)
+        await fe.start()
+        # warm the chunk+decode executables AND the parity probe out
+        # of the measured window (one compile each is legitimate)
+        await unary(fe.port, warm, 2)
+        got = await unary(fe.port, probe, 8)
+        results = await asyncio.gather(
+            *(client(fe.port, item) for item in workload))
+        metrics = await fe.stop()
+        return {"results": results, "metrics": metrics,
+                "parity": got == [int(t) for t in want],
+                "decode_compiles": engine.decode_compiles,
+                "prefill_compiles": engine.prefill_compiles}
+
+    def pct(vals, q):
+        return round(float(np.percentile(vals, q)), 4) if vals else 0.0
+
+    out = {"serve_http_n_requests": n_req,
+           "serve_http_classes": classes_spec}
+    arms = ("fcfs", "slo") if prio else ("fcfs",)
+    for arm in arms:
+        r = asyncio.run(drive(arm))
+        served = [x for x in r["results"] if not x["shed"]]
+        for cls in ("interactive", "batch"):
+            ttfts = [x["ttft"] for x in served
+                     if x["cls"] == cls and x["ttft"] is not None]
+            tpots = [x["tpot"] for x in served
+                     if x["cls"] == cls and x["tpot"] is not None]
+            out[f"serve_http_{arm}_ttft_p50_s_{cls}"] = pct(ttfts, 50)
+            out[f"serve_http_{arm}_ttft_p99_s_{cls}"] = pct(ttfts, 99)
+            out[f"serve_http_{arm}_tpot_p50_s_{cls}"] = pct(tpots, 50)
+            out[f"serve_http_{arm}_tpot_p99_s_{cls}"] = pct(tpots, 99)
+        hits = [x for x in served if x["cls"] == "interactive"
+                and x["ttft"] is not None
+                and x["ttft"] <= ttft_ms / 1e3]
+        n_int = max(sum(1 for x in r["results"]
+                        if x["cls"] == "interactive"), 1)
+        out[f"serve_http_{arm}_deadline_hit_rate"] = round(
+            len(hits) / n_int, 4)
+        out[f"serve_http_{arm}_shed_rate"] = round(
+            sum(1 for x in r["results"] if x["shed"]) / n_req, 4)
+        out[f"serve_http_{arm}_decode_compiles"] = r["decode_compiles"]
+        out[f"serve_http_{arm}_prefill_compiles"] = \
+            r["prefill_compiles"]
+        out[f"serve_http_{arm}_n_shed"] = r["metrics"]["n_shed"]
+        if arm == "fcfs":
+            out["serve_http_token_parity"] = r["parity"]
+    if prio:
+        fcfs = out["serve_http_fcfs_ttft_p99_s_interactive"]
+        slo = out["serve_http_slo_ttft_p99_s_interactive"]
+        # comparable only when the SLO arm actually SERVED the class:
+        # under total overload it may (correctly) shed every
+        # interactive request, and fcfs/0 would print as evidence
+        out["serve_http_prio_ttft_p99_win"] = round(
+            fcfs / slo, 2) if slo > 0 else 0.0
+    return out
+
+
 def bench_obs(steps: int) -> dict:
     """Telemetry overhead A/B: the SAME GPT bench step (bench_gpt
     geometry + knobs) timed with observability disabled, then enabled
@@ -1314,6 +1521,8 @@ def _sub_main(name: str) -> None:
         print(json.dumps(bench_serve_prefix()))
     elif name == "serve_spec":
         print(json.dumps(bench_serve_spec()))
+    elif name == "serve_http":
+        print(json.dumps(bench_serve_http()))
     elif name == "obs":
         print(json.dumps(bench_obs(max(4, steps // 4))))
     elif name == "comms":
@@ -1494,6 +1703,9 @@ def _deadline(name: str, default: int) -> int:
 _SECONDARY_BENCHES = (("gpt", 900), ("gpt_long", 1500), ("loader", 900),
                       ("unet", 900), ("decode", 1500), ("serve", 1800),
                       ("serve_prefix", 1500), ("serve_spec", 1500),
+                      # same budget as its run_ab QUEUE rows: the two
+                      # drivers must not disagree on when to kill it
+                      ("serve_http", 1800),
                       ("obs", 900), ("comms", 900))
 
 

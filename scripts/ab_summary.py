@@ -103,7 +103,8 @@ def main() -> None:
     # render them raw rather than silently dropping recorded evidence
     multi_key = ("decode", "decode_int8", "cifar_acc", "comms",
                  "comms_cpu8", "serve_prefix", "serve_prefix_int8",
-                 "serve_spec", "serve_spec_int8")
+                 "serve_spec", "serve_spec_int8", "serve_http",
+                 "serve_http_prio")
     for name in sorted(attempts):
         if name in METRICS or (name in multi_key and name in latest):
             continue  # multi-key ok rows print below; failures fall through
@@ -171,6 +172,39 @@ def main() -> None:
             print(f"| {arm} "
                   f"| {r.get(f'serve_spec_tok_s_{arm}{sfx}', '—')} "
                   f"| {r.get(f'serve_spec_latency_{arm}_s{sfx}', '—')} |")
+
+    # serve_http rows: the front-door A/B rendered as a per-class SLO
+    # sub-table (client-observed TTFT/TPOT percentiles per arm x
+    # class, deadline hit + shed rates, parity + compile proofs); the
+    # prio row carries both arms and the p99 win headline
+    for name in ("serve_http", "serve_http_prio"):
+        e = latest.get(name)
+        if e is None:
+            continue
+        r = e.get("result") or {}
+        win = r.get("serve_http_prio_ttft_p99_win")
+        print(f"\n{name} (classes {r.get('serve_http_classes', '?')}, "
+              f"token parity {r.get('serve_http_token_parity', '?')}"
+              + (f", SLO interactive p99 TTFT win {win}x vs FCFS"
+                 if win is not None else "") + "):")
+        print("| arm | class | ttft p50/p99 s | tpot p50/p99 s "
+              "| deadline hit | shed rate | decode compiles |")
+        print("|---|---|---|---|---|---|---|")
+        for arm in ("fcfs", "slo"):
+            if f"serve_http_{arm}_deadline_hit_rate" not in r:
+                continue
+            for cls in ("interactive", "batch"):
+                hit = (r.get(f"serve_http_{arm}_deadline_hit_rate", "—")
+                       if cls == "interactive" else "—")
+                print(
+                    f"| {arm} | {cls} "
+                    f"| {r.get(f'serve_http_{arm}_ttft_p50_s_{cls}', '—')}"
+                    f"/{r.get(f'serve_http_{arm}_ttft_p99_s_{cls}', '—')} "
+                    f"| {r.get(f'serve_http_{arm}_tpot_p50_s_{cls}', '—')}"
+                    f"/{r.get(f'serve_http_{arm}_tpot_p99_s_{cls}', '—')} "
+                    f"| {hit} "
+                    f"| {r.get(f'serve_http_{arm}_shed_rate', '—')} "
+                    f"| {r.get(f'serve_http_{arm}_decode_compiles', '—')} |")
 
     # comms rows: bytes-moved + step-time deltas across the gradient
     # sync arms, rendered as a compact sub-table (one row per arm)

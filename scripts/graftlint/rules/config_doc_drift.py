@@ -18,8 +18,8 @@ nothing is imported):
   count: common field names (``warmup``, ``eps``, ``name``) ride on
   unrelated sentences and would void the guarantee;
 - **reverse**: inside every ``\\`\\`\\`yaml`` fence of docs/config.md,
-  the sub-keys of a documented block (``serving:``, ``comms:``,
-  ``observability:``, ``env:``, ``loader:``, ``optim:``,
+  the sub-keys of a documented block (``serving:``, ``frontend:``,
+  ``comms:``, ``observability:``, ``env:``, ``loader:``, ``optim:``,
   ``scheduler:``, ``dataset:``) must each be a real field of the
   corresponding config class; and every row of a markdown field table
   introduced by the ``\\`block:\\` (\\`Class\\`):`` convention must
@@ -40,7 +40,10 @@ RULE_ID = "config-doc-drift"
 CONFIG_REL = "torchbooster_tpu/config.py"
 DOC_REL = "docs/config.md"
 
-# documented YAML block name -> config class
+# documented YAML block name -> config class. "frontend" is the
+# serving.frontend SUB-block — docs/config.md documents it as a
+# standalone fence precisely so this rule checks its keys both ways
+# (a nested fence's sub-sub-keys are invisible to the reverse walk).
 BLOCKS = {
     "env": "EnvConfig",
     "loader": "LoaderConfig",
@@ -48,6 +51,7 @@ BLOCKS = {
     "scheduler": "SchedulerConfig",
     "dataset": "DatasetConfig",
     "serving": "ServingConfig",
+    "frontend": "FrontendConfig",
     "comms": "CommsConfig",
     "observability": "ObservabilityConfig",
 }
@@ -141,9 +145,9 @@ Flags:
   torchbooster_tpu/config.py that never appears in docs/config.md as
   code (backticked, or a yaml-fence key — prose mentions don't count)
   — finding anchored at the field's definition line;
-- reverse: a sub-key of a documented block (`serving:`, `comms:`,
-  `observability:`, `env:`, `loader:`, `optim:`, `scheduler:`,
-  `dataset:`) inside a yaml fence of docs/config.md that is not a
+- reverse: a sub-key of a documented block (`serving:`, `frontend:`,
+  `comms:`, `observability:`, `env:`, `loader:`, `optim:`,
+  `scheduler:`, `dataset:`) inside a yaml fence of docs/config.md that is not a
   field of the corresponding config class, and any field-table row
   (the `block:` (`Class`): convention) naming a dropped field —
   finding anchored at the doc line. Unparseable fences (the

@@ -33,10 +33,13 @@ HOT_PATHS = (
     "torchbooster_tpu/metrics.py",
     "torchbooster_tpu/scheduler.py",
     # the whole serving package is step-cadence: engine decode/prefill,
-    # the batcher loop, AND speculative.py (host-side drafting runs
+    # the batcher loop, speculative.py (host-side drafting runs
     # between every verify dispatch — a stray sync there stalls the
-    # multi-token pipeline exactly like one in the decode loop;
-    # tests/test_obs_lint.py pins the coverage)
+    # multi-token pipeline exactly like one in the decode loop), AND
+    # the frontend/ async scheduler loop (the event loop pumps step()
+    # between dispatches — it must never block on device reads;
+    # deferred registry reads only; tests/test_obs_lint.py pins the
+    # coverage)
     "torchbooster_tpu/serving/",
     "torchbooster_tpu/observability/",
     "torchbooster_tpu/data/pipeline.py",

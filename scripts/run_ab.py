@@ -107,6 +107,17 @@ QUEUE: list[tuple[str, str, dict, int]] = [
     ("serve_spec", "serve_spec", {}, 1800),
     ("serve_spec_int8", "serve_spec",
      {"BENCH_SPEC_CACHE_DTYPE": "int8"}, 1800),
+    # the serving FRONT DOOR (the PR-7 tentpole A/B): real asyncio
+    # HTTP clients streaming SSE from the live server over localhost
+    # — client-observed p50/p99 TTFT/TPOT per priority class,
+    # deadline hit + shed rates, greedy token parity vs jit_generate,
+    # zero-recompile proof under concurrent mixed-priority traffic
+    # (bench.bench_serve_http); the prio row drives the SAME trace
+    # through FCFS and the SLO scheduler — the acceptance target is
+    # serve_http_prio_ttft_p99_win > 1 (the high-priority class's p99
+    # TTFT beats FCFS under contention)
+    ("serve_http", "serve_http", {}, 1800),
+    ("serve_http_prio", "serve_http", {"BENCH_HTTP_PRIO": "1"}, 1800),
     # recipe accuracy on chip (VERDICT r4 #3): the shipped ResNet
     # CIFAR recipe end to end, ref hyperparams, 20 epochs — real
     # CIFAR-10 if a binary release is under the dataset root (none in

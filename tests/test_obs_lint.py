@@ -69,7 +69,12 @@ def test_hot_paths_cover_step_cadence_serving_files():
     for rel in ("torchbooster_tpu/serving/engine.py",
                 "torchbooster_tpu/serving/batcher.py",
                 "torchbooster_tpu/serving/speculative.py",
-                "torchbooster_tpu/serving/kv_pages.py"):
+                "torchbooster_tpu/serving/kv_pages.py",
+                # the front door's async scheduler loop pumps step()
+                # between dispatches — a host sync there stalls the
+                # decode pipeline exactly like one in the batcher
+                "torchbooster_tpu/serving/frontend/server.py",
+                "torchbooster_tpu/serving/frontend/scheduler.py"):
         assert (REPO / rel).exists(), f"{rel} moved without this test"
         assert any(rel.startswith(h) for h in lint.HOT_PATHS), (
             f"{rel} fell out of obs_lint HOT_PATHS")

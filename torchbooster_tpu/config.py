@@ -621,6 +621,73 @@ class SchedulerConfig(BaseConfig):
 
 
 @dataclass
+class FrontendConfig(BaseConfig):
+    """The serving front door (torchbooster_tpu/serving/frontend):
+    scheduler policy + the asyncio OpenAI-compatible HTTP server.
+    Nested under ``serving:`` as its ``frontend:`` sub-block. No
+    reference analogue — this is the request-facing half of the
+    "millions of users" north-star item.
+
+    ``policy`` selects the scheduler: ``fcfs`` (default — byte-for-
+    byte the pre-frontend batcher: strict arrival order, never shed,
+    youngest preemption victim) or ``slo`` (deadline-driven:
+    earliest-slack-first admission over ``classes``, load shedding
+    with HTTP 429 + Retry-After when a TTFT deadline is already
+    unmeetable, preemption victims by re-admission cost — a
+    prefix-cached victim is nearly free to re-seat).
+
+    ``classes`` is the priority-class table as a compact spec string
+    (the mesh-spec idiom): ``"name:ttft_ms:tpot_ms,..."`` in priority
+    order (first = highest), 0 disabling that deadline — e.g.
+    ``"interactive:250:60,batch:5000:0"``. ``default_class`` names
+    the class of requests that don't send one (defaults to the first
+    listed). ``shed_grace`` scales the shed threshold (1.0 = shed
+    exactly when the estimate says the deadline is lost; higher
+    sheds later). ``max_queue`` bounds the HTTP submit queue —
+    beyond it requests get 429 before touching the scheduler.
+
+    The server itself is stdlib asyncio; install the ``[serve]``
+    extra and call ``frontend.server.install_uvloop()`` for the
+    optional event-loop swap. See docs/serving.md for the request
+    lifecycle, API surface, and the backpressure contract.
+    """
+
+    host: str = "127.0.0.1"
+    port: int = 8000                   # 0 = ephemeral (tests/benches)
+    policy: str = "fcfs"               # fcfs | slo
+    classes: str = ""                  # "name:ttft_ms:tpot_ms,..."
+    default_class: str = ""            # "" = first listed class
+    shed_grace: float = 1.0
+    max_queue: int = 64
+
+    def make_policy(self) -> Any:
+        """Build the scheduler policy object the batcher consumes."""
+        from torchbooster_tpu.serving.frontend import (
+            FCFSPolicy, SLOPolicy, parse_classes)
+
+        if self.policy == "fcfs":
+            return FCFSPolicy()
+        if self.policy == "slo":
+            return SLOPolicy(parse_classes(self.classes),
+                             default=self.default_class,
+                             shed_grace=self.shed_grace)
+        raise ValueError(
+            f"frontend.policy must be 'fcfs' or 'slo', got "
+            f"{self.policy!r}")
+
+    def make(self, batcher: Any, codec: Any = None) -> Any:
+        """Build the :class:`~torchbooster_tpu.serving.frontend.
+        ServingFrontend` over an already-built batcher (normally
+        ``ServingConfig.make(...)``, which installs this block's
+        policy). ``await frontend.start()`` binds and serves."""
+        from torchbooster_tpu.serving.frontend import ServingFrontend
+
+        return ServingFrontend(batcher, host=self.host,
+                               port=self.port, codec=codec,
+                               max_queue=self.max_queue)
+
+
+@dataclass
 class ServingConfig(BaseConfig):
     """Serving-engine settings (torchbooster_tpu/serving): the paged
     KV cache's geometry and the sampling knobs of the continuous-
@@ -668,14 +735,19 @@ class ServingConfig(BaseConfig):
     speculative: bool = False          # draft + batched-verify decode
     draft_len: int = 4                 # drafted tokens per verify step
     ngram_min: int = 2                 # shortest prompt-lookup n-gram
+    frontend: FrontendConfig = dataclasses.field(
+        default_factory=FrontendConfig)  # HTTP front door + scheduler
 
     def make(self, params: Any, model_cfg: Any,
              compute_dtype: Any = None,
              on_recompile: str = "warn") -> Any:
         """Build the engine + batcher for ``params``/``model_cfg`` (a
         :class:`~torchbooster_tpu.models.gpt.GPTConfig`). Returns the
-        :class:`~torchbooster_tpu.serving.ContinuousBatcher`; its
-        ``.engine`` exposes admit/step/retire for custom drivers.
+        :class:`~torchbooster_tpu.serving.ContinuousBatcher` — with
+        the ``frontend:`` block's scheduler policy installed (the
+        default is FCFS, byte-for-byte the policy-less batcher); its
+        ``.engine`` exposes admit/step/retire for custom drivers, and
+        ``self.frontend.make(batcher)`` wraps it in the HTTP server.
         ``on_recompile`` is the batcher's runtime-guard policy — pass
         your ``ObservabilityConfig.on_recompile`` so the YAML policy
         reaches the one region the docs advertise as guarded."""
@@ -696,7 +768,8 @@ class ServingConfig(BaseConfig):
             prefill_chunk_pages=self.prefill_chunk_pages,
             speculative=self.speculative,
             draft_len=self.draft_len, ngram_min=self.ngram_min)
-        return ContinuousBatcher(engine, on_recompile=on_recompile)
+        return ContinuousBatcher(engine, on_recompile=on_recompile,
+                                 policy=self.frontend.make_policy())
 
 
 @dataclass

@@ -14,21 +14,36 @@ package stops streaming dead bytes:
   reading the pool once per step and routing shared pages to every
   referencing slot (length-masked pages, online-softmax combine), and
   ONE compiled prefill chunk serving every prompt length;
-- :mod:`batcher` — FCFS admission, one prefill chunk interleaved per
-  decode step, preemption under pool pressure,
-  latency/TTFT/tokens-per-second + prefix-hit + speculation metrics;
+- :mod:`batcher` — the PUMPABLE scheduling core: policy-driven
+  admission (FCFS default), one prefill chunk interleaved per decode
+  step, preemption under pool pressure, thread-safe submit/cancel
+  inboxes, latency/TTFT/tokens-per-second + prefix-hit + speculation
+  (+ per-class SLO) metrics;
 - :mod:`speculative` — draft → batched-verify → accept/rewind decode
   (``speculative: true``): model-free prompt-lookup drafting plus ONE
   compiled multi-token verify step, so each pool read yields
-  ``accepted + 1`` tokens instead of one (greedy-parity-exact).
+  ``accepted + 1`` tokens instead of one (greedy-parity-exact);
+- :mod:`frontend` — the request-facing surface: scheduler policies
+  (:class:`FCFSPolicy`/:class:`SLOPolicy` — priority classes,
+  deadline-driven admission, cost-aware preemption, load shedding)
+  and the stdlib asyncio OpenAI-compatible HTTP/SSE server
+  (:class:`ServingFrontend`) that pumps the batcher from an event
+  loop (docs/serving.md).
 
 Entry points: build a :class:`~torchbooster_tpu.serving.engine.
 PagedEngine` (or via ``ServingConfig.make`` from YAML), wrap it in a
 :class:`~torchbooster_tpu.serving.batcher.ContinuousBatcher`, and feed
-it :class:`~torchbooster_tpu.serving.batcher.Request`s.
+it :class:`~torchbooster_tpu.serving.batcher.Request`s — or serve it
+over HTTP with ``ServingConfig.frontend.make(batcher)``.
 """
 from torchbooster_tpu.serving.batcher import ContinuousBatcher, Request
 from torchbooster_tpu.serving.engine import PagedEngine
+from torchbooster_tpu.serving.frontend import (
+    FCFSPolicy,
+    PriorityClass,
+    SLOPolicy,
+    SchedulerPolicy,
+)
 from torchbooster_tpu.serving.kv_pages import (
     BlockTables,
     NULL_PAGE,
@@ -39,6 +54,17 @@ from torchbooster_tpu.serving.speculative import (
     PromptLookupDrafter,
 )
 
-__all__ = ["BlockTables", "ContinuousBatcher", "NO_DRAFT", "NULL_PAGE",
-           "PagedEngine", "PromptLookupDrafter", "Request",
-           "make_pool"]
+
+def __getattr__(name: str):
+    if name == "ServingFrontend":     # lazy: pulls in the http layer
+        from torchbooster_tpu.serving.frontend import ServingFrontend
+
+        return ServingFrontend
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}")
+
+
+__all__ = ["BlockTables", "ContinuousBatcher", "FCFSPolicy",
+           "NO_DRAFT", "NULL_PAGE", "PagedEngine", "PriorityClass",
+           "PromptLookupDrafter", "Request", "SLOPolicy",
+           "SchedulerPolicy", "ServingFrontend", "make_pool"]

@@ -1,32 +1,56 @@
-"""Host-side continuous batching: FCFS admission over the paged engine.
+"""Host-side continuous batching: policy-driven admission over the
+paged engine.
 
 The reference framework has no serving story at all (DDP training
-only); this is the front door of the serving subsystem. Requests queue
-FCFS; whenever a slot AND enough pages are free, the next ARRIVED
-request is SEATED (its prompt pages allocated, cached prefix pages
-mapped in) and its prefill streams in as fixed-size chunks — the
-scheduling loop issues ONE prefill chunk, then one compiled decode
-step over all live slots, per iteration, so a long arriving prompt
+only); this is the scheduling core of the serving subsystem. Requests
+queue; whenever a slot AND enough pages are free, a request picked by
+the SCHEDULER POLICY is SEATED (its prompt pages allocated, cached
+prefix pages mapped in) and its prefill streams in as fixed-size
+chunks — each scheduling iteration issues ONE prefill chunk, then one
+compiled decode step over all live slots, so a long arriving prompt
 adds at most one chunk of latency between decode steps instead of
 stalling them for its whole prefill. Sequences retire on EOS, on
 their ``max_new_tokens``, or at the ``seq_len`` cache horizon — all
 without touching the compiled steps (kv_pages.py fixed-shape tables).
 
+The per-iteration body lives in :meth:`ContinuousBatcher.step` — a
+PUMPABLE core. :meth:`run` drives it synchronously over a whole
+request trace (the bench/test surface, unchanged); the asyncio front
+door (serving/frontend/server.py) drives the same ``step`` from an
+event loop, feeding it via the thread-safe :meth:`submit` /
+:meth:`cancel` inboxes and streaming the per-step token events back
+to HTTP clients. Cancellation routes through the engine's existing
+abort paths: a queued request just leaves the queue, a mid-prefill
+request hits the pending-slot abort (PR 4), a decoding request
+retires — all page-reclaiming, none recompiling.
+
+WHICH request seats next, which queued requests are SHED (rejected
+with backpressure instead of a guaranteed deadline miss), and which
+seated request is PREEMPTED under pool pressure are delegated to a
+:class:`~torchbooster_tpu.serving.frontend.scheduler.SchedulerPolicy`.
+The default :class:`FCFSPolicy` reproduces the pre-frontend batcher
+exactly (strict arrival order, head-of-line blocking, never shed,
+youngest victim); :class:`SLOPolicy` makes admission deadline-driven
+(earliest slack first over priority classes) and picks victims by
+re-admission cost (a prefix-cached victim is nearly free to re-seat).
+
 Pool pressure is handled by PREEMPTION, not failure: when a growing
 sequence cannot get its next page (even after evicting cached
-prefixes), the youngest seated request — mid-prefill or decoding — is
-pushed back to the FRONT of the queue with its generated tokens
-folded into its prompt (it re-prefills later and keeps going);
-requests too big for the whole pool fail loudly at submit.
+prefixes), the policy's victim — mid-prefill or decoding — is pushed
+back to the FRONT of the queue with its generated tokens folded into
+its prompt (it re-prefills later and keeps going); requests too big
+for the whole pool fail loudly at submit.
 
 Metrics mirror the training A/B machinery's spirit — every number a
 JSON-serializable scalar so serving rows land in the same logs:
 per-request latency (arrival → completion) and time-to-first-token,
 plus aggregate decode tokens/s over the busy window, plus the
-admission/preemption counts, prefill-chunk count, and prefix-cache
-hit stats. Every run also feeds the telemetry registry (``serving_*``
-counters/histograms/gauges — the exporters' view of the same events)
-and is watched by a
+admission/preemption/shed/cancel counts, prefill-chunk count, and
+prefix-cache hit stats; SLO policies add per-class TTFT/TPOT
+percentiles and deadline hit rates (``classes`` sub-dicts). Every run
+also feeds the telemetry registry (``serving_*`` — and, under an SLO
+policy, ``serving_slo_*`` — counters/histograms/gauges, the
+exporters' view of the same events) and is watched by a
 :class:`~torchbooster_tpu.observability.RecompileSentinel`, which
 turns the engine's zero-recompile contract into a runtime guard
 (``on_recompile`` selects ignore/warn/raise).
@@ -34,6 +58,7 @@ turns the engine's zero-recompile contract into a runtime guard
 from __future__ import annotations
 
 import time
+from collections import deque
 from dataclasses import dataclass, field
 
 import numpy as np
@@ -44,22 +69,45 @@ from torchbooster_tpu.observability import (
 )
 from torchbooster_tpu.observability.recompile import POLICIES
 from torchbooster_tpu.serving.engine import PagedEngine
+from torchbooster_tpu.serving.frontend.scheduler import (
+    FCFSPolicy,
+    SchedulerPolicy,
+)
 
 
-@dataclass
+@dataclass(eq=False)
 class Request:
-    """One generation request. ``arrival`` is an offset (seconds) from
+    """One generation request — identity-compared (``eq=False``): the
+    scheduler queues/cancels BY OBJECT, and field equality over numpy
+    prompts is ambiguous anyway. ``arrival`` is an offset (seconds) from
     the batcher's clock start — 0 means "already waiting"; the bench's
-    Poisson trace sets real offsets. ``eos_id=None`` never stops early."""
+    Poisson trace sets real offsets and the HTTP front door stamps
+    submit time. ``eos_id=None`` never stops early.
+
+    SLO fields (all optional — the FCFS path ignores them, so a
+    pre-frontend ``Request(prompt, max_new_tokens, ...)`` construction
+    is untouched): ``priority`` names a configured
+    :class:`~torchbooster_tpu.serving.frontend.scheduler.PriorityClass`
+    ("" = the policy's default class; membership is validated at
+    submit time, where the class table is known), ``deadline_ms``
+    overrides the class TTFT deadline, and ``arrival_time`` is the
+    submitter's wall-clock timestamp (informational — scheduling runs
+    on the batcher clock via ``arrival``)."""
     prompt: np.ndarray
     max_new_tokens: int = 32
     eos_id: int | None = None
     arrival: float = 0.0
+    priority: str = ""
+    deadline_ms: float | None = None
+    arrival_time: float | None = None
     # filled by the batcher
     tokens: list = field(default_factory=list)
     admitted_at: float | None = None
     first_token_at: float | None = None
     finished_at: float | None = None
+    finish_reason: str | None = None
+    shed: bool = False
+    cancelled: bool = False
 
     def __post_init__(self):
         self.prompt = np.asarray(self.prompt, np.int32).reshape(-1)
@@ -68,6 +116,19 @@ class Request:
         if self.max_new_tokens < 1:
             raise ValueError(
                 f"max_new_tokens must be >= 1, got {self.max_new_tokens}")
+        if not isinstance(self.priority, str):
+            raise TypeError(
+                f"priority must be a class NAME (str, '' = policy "
+                f"default), got {type(self.priority).__name__} "
+                f"{self.priority!r}")
+        if self.deadline_ms is not None and self.deadline_ms <= 0:
+            raise ValueError(
+                f"deadline_ms must be > 0 (None = class default), got "
+                f"{self.deadline_ms}")
+        if self.arrival_time is not None and self.arrival_time < 0:
+            raise ValueError(
+                f"arrival_time must be a non-negative timestamp, got "
+                f"{self.arrival_time}")
         # the ORIGINAL prompt length: preemption folds generated tokens
         # into ``prompt`` for the re-prefill, so the true context length
         # is base_len + len(tokens) — counting from the grown prompt
@@ -75,18 +136,78 @@ class Request:
         self.base_len = int(self.prompt.size)
 
 
-class ContinuousBatcher:
-    """FCFS admission queue driving a :class:`PagedEngine`.
+class _Session:
+    """One pumping session's mutable state (a ``run()`` trace, or the
+    whole lifetime of the HTTP front door). Plain attribute bag —
+    every field the old run() closure held, promoted so ``step()``
+    can be driven externally."""
 
-    ``run(requests)`` processes the whole trace and returns a metrics
-    dict; finished requests carry their generated ``tokens`` and
-    timing fields. ``clock`` is injectable for deterministic tests —
-    it MUST advance on its own (the batcher real-sleeps up to 50 ms
-    while idle before an arrival; a frozen clock with a future arrival
-    would wait forever)."""
+    # bounded percentile reservoirs: the front door keeps ONE session
+    # open for the server's whole lifetime, so per-request lists must
+    # not grow with traffic (the registry's _MAX_SAMPLES discipline);
+    # oldest samples drop first, run()-sized traces are unaffected
+    MAX_SAMPLES = 8192
+
+    def __init__(self, batcher: "ContinuousBatcher"):
+        eng = batcher.engine
+        self.queue: list[Request] = []
+        self.live: dict[int, Request] = {}       # decoding
+        self.filling: dict[int, Request] = {}    # seated, prefill streaming
+        self.admit_order: list[int] = []         # oldest-first seated slots
+        self.t0 = batcher.clock()
+        self.decoded = 0
+        self.decode_time = 0.0
+        self.n_admissions = 0
+        self.n_preemptions = 0
+        self.n_shed = 0
+        self.n_cancelled = 0
+        # RUNNING aggregates, not retained Request objects: a
+        # long-lived front-door session must not hold every prompt
+        # array it ever served
+        self.n_seen = 0
+        self.new_tokens = 0
+        self.lat: list[float] = []
+        self.ttft: list[float] = []
+        # per-class SLO accounting (SLO policies only): name ->
+        # {"ttft": [...], "tpot": [...], hit/evaluated counts, n, shed}
+        self.per_class: dict[str, dict] = {}
+        self.hits0 = eng.prefix_hit_pages
+        self.lookups0 = eng.prefix_lookup_pages
+        self.chunks0 = eng.prefill_chunks
+        self.spec_steps0 = eng.spec_steps
+        self.spec_prop0 = eng.spec_proposed
+        self.spec_acc0 = eng.spec_accepted
+        self.closed = False
+
+    def sample(self, series: list[float], value: float) -> None:
+        series.append(value)
+        if len(series) > self.MAX_SAMPLES:
+            del series[:len(series) - self.MAX_SAMPLES]
+
+    @property
+    def has_seated(self) -> bool:
+        return bool(self.live or self.filling)
+
+
+class ContinuousBatcher:
+    """Policy-driven admission queue driving a :class:`PagedEngine`.
+
+    ``run(requests)`` processes a whole trace synchronously and
+    returns a metrics dict; finished requests carry their generated
+    ``tokens`` and timing fields. For an external driver (the asyncio
+    HTTP front door), ``start_session()`` / ``step()`` /
+    ``finish_session()`` expose the same loop one iteration at a
+    time, with ``submit``/``cancel`` as thread-safe inboxes the next
+    ``step()`` drains. ``policy`` is the scheduler
+    (:class:`FCFSPolicy` default — behavior and metric values
+    identical to the pre-frontend batcher). ``clock`` is injectable
+    for deterministic tests — it MUST advance on its own (the batcher
+    real-sleeps up to 50 ms while idle before an arrival; a frozen
+    clock with a future arrival would wait forever)."""
 
     def __init__(self, engine: PagedEngine, clock=time.perf_counter,
-                 on_recompile: str = "warn"):
+                 on_recompile: str = "warn",
+                 policy: SchedulerPolicy | None = None):
         # the zero-recompile contract as a RUNTIME guard, not just a
         # test assert: every run() watches the decode jit cache
         # (observability/recompile.py); policy ignore | warn | raise —
@@ -96,12 +217,30 @@ class ContinuousBatcher:
             raise ValueError(
                 f"on_recompile={on_recompile!r}: expected one of "
                 f"{POLICIES}")
+        if policy is not None and not isinstance(policy, SchedulerPolicy):
+            raise TypeError(
+                f"policy must be a SchedulerPolicy (frontend."
+                f"scheduler), got {type(policy).__name__}")
         self.on_recompile = on_recompile
+        self.policy = policy if policy is not None else FCFSPolicy()
         self.engine = engine
         self.clock = clock
         # usable pool capacity in tokens (page 0 is the reserved null)
         self._capacity = (engine.n_pages - 1) * engine.page_size
+        # EWMA service-time estimates (host perf_counter deltas) the
+        # SLO policy's slack math consumes; zero until measured, so a
+        # cold batcher never sheds on a guess
+        self.est_chunk_s = 0.0
+        self.est_step_s = 0.0
+        self._s: _Session | None = None
+        self._sentinel: RecompileSentinel | None = None
+        self._inst: dict | None = None
+        # thread-safe inboxes (deque appends are atomic): the event
+        # loop submits/cancels while step() runs on the pump thread
+        self._inbox_submit: deque[Request] = deque()
+        self._inbox_cancel: deque[Request] = deque()
 
+    # ---- capacity & estimates ------------------------------------
     def _check_fits(self, req: Request) -> None:
         worst = req.base_len + req.max_new_tokens
         if worst > self.engine.cfg.seq_len:
@@ -129,23 +268,114 @@ class ContinuousBatcher:
                 + f"but the pool holds {self._capacity}; grow "
                 f"serving.n_pages")
 
-    def run(self, requests: list[Request]) -> dict:
-        if not requests:
-            return {"n_requests": 0, "new_tokens": 0, "elapsed_s": 0.0,
-                    "decode_tok_s": 0.0, "total_tok_s": 0.0,
-                    "latency_mean_s": 0.0, "latency_p95_s": 0.0,
-                    "ttft_mean_s": 0.0,
-                    # stable key set: the preemption/admission/prefill
-                    # /speculation stats exist on EVERY return path,
-                    # not just busy ones
-                    "n_admissions": 0, "n_preemptions": 0,
-                    "n_prefill_chunks": 0, "prefix_hit_pages": 0,
-                    "prefix_hit_rate": 0.0,
-                    "n_spec_steps": 0, "n_spec_proposed": 0,
-                    "n_spec_accepted": 0, "spec_accept_rate": 0.0,
-                    "spec_mean_accepted": 0.0}
-        for r in requests:
-            self._check_fits(r)
+    def est_ttft_s(self, req: Request) -> float:
+        """Estimated seconds from now to ``req``'s first token were it
+        seated next: its own prefill chunks plus the chunks already
+        queued ahead of it, at the measured EWMA chunk time, plus one
+        decode step. Prefix-cache hits only ever shorten it (the
+        estimate skips the index walk — too hot for per-step use)."""
+        # len(prompt), not base_len: preemption folds generated tokens
+        # into the prompt, and the re-prefill pays for all of them
+        chunks = -(-len(req.prompt) // self.engine.chunk_tokens)
+        ahead = self.engine.pending_chunk_count
+        return (chunks + ahead) * self.est_chunk_s + self.est_step_s
+
+    def readmission_cost(self, req: Request) -> int:
+        """Tokens a preemption victim would re-prefill on re-seat:
+        its full folded context net of the prompt pages the prefix
+        cache would map straight back. A mid-decode slot whose prompt
+        pages are all registered is nearly free to evict; a cold
+        long-prompt slot is the expensive victim."""
+        folded = len(req.prompt) - req.base_len
+        ctx = np.concatenate(
+            [req.prompt, np.asarray(req.tokens[folded:], np.int32)])
+        matched = self.engine.tables.match_pages(ctx)
+        return len(ctx) - len(matched) * self.engine.page_size
+
+    @property
+    def occupancy(self) -> float:
+        """Fraction of usable pool pages not immediately allocatable
+        (free AND evictable-cached both count as available)."""
+        avail = self.engine.tables.n_available_pages
+        return 1.0 - avail / max(self.engine.n_pages - 1, 1)
+
+    @property
+    def queue_depth(self) -> int:
+        s = self._s
+        return (len(self._inbox_submit)
+                + (len(s.queue) if s is not None else 0))
+
+    @property
+    def has_work(self) -> bool:
+        s = self._s
+        return s is not None and bool(
+            s.queue or s.live or s.filling
+            or self._inbox_submit or self._inbox_cancel)
+
+    # ---- external driver surface ---------------------------------
+    def submit(self, req: Request, arrival: float | None = None) -> None:
+        """Thread-safe enqueue for an externally-driven session: the
+        request joins the scheduling queue at the next :meth:`step`.
+        Raises (in the caller) when the request can never fit the pool
+        or its priority class is unknown to the policy — the front
+        door maps that to HTTP 400 before any pages move."""
+        if self._s is None:
+            raise RuntimeError(
+                "no active session: start_session() first (run() "
+                "manages its own)")
+        self._check_fits(req)
+        self.policy.validate(req)
+        req.arrival = self.session_now() if arrival is None else arrival
+        self._inbox_submit.append(req)
+
+    def cancel(self, req: Request) -> None:
+        """Thread-safe cancellation: at the next :meth:`step` the
+        request leaves the queue, or — if seated — its slot retires
+        through the engine's abort paths (mid-prefill pending-slot
+        abort, mid-decode/mid-spec retire), reclaiming every page
+        without touching the compiled steps. Unknown/finished
+        requests are ignored (cancel races completion benignly)."""
+        self._inbox_cancel.append(req)
+
+    def session_now(self) -> float:
+        """Seconds since the active session started (the ``arrival``
+        clock)."""
+        if self._s is None:
+            raise RuntimeError("no active session")
+        return self.clock() - self._s.t0
+
+    def start_session(self) -> None:
+        """Open a pumpable session (the front door's whole lifetime):
+        sets up instruments and the recompile sentinel, and cancels
+        stale mid-prefill slots a crashed driver may have left."""
+        s = self._begin()
+        self._sentinel.__enter__()
+        self._s = s
+
+    def finish_session(self) -> dict:
+        """Close the pumpable session: closes the sentinel watch
+        (firing its policy), lands gauges/counters, and returns the
+        same metrics dict :meth:`run` does."""
+        if self._s is None:
+            raise RuntimeError("no active session")
+        s = self._s
+        try:
+            self._sentinel.__exit__(None, None, None)
+        finally:
+            self._land(s)
+        return self._metrics(s)
+
+    # ---- session internals ---------------------------------------
+    def _begin(self) -> _Session:
+        if self._s is not None:
+            raise RuntimeError(
+                "a session is already active on this batcher")
+        # stale inbox entries belong to a DEAD session (a crashed pump
+        # left them undrained): replaying them into a fresh trace
+        # would seat unrelated dead-client requests and pollute its
+        # metrics
+        self._inbox_submit.clear()
+        self._inbox_cancel.clear()
         # a previous run that aborted mid-loop (engine error,
         # KeyboardInterrupt) can leave the engine holding
         # half-prefilled slots — cross-run state chunked prefill
@@ -156,77 +386,84 @@ class ContinuousBatcher:
         for slot in self.engine.pending_slots:
             self.engine.retire(slot)
         reg = get_registry()
-        lat_hist = reg.histogram("serving_latency_seconds",
-                                 "request arrival -> completion")
-        ttft_hist = reg.histogram("serving_ttft_seconds",
-                                  "request arrival -> first token")
-        slots_gauge = reg.gauge("serving_slots_live",
-                                "occupied decode slots")
-        pages_gauge = reg.gauge("serving_pages_free",
-                                "free KV pages in the pool")
-        admissions = reg.counter("serving_admissions_total",
-                                 "requests seated (re-admissions count)")
-        preemptions = reg.counter("serving_preemptions_total",
-                                  "youngest-victim preemptions")
-        retired = reg.counter("serving_retired_total",
-                              "sequences retired (EOS/max/horizon)")
-        tokens_ctr = reg.counter("serving_decode_tokens_total",
-                                 "tokens produced by decode steps")
-        hit_pages_ctr = reg.counter(
-            "serving_prefix_hit_pages_total",
-            "prompt pages served from the prefix cache")
-        chunks_ctr = reg.counter("serving_prefill_chunks_total",
-                                 "prefill chunks issued")
-        hit_rate_gauge = reg.gauge(
-            "serving_prefix_hit_rate",
-            "prefix-cache page hit rate over this run")
-        spec_prop_ctr = reg.counter(
-            "serving_spec_proposed_total",
-            "draft tokens proposed to the speculative verify step")
-        spec_acc_ctr = reg.counter(
-            "serving_spec_accepted_total",
-            "draft tokens the verify step accepted")
-        spec_rate_gauge = reg.gauge(
-            "serving_spec_accept_rate",
-            "accepted/proposed draft tokens over this run")
-        queue = sorted(requests, key=lambda r: r.arrival)
-        live: dict[int, Request] = {}        # decoding
-        filling: dict[int, Request] = {}     # seated, prefill streaming
-        admit_order: list[int] = []          # oldest-first seated slots
-        t0 = self.clock()
-        now = lambda: self.clock() - t0
-        decoded = 0
-        decode_time = 0.0
-        n_admissions = 0
-        n_preemptions = 0
-        hits0 = self.engine.prefix_hit_pages
-        lookups0 = self.engine.prefix_lookup_pages
-        chunks0 = self.engine.prefill_chunks
-        spec_steps0 = self.engine.spec_steps
-        spec_prop0 = self.engine.spec_proposed
-        spec_acc0 = self.engine.spec_accepted
-
-        def finish(slot: int) -> None:
-            req = live.pop(slot)
-            admit_order.remove(slot)
-            req.finished_at = now()
-            retired.inc()
-            lat_hist.observe(req.finished_at - req.arrival)
-            if req.first_token_at is not None:
-                ttft_hist.observe(req.first_token_at - req.arrival)
-            self.engine.retire(slot)
-
-        def maybe_stop(slot: int, token: int) -> None:
-            req = live[slot]
-            req.tokens.append(int(token))
-            if req.first_token_at is None:
-                req.first_token_at = now()
-            hit_eos = req.eos_id is not None and token == req.eos_id
-            full = (req.base_len + len(req.tokens)
-                    >= self.engine.cfg.seq_len)
-            if hit_eos or len(req.tokens) >= req.max_new_tokens or full:
-                finish(slot)
-
+        inst = {
+            "lat": reg.histogram("serving_latency_seconds",
+                                 "request arrival -> completion"),
+            "ttft": reg.histogram("serving_ttft_seconds",
+                                  "request arrival -> first token"),
+            "slots": reg.gauge("serving_slots_live",
+                               "occupied decode slots"),
+            "pages": reg.gauge("serving_pages_free",
+                               "free KV pages in the pool"),
+            "admissions": reg.counter(
+                "serving_admissions_total",
+                "requests seated (re-admissions count)"),
+            "preemptions": reg.counter(
+                "serving_preemptions_total",
+                "scheduler-victim preemptions"),
+            "retired": reg.counter(
+                "serving_retired_total",
+                "sequences retired (EOS/max/horizon)"),
+            "tokens": reg.counter("serving_decode_tokens_total",
+                                  "tokens produced by decode steps"),
+            "hit_pages": reg.counter(
+                "serving_prefix_hit_pages_total",
+                "prompt pages served from the prefix cache"),
+            "chunks": reg.counter("serving_prefill_chunks_total",
+                                  "prefill chunks issued"),
+            "hit_rate": reg.gauge(
+                "serving_prefix_hit_rate",
+                "prefix-cache page hit rate over this run"),
+            "spec_prop": reg.counter(
+                "serving_spec_proposed_total",
+                "draft tokens proposed to the speculative verify step"),
+            "spec_acc": reg.counter(
+                "serving_spec_accepted_total",
+                "draft tokens the verify step accepted"),
+            "spec_rate": reg.gauge(
+                "serving_spec_accept_rate",
+                "accepted/proposed draft tokens over this run"),
+        }
+        if self.policy.slo:
+            # per-class SLO families (absent entirely under FCFS so
+            # the cold path's registry view is untouched); every
+            # observation is a host perf_counter delta — deferred
+            # registry reads, never a device sync
+            inst.update({
+                "slo_ttft": reg.histogram(
+                    "serving_slo_ttft_seconds",
+                    "per-class arrival -> first token"),
+                "slo_tpot": reg.histogram(
+                    "serving_slo_tpot_seconds",
+                    "per-class mean inter-token time"),
+                "slo_shed": reg.counter(
+                    "serving_slo_shed_total",
+                    "requests shed by the SLO policy (per class)"),
+                "slo_cancel": reg.counter(
+                    "serving_slo_cancelled_total",
+                    "requests cancelled by the client (per class)"),
+                "slo_hit": reg.counter(
+                    "serving_slo_deadline_hit_total",
+                    "deadline hits (per class, kind=ttft|tpot)"),
+                "slo_miss": reg.counter(
+                    "serving_slo_deadline_miss_total",
+                    "deadline misses (per class, kind=ttft|tpot)"),
+                "slo_ttft_rate": reg.gauge(
+                    "serving_slo_ttft_hit_rate",
+                    "TTFT deadline hit rate over this run (per class)"),
+                "slo_tpot_rate": reg.gauge(
+                    "serving_slo_tpot_hit_rate",
+                    "TPOT deadline hit rate over this run (per class)"),
+            })
+        self._inst = inst
+        s = _Session(self)
+        if self.policy.slo:
+            for name in self.policy.classes:
+                s.per_class[name] = {
+                    "n": 0, "completed": 0, "shed": 0,
+                    "ttft": [], "tpot": [],
+                    "ttft_hit": 0, "ttft_n": 0,
+                    "tpot_hit": 0, "tpot_n": 0}
         # expected compiles in the watched region: the decode (or, in
         # speculative mode, verify) step's very first compile is
         # legitimate; anything after is a broken geometry contract
@@ -235,166 +472,439 @@ class ContinuousBatcher:
         # never-used decode step either.
         step_compiles = lambda: (self.engine.decode_compiles
                                  + self.engine.verify_compiles)
-        sentinel = RecompileSentinel(
+        self._sentinel = RecompileSentinel(
             step_compiles,
             on_recompile=self.on_recompile,
             expected=0 if step_compiles() else 1,
             name="serving_decode", registry=reg)
-        try:
-            # `with sentinel` (not manual enter/exit): an exception
-            # escaping the loop still closes the watch — the policy
-            # only fires on clean exits by design
-            with sentinel:
-                while queue or live or filling:
-                    # --- seat every ARRIVED request that fits, FCFS;
-                    # cached prefix pages map in here, so a hit's
-                    # remaining prefill is only its private tail ---
-                    while queue and queue[0].arrival <= now():
-                        req = queue[0]
-                        slot = self.engine.admit_begin(req.prompt)
-                        if slot is None:
-                            break         # no slot/pages: keep FCFS
-                        queue.pop(0)
-                        filling[slot] = req
-                        admit_order.append(slot)
-                        n_admissions += 1
-                        admissions.inc()
-                        if req.admitted_at is None:
-                            req.admitted_at = now()
-                    # --- ONE prefill chunk per iteration, interleaved
-                    # with decode: long prompts stream in while the
-                    # live slots keep producing tokens ---
-                    if self.engine.has_pending:
-                        done = self.engine.prefill_step()
-                        if done is not None:
-                            slot, first = done
-                            live[slot] = filling.pop(slot)
-                            maybe_stop(slot, first)  # prefill's token
-                    slots_gauge.set(len(live))
-                    pages_gauge.set(self.engine.tables.n_free_pages)
-                    if not live:
-                        if not filling and queue:
-                            # idle until the next arrival
-                            wait = queue[0].arrival - now()
-                            if wait > 0:
-                                time.sleep(min(wait, 0.05))
-                        continue
-                    # --- grow: every live slot's next write page must
-                    # exist (cached prefixes evict first); starved
-                    # slots preempt the YOUNGEST seated request ---
-                    starved = self.engine.grow_slots()
-                    while starved:
-                        victim = admit_order[-1]
-                        req = (live.pop(victim) if victim in live
-                               else filling.pop(victim))
-                        admit_order.remove(victim)
-                        self.engine.retire(victim)
-                        # fold generated tokens into the prompt so it
-                        # resumes from its full context on re-admission
-                        # — only the NOT-yet-folded suffix: a second
-                        # preemption would otherwise re-append tokens
-                        # already in the prompt, duplicating context
-                        # (prompt always holds base_len + folded
-                        # tokens, so the folded count is its excess;
-                        # a mid-prefill victim has no tokens and folds
-                        # nothing)
-                        folded = len(req.prompt) - req.base_len
-                        req.prompt = np.concatenate(
-                            [req.prompt,
-                             np.asarray(req.tokens[folded:], np.int32)])
-                        queue.insert(0, req)
-                        n_preemptions += 1
-                        preemptions.inc()
-                        starved = self.engine.grow_slots() if live \
-                            else []
-                    if not live:
-                        continue
-                    # --- one compiled step over every live slot ---
-                    t_step = self.clock()
-                    if self.engine.speculative:
-                        # draft → batched verify → accept: each slot
-                        # emits 1..draft_len+1 tokens per step; stop
-                        # checks run per token IN ORDER, so EOS or
-                        # max_new_tokens mid-burst truncates exactly
-                        # where sequential decode would have stopped
-                        emitted = self.engine.spec_step()
-                        decode_time += self.clock() - t_step
-                        # count DELIVERED tokens only: a burst tail
-                        # past EOS/max_new_tokens never reaches
-                        # req.tokens, and counting it would inflate
-                        # decode_tok_s vs the non-speculative arm
-                        # (whose every counted token is appended)
-                        delivered = 0
-                        for slot in sorted(emitted):
-                            for tok in emitted[slot]:
-                                if slot not in live:
-                                    break
-                                delivered += 1
-                                maybe_stop(slot, int(tok))
-                        decoded += delivered
-                        tokens_ctr.inc(delivered)
-                    else:
-                        tokens = self.engine.step()
-                        decode_time += self.clock() - t_step
-                        decoded += len(live)
-                        tokens_ctr.inc(len(live))
-                        for slot in list(live):
-                            maybe_stop(slot, int(tokens[slot]))
-        finally:
-            # exception or not, the gauges land on engine truth at
-            # exit (an aborted run may leave seated slots — report
-            # them rather than freezing a stale mid-loop value in the
-            # Prometheus export forever); clean exits read 0 live
-            slots_gauge.set(len(live))
-            pages_gauge.set(self.engine.tables.n_free_pages)
-            hit_pages = self.engine.prefix_hit_pages - hits0
-            lookups = self.engine.prefix_lookup_pages - lookups0
-            n_chunks = self.engine.prefill_chunks - chunks0
-            n_spec_steps = self.engine.spec_steps - spec_steps0
-            n_spec_prop = self.engine.spec_proposed - spec_prop0
-            n_spec_acc = self.engine.spec_accepted - spec_acc0
-            hit_pages_ctr.inc(hit_pages)
-            chunks_ctr.inc(n_chunks)
-            hit_rate_gauge.set(hit_pages / max(lookups, 1))
-            spec_prop_ctr.inc(n_spec_prop)
-            spec_acc_ctr.inc(n_spec_acc)
-            spec_rate_gauge.set(n_spec_acc / max(n_spec_prop, 1))
+        return s
 
-        elapsed = now()
-        lat = [r.finished_at - r.arrival for r in requests]
-        ttft = [r.first_token_at - r.arrival for r in requests]
-        new_tokens = sum(len(r.tokens) for r in requests)
+    def _class_stats(self, req: Request) -> dict | None:
+        if not self.policy.slo:
+            return None
+        name = self.policy.cls_of(req).name
+        return self._s.per_class[name]
+
+    def _finish_request(self, slot: int) -> None:
+        s, inst = self._s, self._inst
+        req = s.live.pop(slot)
+        s.admit_order.remove(slot)
+        req.finished_at = self.clock() - s.t0
+        inst["retired"].inc()
+        s.new_tokens += len(req.tokens)
+        s.sample(s.lat, req.finished_at - req.arrival)
+        inst["lat"].observe(req.finished_at - req.arrival)
+        if req.first_token_at is not None:
+            s.sample(s.ttft, req.first_token_at - req.arrival)
+            inst["ttft"].observe(req.first_token_at - req.arrival)
+        self.engine.retire(slot)
+        cs = self._class_stats(req)
+        if cs is None:
+            return
+        cls = self.policy.cls_of(req)
+        cs["completed"] += 1
+        ttft = req.first_token_at - req.arrival
+        s.sample(cs["ttft"], ttft)
+        inst["slo_ttft"].observe(ttft, cls=cls.name)
+        if len(req.tokens) > 1:
+            tpot = (req.finished_at - req.first_token_at) \
+                / (len(req.tokens) - 1)
+            s.sample(cs["tpot"], tpot)
+            inst["slo_tpot"].observe(tpot, cls=cls.name)
+        else:
+            tpot = None
+        deadline = self.policy.ttft_deadline_s(req)
+        if deadline is not None:
+            hit = ttft <= deadline
+            cs["ttft_n"] += 1
+            cs["ttft_hit"] += int(hit)
+            inst["slo_hit" if hit else "slo_miss"].inc(
+                cls=cls.name, kind="ttft")
+        tpot_target = self.policy.tpot_deadline_s(req)
+        if tpot_target is not None and tpot is not None:
+            hit = tpot <= tpot_target
+            cs["tpot_n"] += 1
+            cs["tpot_hit"] += int(hit)
+            inst["slo_hit" if hit else "slo_miss"].inc(
+                cls=cls.name, kind="tpot")
+
+    def _maybe_stop(self, slot: int, token: int) -> None:
+        s = self._s
+        req = s.live[slot]
+        req.tokens.append(int(token))
+        if req.first_token_at is None:
+            req.first_token_at = self.clock() - s.t0
+        hit_eos = req.eos_id is not None and token == req.eos_id
+        full = (req.base_len + len(req.tokens)
+                >= self.engine.cfg.seq_len)
+        if hit_eos or len(req.tokens) >= req.max_new_tokens or full:
+            req.finish_reason = "stop" if hit_eos else "length"
+            self._finish_request(slot)
+
+    def _cancel_request(self, req: Request, events: list) -> None:
+        s = self._s
+        req.cancelled = True
+        req.finished_at = self.clock() - s.t0
+        req.finish_reason = "cancelled"
+        s.n_cancelled += 1
+        s.new_tokens += len(req.tokens)  # delivered before the cancel
+        events.append((req, []))
+        cs = self._class_stats(req)
+        if cs is not None:
+            self._inst["slo_cancel"].inc(
+                cls=self.policy.cls_of(req).name)
+
+    def _drain_cancels(self, events: list) -> None:
+        s = self._s
+        while self._inbox_cancel:
+            req = self._inbox_cancel.popleft()
+            if req.finished_at is not None:
+                continue                      # raced completion: done
+            if any(req is q for q in s.queue):
+                s.queue.remove(req)
+                self._cancel_request(req, events)
+                continue
+            for table in (s.filling, s.live):
+                slot = next((sl for sl, r in table.items()
+                             if r is req), None)
+                if slot is not None:
+                    # the engine abort paths: retire() cancels an
+                    # in-flight chunked prefill (PR 4 pending-slot
+                    # abort) and reclaims the slot's pages either way
+                    table.pop(slot)
+                    s.admit_order.remove(slot)
+                    self.engine.retire(slot)
+                    self._cancel_request(req, events)
+                    break
+
+    def _shed_request(self, req: Request, events: list) -> None:
+        s = self._s
+        req.shed = True
+        req.finished_at = self.clock() - s.t0
+        req.finish_reason = "shed"
+        s.n_shed += 1
+        events.append((req, []))
+        cs = self._class_stats(req)
+        if cs is not None:
+            cs["shed"] += 1
+            self._inst["slo_shed"].inc(
+                cls=self.policy.cls_of(req).name)
+
+    def step(self) -> list[tuple[Request, list[int]]]:
+        """ONE scheduling iteration — the old run() loop body, now
+        drivable from outside: drain the submit/cancel inboxes, shed
+        (policy), seat admissible requests (policy order), issue one
+        prefill chunk, grow/preempt (policy victim), then one
+        compiled decode (or speculative verify) step.
+
+        Returns this iteration's token events — ordered ``(request,
+        tokens)`` pairs: one per delivered token (a whole accepted
+        spec burst is one event; shed/cancelled requests appear once
+        with no tokens) — which the async front door streams out as
+        SSE. ``run()`` ignores them (requests accumulate their own
+        ``tokens``)."""
+        if self._s is None:
+            raise RuntimeError(
+                "no active session: start_session() first (run() "
+                "manages its own)")
+        s = self._s
+        now = lambda: self.clock() - s.t0
+        events: list = []
+        # submits drain BEFORE cancels: a request submitted and then
+        # cancelled between two steps must be found in the queue
+        while self._inbox_submit:
+            req = self._inbox_submit.popleft()
+            s.n_seen += 1
+            s.queue.append(req)
+            cs = self._class_stats(req)
+            if cs is not None:
+                cs["n"] += 1
+        self._drain_cancels(events)
+        # --- shed: the policy's "this deadline is already lost"
+        # verdict turns into immediate backpressure (FCFS: never) ---
+        for req in self.policy.shed(s.queue, now(), self):
+            s.queue.remove(req)
+            self._shed_request(req, events)
+        # --- seat every admissible request the policy picks; cached
+        # prefix pages map in here, so a hit's remaining prefill is
+        # only its private tail. FCFS stops at the first failed seat
+        # (head-of-line, strict arrival order); SLO keeps trying
+        # other candidates ---
+        tried: set[int] = set()
+        while True:
+            pool = [r for r in s.queue if id(r) not in tried]
+            req = self.policy.next_admission(pool, now(), self)
+            if req is None:
+                break
+            slot = self.engine.admit_begin(req.prompt)
+            if slot is None:
+                if self.policy.stop_on_admit_failure:
+                    break         # no slot/pages: keep FCFS order
+                tried.add(id(req))
+                continue
+            s.queue.remove(req)
+            s.filling[slot] = req
+            s.admit_order.append(slot)
+            s.n_admissions += 1
+            self._inst["admissions"].inc()
+            if req.admitted_at is None:
+                req.admitted_at = now()
+        # --- ONE prefill chunk per iteration, interleaved with
+        # decode: long prompts stream in while the live slots keep
+        # producing tokens ---
+        if self.engine.has_pending:
+            t_chunk = self.clock()
+            done = self.engine.prefill_step()
+            dt = self.clock() - t_chunk
+            self.est_chunk_s = dt if not self.est_chunk_s \
+                else 0.8 * self.est_chunk_s + 0.2 * dt
+            if done is not None:
+                slot, first = done
+                req = s.filling.pop(slot)
+                s.live[slot] = req
+                self._maybe_stop(slot, first)  # prefill's token
+                events.append((req, [int(first)]))
+        self._inst["slots"].set(len(s.live))
+        self._inst["pages"].set(self.engine.tables.n_free_pages)
+        if not s.live:
+            return events
+        # --- grow: every live slot's next write page must exist
+        # (cached prefixes evict first); starved slots preempt the
+        # POLICY's victim (FCFS: youngest seated) ---
+        starved = self.engine.grow_slots()
+        while starved:
+            seated = {**s.filling, **s.live}
+            victim = self.policy.select_victim(
+                s.admit_order, seated, self)
+            req = (s.live.pop(victim) if victim in s.live
+                   else s.filling.pop(victim))
+            s.admit_order.remove(victim)
+            self.engine.retire(victim)
+            # fold generated tokens into the prompt so it resumes
+            # from its full context on re-admission — only the
+            # NOT-yet-folded suffix: a second preemption would
+            # otherwise re-append tokens already in the prompt,
+            # duplicating context (prompt always holds base_len +
+            # folded tokens, so the folded count is its excess; a
+            # mid-prefill victim has no tokens and folds nothing)
+            folded = len(req.prompt) - req.base_len
+            req.prompt = np.concatenate(
+                [req.prompt,
+                 np.asarray(req.tokens[folded:], np.int32)])
+            s.queue.insert(0, req)
+            s.n_preemptions += 1
+            self._inst["preemptions"].inc()
+            starved = self.engine.grow_slots() if s.live else []
+        if not s.live:
+            return events
+        # --- one compiled step over every live slot ---
+        t_step = self.clock()
+        if self.engine.speculative:
+            # draft → batched verify → accept: each slot emits
+            # 1..draft_len+1 tokens per step; stop checks run per
+            # token IN ORDER, so EOS or max_new_tokens mid-burst
+            # truncates exactly where sequential decode would have
+            # stopped
+            emitted = self.engine.spec_step()
+            dt = self.clock() - t_step
+            s.decode_time += dt
+            self.est_step_s = dt if not self.est_step_s \
+                else 0.8 * self.est_step_s + 0.2 * dt
+            # a cancel that landed while the step ran drops the whole
+            # burst (the slot leaves ``live`` here, before emission)
+            self._drain_cancels(events)
+            # count DELIVERED tokens only: a burst tail past
+            # EOS/max_new_tokens never reaches req.tokens, and
+            # counting it would inflate decode_tok_s vs the
+            # non-speculative arm (whose every counted token is
+            # appended)
+            delivered = 0
+            for slot in sorted(emitted):
+                burst: list[int] = []
+                req = s.live.get(slot)
+                for tok in emitted[slot]:
+                    if slot not in s.live:
+                        break
+                    delivered += 1
+                    burst.append(int(tok))
+                    self._maybe_stop(slot, int(tok))
+                if burst:
+                    # the whole accepted burst is ONE event — the SSE
+                    # contract is one message per pool read's yield
+                    events.append((req, burst))
+            s.decoded += delivered
+            self._inst["tokens"].inc(delivered)
+        else:
+            tokens = self.engine.step()
+            dt = self.clock() - t_step
+            s.decode_time += dt
+            self.est_step_s = dt if not self.est_step_s \
+                else 0.8 * self.est_step_s + 0.2 * dt
+            s.decoded += len(s.live)
+            self._inst["tokens"].inc(len(s.live))
+            self._drain_cancels(events)
+            for slot in list(s.live):
+                req = s.live[slot]
+                self._maybe_stop(slot, int(tokens[slot]))
+                events.append((req, [int(tokens[slot])]))
+        return events
+
+    def _land(self, s: _Session) -> None:
+        """Exception or not, the gauges land on engine truth at exit
+        (an aborted run may leave seated slots — report them rather
+        than freezing a stale mid-loop value in the Prometheus export
+        forever); clean exits read 0 live."""
+        if s.closed:
+            return
+        s.closed = True
+        inst = self._inst
+        inst["slots"].set(len(s.live))
+        inst["pages"].set(self.engine.tables.n_free_pages)
+        hit_pages = self.engine.prefix_hit_pages - s.hits0
+        lookups = self.engine.prefix_lookup_pages - s.lookups0
+        inst["hit_pages"].inc(hit_pages)
+        inst["chunks"].inc(self.engine.prefill_chunks - s.chunks0)
+        inst["hit_rate"].set(hit_pages / max(lookups, 1))
+        n_spec_prop = self.engine.spec_proposed - s.spec_prop0
+        n_spec_acc = self.engine.spec_accepted - s.spec_acc0
+        inst["spec_prop"].inc(n_spec_prop)
+        inst["spec_acc"].inc(n_spec_acc)
+        inst["spec_rate"].set(n_spec_acc / max(n_spec_prop, 1))
+        if self.policy.slo:
+            for name, cs in s.per_class.items():
+                inst["slo_ttft_rate"].set(
+                    cs["ttft_hit"] / max(cs["ttft_n"], 1), cls=name)
+                inst["slo_tpot_rate"].set(
+                    cs["tpot_hit"] / max(cs["tpot_n"], 1), cls=name)
+        self._s = None
+        self._sentinel = None
+
+    @staticmethod
+    def _pct(vals: list[float], q: float) -> float:
+        arr = np.percentile(np.asarray(vals or [0.0], np.float64), q)
+        return round(arr.tolist(), 4)
+
+    def _metrics(self, s: _Session) -> dict:
+        elapsed = self.clock() - s.t0
+        lat = s.lat or [0.0]
+        ttft = s.ttft or [0.0]
+        new_tokens = s.new_tokens
+        ttft_hit = sum(cs["ttft_hit"] for cs in s.per_class.values())
+        ttft_n = sum(cs["ttft_n"] for cs in s.per_class.values())
+        classes = {}
+        for name, cs in s.per_class.items():
+            classes[name] = {
+                "n_requests": cs["n"],
+                "n_completed": cs["completed"],
+                "n_shed": cs["shed"],
+                "ttft_p50_s": self._pct(cs["ttft"], 50),
+                "ttft_p99_s": self._pct(cs["ttft"], 99),
+                "tpot_p50_s": self._pct(cs["tpot"], 50),
+                "tpot_p99_s": self._pct(cs["tpot"], 99),
+                "ttft_hit_rate": round(
+                    cs["ttft_hit"] / max(cs["ttft_n"], 1), 4),
+                "tpot_hit_rate": round(
+                    cs["tpot_hit"] / max(cs["tpot_n"], 1), 4),
+            }
         return {
-            "n_requests": len(requests),
+            "n_requests": s.n_seen,
             "new_tokens": new_tokens,
             "elapsed_s": round(elapsed, 4),
-            "decode_tok_s": round(decoded / max(decode_time, 1e-9), 1),
+            "decode_tok_s": round(
+                s.decoded / max(s.decode_time, 1e-9), 1),
             "total_tok_s": round(new_tokens / max(elapsed, 1e-9), 1),
             "latency_mean_s": round(float(np.mean(lat)), 4),
             "latency_p95_s": round(float(np.percentile(lat, 95)), 4),
             "ttft_mean_s": round(float(np.mean(ttft)), 4),
             # previously invisible to callers: how often the
-            # youngest-preemption path actually fired, how many
-            # seatings (INCLUDING re-admissions after preemption) the
-            # trace cost, and what the prefix cache + chunked prefill
+            # preemption path actually fired, how many seatings
+            # (INCLUDING re-admissions after preemption) the trace
+            # cost, and what the prefix cache + chunked prefill
             # actually did — the registry's serving_* counters carry
             # the same events for the exporters
-            "n_admissions": n_admissions,
-            "n_preemptions": n_preemptions,
-            "n_prefill_chunks": n_chunks,
-            "prefix_hit_pages": hit_pages,
-            "prefix_hit_rate": round(hit_pages / max(lookups, 1), 4),
+            "n_admissions": s.n_admissions,
+            "n_preemptions": s.n_preemptions,
+            "n_prefill_chunks": self.engine.prefill_chunks - s.chunks0,
+            "prefix_hit_pages": self.engine.prefix_hit_pages - s.hits0,
+            "prefix_hit_rate": round(
+                (self.engine.prefix_hit_pages - s.hits0)
+                / max(self.engine.prefix_lookup_pages - s.lookups0, 1),
+                4),
             # speculation stats (all zero on a non-speculative
             # engine): mean accepted DRAFT tokens per verify step —
             # tokens/step is that + 1 (the fallback/bonus pick)
-            "n_spec_steps": n_spec_steps,
-            "n_spec_proposed": n_spec_prop,
-            "n_spec_accepted": n_spec_acc,
+            "n_spec_steps": self.engine.spec_steps - s.spec_steps0,
+            "n_spec_proposed":
+                self.engine.spec_proposed - s.spec_prop0,
+            "n_spec_accepted":
+                self.engine.spec_accepted - s.spec_acc0,
             "spec_accept_rate": round(
-                n_spec_acc / max(n_spec_prop, 1), 4),
+                (self.engine.spec_accepted - s.spec_acc0)
+                / max(self.engine.spec_proposed - s.spec_prop0, 1), 4),
             "spec_mean_accepted": round(
-                n_spec_acc / max(n_spec_steps, 1), 4),
+                (self.engine.spec_accepted - s.spec_acc0)
+                / max(self.engine.spec_steps - s.spec_steps0, 1), 4),
+            # SLO scheduler stats — stable keys on EVERY return path
+            # (the established contract): zero/empty under FCFS,
+            # populated per configured class under an SLO policy
+            "n_shed": s.n_shed,
+            "n_cancelled": s.n_cancelled,
+            "deadline_hit_rate": round(
+                ttft_hit / ttft_n, 4) if ttft_n else 1.0,
+            "classes": classes,
         }
+
+    # ---- the synchronous trace driver ----------------------------
+    def run(self, requests: list[Request]) -> dict:
+        if not requests:
+            return {"n_requests": 0, "new_tokens": 0, "elapsed_s": 0.0,
+                    "decode_tok_s": 0.0, "total_tok_s": 0.0,
+                    "latency_mean_s": 0.0, "latency_p95_s": 0.0,
+                    "ttft_mean_s": 0.0,
+                    # stable key set: the preemption/admission/prefill
+                    # /speculation/SLO stats exist on EVERY return
+                    # path, not just busy ones
+                    "n_admissions": 0, "n_preemptions": 0,
+                    "n_prefill_chunks": 0, "prefix_hit_pages": 0,
+                    "prefix_hit_rate": 0.0,
+                    "n_spec_steps": 0, "n_spec_proposed": 0,
+                    "n_spec_accepted": 0, "spec_accept_rate": 0.0,
+                    "spec_mean_accepted": 0.0,
+                    "n_shed": 0, "n_cancelled": 0,
+                    "deadline_hit_rate": 1.0, "classes": {
+                        name: {"n_requests": 0, "n_completed": 0,
+                               "n_shed": 0, "ttft_p50_s": 0.0,
+                               "ttft_p99_s": 0.0, "tpot_p50_s": 0.0,
+                               "tpot_p99_s": 0.0, "ttft_hit_rate": 0.0,
+                               "tpot_hit_rate": 0.0}
+                        for name in (self.policy.classes
+                                     if self.policy.slo else ())}}
+        for r in requests:
+            self._check_fits(r)
+            self.policy.validate(r)
+        s = self._begin()
+        self._s = s
+        s.n_seen = len(requests)
+        s.queue = sorted(requests, key=lambda r: r.arrival)
+        if self.policy.slo:
+            for r in requests:
+                s.per_class[self.policy.cls_of(r).name]["n"] += 1
+        try:
+            # `with sentinel` (not manual enter/exit): an exception
+            # escaping the loop still closes the watch — the policy
+            # only fires on clean exits by design
+            with self._sentinel:
+                while s.queue or s.live or s.filling:
+                    self.step()
+                    if not s.live and not s.filling and s.queue:
+                        # idle until the next arrival
+                        wait = min(r.arrival for r in s.queue) \
+                            - (self.clock() - s.t0)
+                        if wait > 0:
+                            time.sleep(min(wait, 0.05))
+        finally:
+            self._land(s)
+        return self._metrics(s)
 
 
 __all__ = ["ContinuousBatcher", "Request"]

@@ -530,6 +530,14 @@ class PagedEngine:
         return bool(self._pending)
 
     @property
+    def pending_chunk_count(self) -> int:
+        """Prefill chunks still queued across every in-flight
+        admission — the "work ahead of you" term in the front door's
+        TTFT slack estimate (host integers only)."""
+        return sum(-(-(p["s0"] - p["start"]) // self.chunk_tokens)
+                   for p in self._pending)
+
+    @property
     def pending_slots(self) -> list[int]:
         """Slots with an in-flight chunked prefill, oldest first —
         cross-run residue when a driver loop aborts mid-prefill; the

@@ -1,0 +1,497 @@
+"""Asyncio OpenAI-compatible serving front door.
+
+The "millions of users" surface (ROADMAP item 3): everything below
+this module already existed — paged KV pool, continuous batching,
+prefix cache + chunked prefill, speculative decoding, telemetry — but
+stopped at ``ContinuousBatcher.run(list)`` fed by synthetic traces.
+:class:`ServingFrontend` turns that into a SYSTEM: a stdlib-only
+asyncio HTTP server exposing
+
+- ``POST /v1/completions`` and ``POST /v1/chat/completions`` —
+  OpenAI-dialect JSON, ``stream: true`` for SSE (one event per decoded
+  token, or per accepted speculative burst), request ids, usage
+  accounting, ``finish_reason`` stop/length;
+- ``GET /metrics`` — the telemetry registry's Prometheus exposition
+  (the ``serving_*``/``serving_slo_*`` series, scrape-ready);
+- ``GET /healthz`` — liveness + pool occupancy.
+
+The engine never runs on the event loop: a single pump task drives
+``batcher.step()`` through a one-thread executor (the compiled
+decode step blocks THAT thread; the loop keeps accepting, parsing,
+streaming), and every client-visible effect travels through the
+batcher's thread-safe ``submit``/``cancel`` inboxes and per-step
+token events. Client disconnects cancel their request mid-prefill or
+mid-decode through the engine's abort paths — pages reclaimed, zero
+recompiles. Backpressure is explicit: a full queue or an SLO-policy
+shed answers **429 + Retry-After** before any pool pages move.
+Shutdown is graceful by default — stop accepting, drain seated work,
+close the telemetry session (and its recompile-sentinel watch).
+
+Nothing here imports beyond the stdlib; optional uvloop acceleration
+(the ``pip install torchbooster-tpu[serve]`` extra) is a pure
+event-loop swap via :func:`install_uvloop`.
+"""
+from __future__ import annotations
+
+import asyncio
+import concurrent.futures
+import time
+import uuid
+
+import numpy as np
+
+from torchbooster_tpu.serving.batcher import ContinuousBatcher, Request
+from torchbooster_tpu.serving.frontend.http import (
+    SSE_DONE,
+    HttpError,
+    error_response,
+    json_response,
+    read_request,
+    sse_event,
+    sse_head,
+    text_response,
+)
+
+
+def install_uvloop() -> bool:
+    """Swap in uvloop's event loop policy when it is installed (the
+    ``[serve]`` extra); False — and stdlib asyncio, which is fully
+    supported — otherwise. Never required: the server is pure
+    asyncio."""
+    try:
+        import uvloop  # type: ignore[import-not-found]
+    except ImportError:
+        return False
+    uvloop.install()
+    return True
+
+
+class IdCodec:
+    """Tokenizer-free text<->ids codec: "text" is whitespace-separated
+    token ids (``"12 7 903"``). The front door is model-agnostic —
+    callers with a real tokenizer pass any object with this
+    ``encode``/``decode`` surface; the default keeps the server (and
+    its tests/benches) runnable with no vocab asset at all, and
+    OpenAI-style token-array prompts bypass encoding entirely."""
+
+    def encode(self, text: str) -> list[int]:
+        try:
+            return [int(t) for t in text.split()]
+        except ValueError:
+            raise HttpError(
+                400, "the default codec accepts whitespace-separated "
+                "token ids (or pass `prompt` as a token array); "
+                "configure a tokenizer codec for raw text") from None
+
+    def decode(self, ids: list[int]) -> str:
+        return "".join(f"{i} " for i in ids)
+
+
+class _Stream:
+    """Per-request event mailbox the pump fills and one handler
+    drains."""
+
+    __slots__ = ("req", "queue")
+
+    def __init__(self, req: Request):
+        self.req = req
+        self.queue: asyncio.Queue = asyncio.Queue()
+
+
+class ServingFrontend:
+    """The asyncio front door over a
+    :class:`~torchbooster_tpu.serving.batcher.ContinuousBatcher`.
+
+    ``await start()`` opens the batcher session (instruments + the
+    recompile-sentinel watch for the server's whole lifetime) and
+    binds ``host:port`` (port 0 = ephemeral; read :attr:`port`).
+    ``await stop()`` drains and returns the batcher's session metrics
+    dict. ``max_queue`` bounds the submit queue — beyond it requests
+    are answered 429 before touching the scheduler; the policy's
+    ``retry_after_s`` prices the Retry-After header. ``codec``
+    converts text prompts to ids (default :class:`IdCodec`)."""
+
+    def __init__(self, batcher: ContinuousBatcher,
+                 host: str = "127.0.0.1", port: int = 0, *,
+                 codec=None, max_queue: int = 64,
+                 model_name: str = "torchbooster-tpu"):
+        if max_queue < 1:
+            raise ValueError(f"max_queue must be >= 1, got {max_queue}")
+        self.batcher = batcher
+        self.host = host
+        self._port = port
+        self.codec = codec if codec is not None else IdCodec()
+        self.max_queue = max_queue
+        self.model_name = model_name
+        self._server: asyncio.AbstractServer | None = None
+        self._pump_task: asyncio.Task | None = None
+        self._exec = None
+        self._wake = asyncio.Event()
+        self._streams: dict[int, _Stream] = {}
+        self._handlers: set[asyncio.Task] = set()
+        self._stopping = False
+        self.last_metrics: dict | None = None
+
+    # ---- lifecycle -----------------------------------------------
+    @property
+    def port(self) -> int:
+        if self._server is not None:
+            return self._server.sockets[0].getsockname()[1]
+        return self._port
+
+    async def start(self) -> None:
+        if self._server is not None:
+            raise RuntimeError("frontend already started")
+        self.batcher.start_session()
+        # ONE worker thread owns every engine call: the compiled step
+        # blocks it, not the event loop, and batcher state never sees
+        # two drivers (submit/cancel cross over via the inboxes)
+        self._exec = concurrent.futures.ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="tb-serve-pump")
+        self._stopping = False
+        self._server = await asyncio.start_server(
+            self._on_connection, self.host, self._port)
+        self._pump_task = asyncio.create_task(self._pump())
+
+    async def stop(self, drain: bool = True) -> dict:
+        """Graceful shutdown: stop accepting, let seated/queued work
+        finish (``drain=False`` cancels it instead), stop the pump,
+        close the batcher session. Returns the session metrics."""
+        if self._server is None:
+            raise RuntimeError("frontend not started")
+        self._stopping = True
+        self._server.close()
+        await self._server.wait_closed()
+        if not drain:
+            for stream in list(self._streams.values()):
+                self.batcher.cancel(stream.req)
+        self._wake.set()
+        pump_exc = None
+        if self._pump_task is not None:
+            try:
+                await self._pump_task
+            except Exception as exc:   # close the session, THEN re-raise
+                pump_exc = exc
+        if self._handlers:
+            await asyncio.gather(*self._handlers,
+                                 return_exceptions=True)
+        self._exec.shutdown(wait=True)
+        self._server = None
+        self._pump_task = None
+        self.last_metrics = self.batcher.finish_session()
+        if pump_exc is not None:
+            raise pump_exc
+        return self.last_metrics
+
+    # ---- the pump ------------------------------------------------
+    async def _pump(self) -> None:
+        """Drive ``batcher.step()`` off-loop and fan its token events
+        out to the per-request mailboxes. The loop thread only ever
+        parses/streams; the executor thread only ever steps. A step
+        that RAISES (engine failure) must not strand handlers blocked
+        on their mailboxes forever — every in-flight request gets a
+        terminal error event and the exception resurfaces at
+        ``stop()``."""
+        loop = asyncio.get_running_loop()
+        try:
+            while True:
+                if not self.batcher.has_work:
+                    if self._stopping:
+                        break
+                    self._wake.clear()
+                    # the timeout is a liveness belt: submit()/cancel()
+                    # always set the event, but a cheap periodic poll
+                    # keeps shutdown and clock-driven arrivals honest
+                    try:
+                        await asyncio.wait_for(self._wake.wait(),
+                                               timeout=0.5)
+                    except asyncio.TimeoutError:
+                        pass
+                    continue
+                events = await loop.run_in_executor(
+                    self._exec, self.batcher.step)
+                # a request may get several events in one step (its
+                # prefill token, then the same iteration's decode
+                # token): the finished flag rides only the LAST one,
+                # or a handler would close its stream with tokens
+                # still queued behind
+                last = {id(req): i for i, (req, _) in enumerate(events)}
+                for i, (req, tokens) in enumerate(events):
+                    stream = self._streams.get(id(req))
+                    if stream is not None:
+                        done = (req.finished_at is not None
+                                and last[id(req)] == i)
+                        stream.queue.put_nowait((tokens, done))
+        except Exception:
+            self._stopping = True
+            for stream in list(self._streams.values()):
+                if stream.req.finished_at is None:
+                    stream.req.finish_reason = "error"
+                stream.queue.put_nowait(([], True))
+            raise
+
+    def _register(self, req: Request) -> _Stream:
+        stream = _Stream(req)
+        self._streams[id(req)] = stream
+        return stream
+
+    def _unregister(self, req: Request) -> None:
+        self._streams.pop(id(req), None)
+
+    # ---- connection handling -------------------------------------
+    async def _on_connection(self, reader: asyncio.StreamReader,
+                             writer: asyncio.StreamWriter) -> None:
+        task = asyncio.current_task()
+        self._handlers.add(task)
+        try:
+            await self._serve_one(reader, writer)
+        except (ConnectionResetError, BrokenPipeError):
+            pass
+        finally:
+            self._handlers.discard(task)
+            writer.close()
+            try:
+                await writer.wait_closed()
+            except (ConnectionResetError, BrokenPipeError):
+                pass
+
+    async def _serve_one(self, reader, writer) -> None:
+        try:
+            request = await read_request(reader)
+            if request is None:
+                return
+            if self._stopping:
+                raise HttpError(503, "server is shutting down")
+            route = (request.method, request.path)
+            if route == ("POST", "/v1/completions"):
+                await self._completion(request, reader, writer,
+                                       chat=False)
+            elif route == ("POST", "/v1/chat/completions"):
+                await self._completion(request, reader, writer,
+                                       chat=True)
+            elif route == ("GET", "/metrics"):
+                from torchbooster_tpu.observability.export import (
+                    prometheus_text)
+
+                writer.write(text_response(200, prometheus_text()))
+            elif route == ("GET", "/healthz"):
+                eng = self.batcher.engine
+                writer.write(json_response(200, {
+                    "status": "ok",
+                    "queue_depth": self.batcher.queue_depth,
+                    "pages_free": int(eng.tables.n_free_pages),
+                    "occupancy": round(self.batcher.occupancy, 4),
+                }))
+            elif request.path in ("/v1/completions",
+                                  "/v1/chat/completions",
+                                  "/metrics", "/healthz"):
+                raise HttpError(405,
+                                f"{request.method} not allowed here")
+            else:
+                raise HttpError(404, f"no route {request.path}")
+            await writer.drain()
+        except HttpError as err:
+            writer.write(error_response(err))
+            await writer.drain()
+
+    # ---- request construction ------------------------------------
+    def _prompt_ids(self, payload: dict, chat: bool) -> np.ndarray:
+        if chat:
+            messages = payload.get("messages")
+            if not isinstance(messages, list) or not messages:
+                raise HttpError(400,
+                                "chat needs a non-empty `messages` list")
+            parts = []
+            for m in messages:
+                if not isinstance(m, dict) or "content" not in m:
+                    raise HttpError(
+                        400, "each message needs role+content")
+                parts.append(str(m["content"]))
+            # the default codec is id-based, so the chat template is
+            # pure concatenation of the messages' token text — a real
+            # tokenizer codec may impose its own chat template before
+            # this server ever sees the text
+            ids = []
+            for part in parts:
+                ids.extend(self.codec.encode(part))
+            if not ids:
+                raise HttpError(400, "messages tokenize to nothing")
+            return np.asarray(ids, np.int32)
+        prompt = payload.get("prompt")
+        if isinstance(prompt, str):
+            ids = self.codec.encode(prompt)
+        elif isinstance(prompt, list) and prompt \
+                and all(isinstance(t, int) for t in prompt):
+            ids = prompt
+        else:
+            raise HttpError(
+                400, "`prompt` must be a string or a non-empty token "
+                "array (batched string-list prompts not supported)")
+        if not ids:
+            raise HttpError(400, "prompt tokenizes to nothing")
+        return np.asarray(ids, np.int32)
+
+    def _build_request(self, payload: dict, chat: bool) -> Request:
+        if not isinstance(payload, dict):
+            raise HttpError(400, "body must be a JSON object")
+        ids = self._prompt_ids(payload, chat)
+        max_tokens = payload.get("max_tokens", 16)
+        deadline = payload.get("deadline_ms")
+        try:
+            req = Request(
+                prompt=ids,
+                max_new_tokens=int(max_tokens),
+                eos_id=payload.get("eos_id"),
+                priority=payload.get("priority", ""),
+                deadline_ms=(float(deadline) if deadline is not None
+                             else None),
+                arrival_time=time.time(),
+            )
+        except (TypeError, ValueError) as exc:
+            raise HttpError(400, str(exc)) from None
+        return req
+
+    def _submit(self, req: Request) -> None:
+        if self.batcher.queue_depth >= self.max_queue:
+            raise HttpError(
+                429, f"queue full ({self.max_queue} waiting); "
+                "retry later", {"Retry-After": str(
+                    self.batcher.policy.retry_after_s(self.batcher))})
+        try:
+            self.batcher.submit(req)
+        except (TypeError, ValueError) as exc:
+            raise HttpError(400, str(exc)) from None
+        self._wake.set()
+
+    # ---- completion serving --------------------------------------
+    async def _completion(self, request, reader, writer,
+                          chat: bool) -> None:
+        payload = request.json()
+        req = self._build_request(payload, chat)
+        stream_mode = bool(payload.get("stream"))
+        rid = ("chatcmpl-" if chat else "cmpl-") + uuid.uuid4().hex[:24]
+        created = int(req.arrival_time)
+        stream = self._register(req)
+        # the disconnect watchdog: this dialect sends nothing after
+        # the body, so any read completing means EOF/reset — route it
+        # to the batcher's cancel path (mid-prefill abort, mid-decode
+        # retire; pages reclaimed, zero recompiles)
+        watchdog = asyncio.create_task(self._watch_disconnect(
+            reader, req))
+        try:
+            self._submit(req)
+            if stream_mode:
+                await self._stream_response(req, stream, writer, rid,
+                                            created, chat)
+            else:
+                await self._unary_response(req, stream, writer, rid,
+                                           created, chat)
+        finally:
+            watchdog.cancel()
+            self._unregister(req)
+
+    async def _watch_disconnect(self, reader, req: Request) -> None:
+        try:
+            await reader.read(1)
+        except (asyncio.CancelledError, Exception):
+            return
+        finally:
+            # EOF (or any stray bytes, which this dialect forbids)
+            # while the request is unfinished => client is gone
+            if req.finished_at is None:
+                self.batcher.cancel(req)
+                self._wake.set()
+
+    def _shed_error(self) -> HttpError:
+        return HttpError(
+            429, "shed: the scheduler cannot meet this request's "
+            "deadline under current load", {"Retry-After": str(
+                self.batcher.policy.retry_after_s(self.batcher))})
+
+    def _chunk(self, rid: str, created: int, tokens: list[int],
+               finish: str | None, chat: bool) -> dict:
+        text = self.codec.decode(tokens) if tokens else ""
+        if chat:
+            delta = {"content": text} if text else {}
+            choice = {"index": 0, "delta": delta,
+                      "finish_reason": finish}
+            obj = "chat.completion.chunk"
+        else:
+            choice = {"index": 0, "text": text,
+                      "token_ids": tokens, "finish_reason": finish}
+            obj = "text_completion"
+        return {"id": rid, "object": obj, "created": created,
+                "model": self.model_name, "choices": [choice]}
+
+    async def _stream_response(self, req, stream, writer, rid,
+                               created, chat) -> None:
+        head_sent = False
+        while True:
+            tokens, done = await stream.queue.get()
+            if req.shed:
+                if head_sent:   # defensive: shed only ever targets
+                    # never-started requests, but a malformed custom
+                    # policy must not make us write a 429 into an
+                    # open SSE stream
+                    writer.write(SSE_DONE)
+                    await writer.drain()
+                    return
+                raise self._shed_error()
+            if req.cancelled:
+                return          # client is gone; nothing to write
+            if req.finish_reason == "error" and not head_sent:
+                raise HttpError(500, "engine failure mid-request; "
+                                "see server logs")
+            if not head_sent:
+                writer.write(sse_head())
+                head_sent = True
+            if tokens:
+                # one SSE event per decode step's delivery: a single
+                # token normally, the whole accepted burst in
+                # speculative mode
+                finish = req.finish_reason if done else None
+                writer.write(sse_event(self._chunk(
+                    rid, created, tokens, finish, chat)))
+                await writer.drain()
+            if done:
+                if not tokens:  # finished on an empty event
+                    writer.write(sse_event(self._chunk(
+                        rid, created, [], req.finish_reason, chat)))
+                writer.write(SSE_DONE)
+                await writer.drain()
+                return
+
+    async def _unary_response(self, req, stream, writer, rid,
+                              created, chat) -> None:
+        tokens: list[int] = []
+        while True:
+            chunk, done = await stream.queue.get()
+            if req.shed:
+                raise self._shed_error()
+            if req.cancelled:
+                return
+            if req.finish_reason == "error":
+                raise HttpError(500, "engine failure mid-request; "
+                                "see server logs")
+            tokens.extend(chunk)
+            if done:
+                break
+        text = self.codec.decode(tokens)
+        if chat:
+            choice = {"index": 0, "message":
+                      {"role": "assistant", "content": text},
+                      "finish_reason": req.finish_reason}
+            obj = "chat.completion"
+        else:
+            choice = {"index": 0, "text": text, "token_ids": tokens,
+                      "finish_reason": req.finish_reason}
+            obj = "text_completion"
+        writer.write(json_response(200, {
+            "id": rid, "object": obj, "created": created,
+            "model": self.model_name, "choices": [choice],
+            "usage": {"prompt_tokens": req.base_len,
+                      "completion_tokens": len(tokens),
+                      "total_tokens": req.base_len + len(tokens)}}))
+
+
+__all__ = ["IdCodec", "ServingFrontend", "install_uvloop"]
