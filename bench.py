@@ -708,6 +708,170 @@ def bench_serve_spec() -> dict:
     return out
 
 
+def bench_serve_kernel() -> dict:
+    """Decode-backend A/B (the PR-8 tentpole): the SAME request trace
+    served through ``decode_backend: xla`` (the whole-pool sweep — the
+    control) and ``decode_backend: pallas`` (the in-kernel block-table
+    walk, ops/paged_attention.py) on IDENTICAL engine geometry.
+
+    The claim under test is the two-regime roofline
+    (docs/performance.md): the sweep streams pool CAPACITY every step,
+    the kernel streams live OCCUPANCY — on an HBM-bound loop the byte
+    ratio is the tokens/s ratio. So besides the per-backend decode
+    tok/s the row emits the MODELED bytes: live MB/step (sampled from
+    the block tables before every step — shared prefix pages counted
+    once, exactly what the kernel walk reads) vs pool MB/step, and
+    their ratio — the predicted win the measured ratio should track.
+    Also emitted: token parity across backends (the speedup is only
+    evidence if the kernel emitted EXACTLY the sweep's tokens) and the
+    per-backend compile counts (the zero-recompile proof through the
+    kernel path).
+
+    ``BENCH_KERNEL_SPEC=1`` switches the workload to the repetitive
+    speculative shape (serve_spec's) with ``BENCH_KERNEL_DRAFT``
+    drafted tokens, so the A/B prices the FUSED verify pass (one
+    kernel walk per burst) against the sweep's second full pool read.
+    Knobs are validated LOUDLY: an unknown backend name or a draft
+    outside [1, page_size) must kill the row, not silently measure
+    the wrong configuration."""
+    from torchbooster_tpu.models.gpt import GPT, GPTConfig
+    from torchbooster_tpu.serving import (ContinuousBatcher,
+                                          PagedEngine, Request)
+
+    spec = env_flag("BENCH_KERNEL_SPEC")
+    n_req = int(os.environ.get("BENCH_KERNEL_REQUESTS", 12))
+    rate = float(os.environ.get("BENCH_KERNEL_RATE", 16.0))
+    slots = int(os.environ.get("BENCH_KERNEL_SLOTS", 8))
+    page = int(os.environ.get("BENCH_KERNEL_PAGE", 64))
+    n_pages = int(os.environ.get("BENCH_KERNEL_PAGES", 96))
+    seq = int(os.environ.get("BENCH_KERNEL_SEQ", 2048))
+    n_layers = int(os.environ.get("BENCH_KERNEL_LAYERS", 12))
+    kv = int(os.environ.get("BENCH_KERNEL_KV_HEADS", 4))
+    draft = int(os.environ.get("BENCH_KERNEL_DRAFT", 8))
+    period = int(os.environ.get("BENCH_KERNEL_PERIOD", 16))
+    cache_dtype = os.environ.get("BENCH_KERNEL_CACHE_DTYPE") or None
+    backends = [b.strip() for b in os.environ.get(
+        "BENCH_KERNEL_BACKENDS", "xla,pallas").split(",") if b.strip()]
+    bad = [b for b in backends if b not in ("xla", "pallas")]
+    if bad or not backends:
+        raise ValueError(
+            f"BENCH_KERNEL_BACKENDS must be a non-empty comma list "
+            f"over {{'xla', 'pallas'}}, got {bad or backends!r}: a "
+            "typo here would silently A/B the wrong regime")
+    if "xla" not in backends and len(backends) > 1:
+        raise ValueError(
+            "BENCH_KERNEL_BACKENDS without 'xla' has no control arm "
+            "— the ratio and parity fields would compare nothing")
+    if spec and not 1 <= draft < page:
+        raise ValueError(
+            f"BENCH_KERNEL_DRAFT ({draft}) must satisfy 1 <= "
+            f"draft_len < page_size ({page}): at or above page_size "
+            "the verify write-ahead breaks the engine's one-page "
+            "grow/preempt bound (PagedEngine enforces the same rule)")
+    if cache_dtype not in (None, "int8"):
+        raise ValueError(
+            f"BENCH_KERNEL_CACHE_DTYPE must be '' or 'int8', got "
+            f"{cache_dtype!r}")
+    suffix = f"_{cache_dtype}" if cache_dtype else ""
+    pre = "serve_kernel_spec" if spec else "serve_kernel"
+
+    rs = np.random.RandomState(0)
+    if spec:
+        # the repetitive serve_spec shape: prompt-lookup drafts well,
+        # so the fused verify pass is actually exercised multi-token
+        prompt_len = max(period,
+                         min(4 * page, seq // 2) // period * period)
+        out_hi = max(2, min(129, seq - prompt_len))
+        prompts = [np.tile(rs.randint(0, 50257, period, dtype=np.int32),
+                           prompt_len // period) for _ in range(n_req)]
+        out_lens = rs.randint(min(32, out_hi - 1), out_hi, n_req)
+        arrivals = np.zeros(n_req)
+        warm_ids = np.tile(rs.randint(0, 50257, period, dtype=np.int32),
+                           prompt_len // period)
+    else:
+        # the mixed-length Poisson serve shape: partial occupancy is
+        # the point — the live/pool gap IS the kernel's predicted win
+        buckets = [b for b in (64, 128, 192, 256, 320, 384, 448)
+                   if b < seq // 2] or [max(1, min(seq // 2, seq - 8))]
+        out_hi = max(2, min(129, seq - max(buckets)))
+        arrivals = np.cumsum(rs.exponential(1.0 / rate, n_req))
+        prompts = [rs.randint(0, 50257, int(n), dtype=np.int32)
+                   for n in rs.choice(buckets, n_req)]
+        out_lens = rs.randint(min(16, out_hi - 1), out_hi, n_req)
+        warm_ids = rs.randint(0, 50257,
+                              min(max(buckets) + out_hi - 2, seq - 2),
+                              dtype=np.int32)
+
+    def trace():
+        return [Request(prompt=p, max_new_tokens=int(o),
+                        arrival=float(a))
+                for p, o, a in zip(prompts, out_lens, arrivals)]
+
+    cfg = GPTConfig(n_layers=n_layers, seq_len=seq, n_kv_heads=kv)
+    params = GPT.init(jax.random.PRNGKey(0), cfg)
+    head_dim = cfg.d_model // cfg.n_heads
+    # modeled bytes per K/V row across K+V and all layers: int8 pages
+    # carry 1 byte/elem + a bf16 scale per (token, head)
+    elem = (1 + 2 / head_dim) if cache_dtype else 2
+    row_mb = 2 * n_layers * cfg.kv_heads * head_dim * elem / 1e6
+    pool_mb = (n_pages - 1) * page * row_mb
+
+    out = {}
+    tokens_by_arm = {}
+    live_samples: dict[str, list[int]] = {}
+    for backend in backends:
+        engine = PagedEngine(params, cfg, page_size=page,
+                             n_pages=n_pages, max_slots=slots,
+                             cache_dtype=cache_dtype,
+                             speculative=spec, draft_len=draft,
+                             decode_backend=backend)
+        samples: list[int] = []
+        live_samples[backend] = samples
+        step_name = "spec_step" if spec else "step"
+        inner = getattr(engine, step_name)
+
+        def sampled(engine=engine, samples=samples, inner=inner):
+            # the live-page count the imminent step will read — host
+            # integers off the block tables, no device sync
+            samples.append(engine.tables.n_live_pages)
+            return inner()
+
+        setattr(engine, step_name, sampled)
+        batcher = ContinuousBatcher(engine)
+        batcher.run([Request(prompt=warm_ids, max_new_tokens=2)])
+        samples.clear()
+        reqs = trace()
+        m = batcher.run(reqs)
+        tokens_by_arm[backend] = [list(r.tokens) for r in reqs]
+        out[f"{pre}_tok_s_{backend}{suffix}"] = m["decode_tok_s"]
+        out[f"{pre}_latency_{backend}_s{suffix}"] = m["latency_mean_s"]
+        out[f"{pre}_decode_compiles_{backend}{suffix}"] = \
+            engine.decode_compiles
+        out[f"{pre}_verify_compiles_{backend}{suffix}"] = \
+            engine.verify_compiles
+        out[f"{pre}_live_mb_step_{backend}{suffix}"] = round(
+            float(np.mean(samples)) * page * row_mb, 3) \
+            if samples else 0.0
+        if spec:
+            out[f"{pre}_accept_rate_{backend}{suffix}"] = \
+                m["spec_accept_rate"]
+    out[f"{pre}_pool_mb_step{suffix}"] = round(pool_mb, 3)
+    if "xla" in backends and "pallas" in backends:
+        out[f"{pre}_tok_s_ratio{suffix}"] = round(
+            out[f"{pre}_tok_s_pallas{suffix}"]
+            / max(out[f"{pre}_tok_s_xla{suffix}"], 1e-9), 2)
+        # the MODELED win: pool bytes over mean live bytes (+ the one
+        # null page the padded walk touches) — what the measured
+        # ratio should track on an HBM-bound loop
+        live = float(np.mean(live_samples["pallas"])) \
+            if live_samples["pallas"] else 0.0
+        out[f"{pre}_modeled_bytes_ratio{suffix}"] = round(
+            (n_pages - 1) / max(live + 1.0, 1e-9), 2)
+        out[f"{pre}_token_parity{suffix}"] = \
+            tokens_by_arm["pallas"] == tokens_by_arm["xla"]
+    return out
+
+
 def bench_serve_http() -> dict:
     """The serving FRONT DOOR end to end: real asyncio HTTP clients
     stream SSE completions from a live ``ServingFrontend`` over
@@ -1521,6 +1685,8 @@ def _sub_main(name: str) -> None:
         print(json.dumps(bench_serve_prefix()))
     elif name == "serve_spec":
         print(json.dumps(bench_serve_spec()))
+    elif name == "serve_kernel":
+        print(json.dumps(bench_serve_kernel()))
     elif name == "serve_http":
         print(json.dumps(bench_serve_http()))
     elif name == "obs":
@@ -1703,8 +1869,11 @@ def _deadline(name: str, default: int) -> int:
 _SECONDARY_BENCHES = (("gpt", 900), ("gpt_long", 1500), ("loader", 900),
                       ("unet", 900), ("decode", 1500), ("serve", 1800),
                       ("serve_prefix", 1500), ("serve_spec", 1500),
-                      # same budget as its run_ab QUEUE rows: the two
-                      # drivers must not disagree on when to kill it
+                      # same budget as their run_ab QUEUE rows: the
+                      # two drivers must not disagree on when to kill
+                      # them (serve_kernel compiles the mosaic kernel
+                      # — first-compile on the tunnel is the slow tail)
+                      ("serve_kernel", 1800),
                       ("serve_http", 1800),
                       ("obs", 900), ("comms", 900))
 

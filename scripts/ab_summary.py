@@ -104,7 +104,7 @@ def main() -> None:
     multi_key = ("decode", "decode_int8", "cifar_acc", "comms",
                  "comms_cpu8", "serve_prefix", "serve_prefix_int8",
                  "serve_spec", "serve_spec_int8", "serve_http",
-                 "serve_http_prio")
+                 "serve_http_prio", "serve_kernel", "serve_kernel_spec")
     for name in sorted(attempts):
         if name in METRICS or (name in multi_key and name in latest):
             continue  # multi-key ok rows print below; failures fall through
@@ -172,6 +172,34 @@ def main() -> None:
             print(f"| {arm} "
                   f"| {r.get(f'serve_spec_tok_s_{arm}{sfx}', '—')} "
                   f"| {r.get(f'serve_spec_latency_{arm}_s{sfx}', '—')} |")
+
+    # serve_kernel rows: the decode-backend A/B rendered as a
+    # per-backend sub-table (tok/s, modeled live-vs-pool MB/step,
+    # compile counts) with the measured-vs-modeled ratio headline
+    for name in ("serve_kernel", "serve_kernel_spec"):
+        e = latest.get(name)
+        if e is None:
+            continue
+        r = e.get("result") or {}
+        pre = name
+        print(f"\n{name} (tok/s ratio "
+              f"{r.get(f'{pre}_tok_s_ratio', '?')}x vs modeled bytes "
+              f"ratio {r.get(f'{pre}_modeled_bytes_ratio', '?')}x, "
+              f"pool {r.get(f'{pre}_pool_mb_step', '?')} MB/step, "
+              f"token parity {r.get(f'{pre}_token_parity', '?')}):")
+        print("| backend | decode tok/s | mean latency s "
+              "| live MB/step | decode/verify compiles |")
+        print("|---|---|---|---|---|")
+        for backend in ("xla", "pallas"):
+            if f"{pre}_tok_s_{backend}" not in r:
+                continue
+            print(
+                f"| {backend} "
+                f"| {r.get(f'{pre}_tok_s_{backend}', '—')} "
+                f"| {r.get(f'{pre}_latency_{backend}_s', '—')} "
+                f"| {r.get(f'{pre}_live_mb_step_{backend}', '—')} "
+                f"| {r.get(f'{pre}_decode_compiles_{backend}', '—')}"
+                f"/{r.get(f'{pre}_verify_compiles_{backend}', '—')} |")
 
     # serve_http rows: the front-door A/B rendered as a per-class SLO
     # sub-table (client-observed TTFT/TPOT percentiles per arm x

@@ -41,6 +41,11 @@ HOT_PATHS = (
     # deferred registry reads only; tests/test_obs_lint.py pins the
     # coverage)
     "torchbooster_tpu/serving/",
+    # the paged flash-decode kernel wrapper sits INSIDE the compiled
+    # decode/verify steps (serving/engine.py calls it per layer per
+    # step) — a host sync in its wrapper-level plumbing would stall
+    # every decode dispatch exactly like one in the engine itself
+    "torchbooster_tpu/ops/paged_attention.py",
     "torchbooster_tpu/observability/",
     "torchbooster_tpu/data/pipeline.py",
     # the gradient-sync hook runs INSIDE the compiled step and its

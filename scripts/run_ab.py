@@ -107,6 +107,16 @@ QUEUE: list[tuple[str, str, dict, int]] = [
     ("serve_spec", "serve_spec", {}, 1800),
     ("serve_spec_int8", "serve_spec",
      {"BENCH_SPEC_CACHE_DTYPE": "int8"}, 1800),
+    # decode-backend A/B (the PR-8 tentpole): the SAME mixed-length
+    # Poisson trace through decode_backend xla (pool sweep) vs pallas
+    # (in-kernel block-table walk) — tok/s ratio vs the MODELED
+    # live-vs-pool bytes ratio, token parity, one-compile proof
+    # (bench.bench_serve_kernel; the roofline says the measured ratio
+    # should track pool/live occupancy); the spec row prices the
+    # FUSED verify pass against the sweep's second full pool read
+    ("serve_kernel", "serve_kernel", {}, 1800),
+    ("serve_kernel_spec", "serve_kernel",
+     {"BENCH_KERNEL_SPEC": "1"}, 1800),
     # the serving FRONT DOOR (the PR-7 tentpole A/B): real asyncio
     # HTTP clients streaming SSE from the live server over localhost
     # — client-observed p50/p99 TTFT/TPOT per priority class,

@@ -721,6 +721,13 @@ class ServingConfig(BaseConfig):
     sampling. ``ngram_min`` is the shortest history n-gram the
     drafter will match. ``draft_len`` must stay below ``page_size``
     (the engine validates loudly).
+
+    ``decode_backend: pallas`` swaps the decode/verify pool READ for
+    the paged flash-decode kernel (ops/paged_attention.py): block
+    tables walked in-kernel, so bytes/step are the live context
+    instead of the pool capacity — docs/performance.md has the
+    two-regime roofline. ``xla`` (default) keeps the pool sweep and
+    is the A/B control; both are token-exact for greedy decode.
     """
 
     page_size: int = 64
@@ -735,6 +742,7 @@ class ServingConfig(BaseConfig):
     speculative: bool = False          # draft + batched-verify decode
     draft_len: int = 4                 # drafted tokens per verify step
     ngram_min: int = 2                 # shortest prompt-lookup n-gram
+    decode_backend: str = "xla"        # "xla" pool sweep | "pallas" kernel
     frontend: FrontendConfig = dataclasses.field(
         default_factory=FrontendConfig)  # HTTP front door + scheduler
 
@@ -767,7 +775,8 @@ class ServingConfig(BaseConfig):
             prefix_cache=self.prefix_cache,
             prefill_chunk_pages=self.prefill_chunk_pages,
             speculative=self.speculative,
-            draft_len=self.draft_len, ngram_min=self.ngram_min)
+            draft_len=self.draft_len, ngram_min=self.ngram_min,
+            decode_backend=self.decode_backend)
         return ContinuousBatcher(engine, on_recompile=on_recompile,
                                  policy=self.frontend.make_policy())
 

@@ -7,8 +7,9 @@ CPU → interpret/reference path) so the same call sites run everywhere.
 from torchbooster_tpu.ops.attention import attention, mha_reference
 from torchbooster_tpu.ops.losses import (
     bce_with_logits, cross_entropy, l2_loss, mse_loss)
+from torchbooster_tpu.ops.paged_attention import paged_attention
 
 __all__ = [
     "attention", "bce_with_logits", "cross_entropy", "l2_loss",
-    "mha_reference", "mse_loss",
+    "mha_reference", "mse_loss", "paged_attention",
 ]

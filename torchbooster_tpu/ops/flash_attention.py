@@ -47,7 +47,10 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from torchbooster_tpu._jax_compat import CompilerParams as _CompilerParams
+from torchbooster_tpu.ops._pallas_util import (
+    CompilerParams as _CompilerParams,
+    resolve_interpret as _resolve_interpret,
+)
 
 NEG_INF = -1e30
 # Per-row residual (lse) lane padding. Mosaic requires a block's minor
@@ -424,7 +427,7 @@ def flash_attention(
     sm_scale: float | None = None,
     block_q: int | None = None,
     block_k: int | None = None,
-    interpret: bool = False,
+    interpret: bool | None = None,
 ) -> jax.Array:
     """Blocked attention over (BH, S, D) tensors; differentiable (the
     backward recomputes probabilities from the saved logsumexp — see
@@ -450,8 +453,11 @@ def flash_attention(
                           else _block_default("Q"), seq_q, "seq_q")
     block_k = _pick_block(block_k if block_k is not None
                           else _block_default("K"), seq_kv, "seq_kv")
+    # interpret=None -> the shared ops-wide policy (_pallas_util):
+    # compiled on TPU backends, interpret mode elsewhere — resolved
+    # OUTSIDE _flash_entry's jit so its cache keys on the bool
     return _flash_entry(q, k, v, causal, sm_scale, block_q, block_k,
-                        interpret)
+                        _resolve_interpret(interpret))
 
 
 __all__ = ["flash_attention", "tileable"]

@@ -71,6 +71,7 @@ from torchbooster_tpu.models.gpt import (
     _make_pick,
     _quantize_kv,
 )
+from torchbooster_tpu.ops.paged_attention import paged_attention
 from torchbooster_tpu.serving.kv_pages import (
     NULL_PAGE,
     BlockTables,
@@ -120,6 +121,19 @@ class PagedEngine:
     ``ngram_min`` tune the drafter. Off (the default), no verify
     executable exists and the engine is bit-for-bit the
     non-speculative one.
+
+    ``decode_backend="pallas"`` swaps the decode AND verify steps'
+    pool READ for the paged flash-decode kernel
+    (ops/paged_attention.py): block tables walked in-kernel over a
+    compacted live-page list, so bytes/step are the live context
+    (``Σ ceil(len/page) · page_size`` rows, shared prefix pages once)
+    instead of the pool — on the HBM-bound decode loop that ratio is
+    the tokens/s ratio (docs/performance.md, two-regime roofline).
+    Greedy output is token-exact vs the sweep and the dense control
+    (tests/test_paged_kernel.py), the compiled-step count stays one
+    per executable across churn, and the default ``"xla"`` leaves the
+    engine — including its jitted call signatures — bit-for-bit
+    unchanged.
     """
 
     def __init__(self, params: dict, cfg: GPTConfig, *,
@@ -133,7 +147,8 @@ class PagedEngine:
                  prefill_chunk_pages: int = 4,
                  speculative: bool = False,
                  draft_len: int = 4,
-                 ngram_min: int = 2):
+                 ngram_min: int = 2,
+                 decode_backend: str = "xla"):
         if cfg.seq_len % page_size:
             # a last partial page per slot would shift page_pos math;
             # geometry is static, so fail loudly at construction
@@ -144,6 +159,11 @@ class PagedEngine:
             raise ValueError(
                 f"prefill_chunk_pages must be >= 1, got "
                 f"{prefill_chunk_pages}")
+        if decode_backend not in ("xla", "pallas"):
+            raise ValueError(
+                f"decode_backend must be 'xla' (the pool sweep) or "
+                f"'pallas' (the paged flash-decode kernel), got "
+                f"{decode_backend!r}")
         if speculative and not 1 <= draft_len < page_size:
             # the verify step writes 1 + draft_len positions per slot
             # per step; draft_len < page_size bounds the write-ahead
@@ -178,6 +198,15 @@ class PagedEngine:
         self.pool = make_pool(cfg, page_size, n_pages,
                               cache_dtype=cache_dtype,
                               compute_dtype=compute_dtype)
+        # decode_backend selects HOW the decode/verify steps READ the
+        # pool: "xla" (default) is the whole-pool sweep — the A/B
+        # control, bit-for-bit the pre-kernel engine; "pallas" walks
+        # the block tables in-kernel (ops/paged_attention.py) so
+        # bytes/step track live context instead of pool capacity.
+        # Writes, sampling, bookkeeping, and every contract
+        # (zero-recompile, token parity, seat/retire/evict, prefix
+        # sharing) are backend-independent.
+        self.decode_backend = decode_backend
         self.temperature = temperature
         self.top_k = top_k
         self.top_p = top_p
@@ -331,10 +360,13 @@ class PagedEngine:
         return self._pick(rng, logits), pool_k, pool_v
 
     def _decode_fn(self, params, pool_k, pool_v, tables, lengths,
-                   refs, page_pos, active, last_ids, rng):
+                   refs, page_pos, active, last_ids, rng,
+                   work_pages=None, work_refs=None, work_pos=None):
         """One decode step over all slots. Signature shapes depend
         only on pool geometry — never on which slots are live or how
-        pages are shared."""
+        pages are shared. The trailing ``work_*`` operands exist only
+        on the pallas backend (the compacted live-page walk from
+        ``kernel_args()``); the XLA sweep never receives them."""
         cfg, ps = self.cfg, self.page_size
         n_slots = last_ids.shape[0]
 
@@ -357,14 +389,15 @@ class PagedEngine:
         # (dead-slot write target, never referenced), and excluding it
         # keeps the read at exactly the usable capacity, so the
         # dense-geometry control streams exactly max_slots × seq_len
-        refs_t = refs[1:]                       # (P, R)
-        n_lanes = refs_t.shape[1]
-        seg = jnp.where(refs_t >= 0, refs_t, n_slots).reshape(-1)
-        ref_c = jnp.clip(refs_t, 0, n_slots - 1)
-        tok_pos = page_pos[1:, None] * ps + jnp.arange(ps)[None, :]
-        ref_len = jnp.where(refs_t >= 0, lengths[ref_c], -1)
-        visible = tok_pos[:, None, :] <= ref_len[:, :, None]
-        # (P, R, ps) -> broadcast against the (P, g, rep, R, ps) scores
+        if self.decode_backend == "xla":
+            refs_t = refs[1:]                   # (P, R)
+            n_lanes = refs_t.shape[1]
+            seg = jnp.where(refs_t >= 0, refs_t, n_slots).reshape(-1)
+            ref_c = jnp.clip(refs_t, 0, n_slots - 1)
+            tok_pos = page_pos[1:, None] * ps + jnp.arange(ps)[None, :]
+            ref_len = jnp.where(refs_t >= 0, lengths[ref_c], -1)
+            visible = tok_pos[:, None, :] <= ref_len[:, :, None]
+            # (P, R, ps) broadcast against (P, g, rep, R, ps) scores
 
         # this step's write target per slot: the page holding position
         # ``lengths`` — ALWAYS private (shared pages are full prompt
@@ -392,6 +425,18 @@ class PagedEngine:
                         k[:, 0].astype(pk.dtype))
                     new_v = pv.at[w_page, w_off].set(
                         v[:, 0].astype(pv.dtype))
+                if self.decode_backend == "pallas":
+                    # the in-kernel block-table walk: the kernel's
+                    # grid iterates the compacted live-page list and
+                    # fetches pages by table value, so the HBM stream
+                    # is the live context (shared pages once), not
+                    # the pool; (page, lane) partials merge per slot
+                    # in VMEM scratch with the same online-softmax
+                    # combine the sweep runs through segment ops
+                    o = paged_attention(
+                        q, new_k, new_v, work_pages, work_refs,
+                        work_pos, lengths, page_size=ps)
+                    return o.astype(q.dtype), (new_k, new_v)
                 # the pool sweep: each live page attends the queries
                 # of ALL its reference lanes (a gather of the TINY q
                 # tensor into (P, R, H, Dh) — the pool itself is read
@@ -607,6 +652,17 @@ class PagedEngine:
                 starved.append(int(slot))
         return starved
 
+    def _kernel_operands(self) -> tuple:
+        """The pallas backend's extra decode/verify operands (the
+        compacted live-page walk); empty on the XLA sweep, so the
+        default backend's jitted call signature — and therefore its
+        compiled artifact — is byte-identical to the pre-kernel
+        engine's."""
+        if self.decode_backend != "pallas":
+            return ()
+        ka = self.tables.kernel_args()
+        return (ka["work_pages"], ka["work_refs"], ka["work_pos"])
+
     def step(self) -> np.ndarray:
         """One decode step over every ACTIVE slot; advances lengths/
         last_ids for those and returns the (max_slots,) token ids
@@ -620,11 +676,13 @@ class PagedEngine:
                     "retire sequences at the cache horizon")
         self._rng, sub = jax.random.split(self._rng)
         args = self.tables.device_args()
+        extra = self._kernel_operands()
         with span("decode_step"):
             tokens, pool_k, pool_v = self._decode_jit(
                 self.params, self.pool["k"], self.pool["v"],
                 args["tables"], args["lengths"], args["refs"],
-                args["page_pos"], args["active"], args["last_ids"], sub)
+                args["page_pos"], args["active"], args["last_ids"],
+                sub, *extra)
             self.pool = {"k": pool_k, "v": pool_v}
             tokens = np.asarray(tokens)
         for slot in np.flatnonzero(active):
@@ -676,13 +734,14 @@ class PagedEngine:
             self.spec_proposed += int((d >= 0).sum())
         self._rng, sub = jax.random.split(self._rng)
         args = self.tables.device_args()
+        extra = self._kernel_operands()
         in_ids = jnp.concatenate(
             [args["last_ids"][:, None], jnp.asarray(drafts)], axis=1)
         with span("spec_verify_step"):
             accept, token, pool_k, pool_v = self._verify_jit(
                 self.params, self.pool["k"], self.pool["v"],
                 args["tables"], args["lengths"], args["refs"],
-                args["page_pos"], args["active"], in_ids, sub)
+                args["page_pos"], args["active"], in_ids, sub, *extra)
             self.pool = {"k": pool_k, "v": pool_v}
             # ONE batched device->host sync for both results (two
             # np.asarray calls would serialize two round-trips into

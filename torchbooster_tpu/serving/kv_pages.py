@@ -499,6 +499,41 @@ class BlockTables:
             "last_ids": jnp.asarray(self.last_ids),
         }
 
+    def kernel_args(self) -> dict:
+        """The pallas decode kernel's COMPACTED live-page walk
+        (ops/paged_attention.py): fixed ``n_pages - 1`` entries —
+        every referenced page once (ascending pool order), then
+        padding pinned to the reserved null page with empty lanes.
+        The kernel's grid walks this list and fetches each entry's
+        pool page by table VALUE; the all-null padding tail is fetched
+        once, so HBM reads track the LIVE entries. Shapes are
+        geometry-only (values change under churn — the same
+        zero-recompile contract as :meth:`device_args`). Cached
+        refcount-0 prefix pages are deliberately absent: no live slot
+        references them, so the kernel never pays for residency —
+        exactly the pool-sweep cost the XLA backend cannot avoid."""
+        n_w = self.n_pages - 1
+        live = np.flatnonzero(self.refcount[1:] > 0) + 1
+        work_pages = np.zeros(n_w, np.int32)
+        work_refs = np.full((n_w, self.n_ref_lanes), -1, np.int32)
+        work_pos = np.zeros(n_w, np.int32)
+        n = len(live)
+        work_pages[:n] = live
+        work_refs[:n] = self.refs[live]
+        work_pos[:n] = self.page_pos[live]
+        return {
+            "work_pages": jnp.asarray(work_pages),
+            "work_refs": jnp.asarray(work_refs),
+            "work_pos": jnp.asarray(work_pos),
+        }
+
+    @property
+    def n_live_pages(self) -> int:
+        """Referenced (refcount > 0) pages — the pallas walk's real
+        per-step page reads, and the live-bytes term of the two-regime
+        roofline (docs/performance.md)."""
+        return int(np.count_nonzero(self.refcount[1:] > 0))
+
     # ---- invariants (tests) --------------------------------------
     def check(self) -> None:
         """Structural invariants, asserted by the churn tests: page 0

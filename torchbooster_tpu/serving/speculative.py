@@ -66,6 +66,7 @@ from torchbooster_tpu.models.gpt import (
     _make_spec_pick,
     _quantize_kv,
 )
+from torchbooster_tpu.ops.paged_attention import paged_attention
 from torchbooster_tpu.serving.kv_pages import NULL_PAGE
 
 # "no proposal" marker in a fixed-width draft row: the verify step
@@ -163,7 +164,9 @@ def make_verify_fn(engine):
     """Build the engine's ONE compiled multi-token verify step.
 
     ``fn(params, pool_k, pool_v, tables, lengths, refs, page_pos,
-    active, in_ids, rng) -> (accept, token, pool_k, pool_v)`` where
+    active, in_ids, rng) -> (accept, token, pool_k, pool_v)`` (the
+    pallas backend appends the ``work_*`` live-page-walk operands —
+    see ``PagedEngine._kernel_operands``) where
     ``in_ids`` is ``(max_slots, 1 + draft_len)``: column 0 each slot's
     pending token, columns 1.. the draft (``NO_DRAFT``-padded). Shapes
     depend ONLY on pool geometry, the model config, and the
@@ -192,7 +195,8 @@ def make_verify_fn(engine):
                                 engine.top_p, jnp.int32)
 
     def verify_fn(params, pool_k, pool_v, tables, lengths, refs,
-                  page_pos, active, in_ids, rng):
+                  page_pos, active, in_ids, rng,
+                  work_pages=None, work_refs=None, work_pos=None):
         n_slots = in_ids.shape[0]
         mp = tables.shape[1]
         positions = lengths[:, None] + jnp.arange(S)     # (B, S)
@@ -221,26 +225,32 @@ def make_verify_fn(engine):
             NULL_PAGE)
         w_off = positions % ps
 
-        # sweep bookkeeping, one (page, lane, position) partial per
-        # element: exactly decode's (page, lane) routing with the S
-        # verify positions riding the query axis — segment ids key
-        # (slot, position) so the combine lands each position's output
-        # in its own row; empty lanes divert to the trash segment
-        refs_t = refs[1:]                                 # (P, R)
-        n_lanes = refs_t.shape[1]
-        ref_c = jnp.clip(refs_t, 0, n_slots - 1)
-        seg = jnp.where(refs_t[:, :, None] >= 0,
-                        ref_c[:, :, None] * S + jnp.arange(S),
-                        n_slots * S).reshape(-1)
-        tok_pos = page_pos[1:, None] * ps + jnp.arange(ps)[None, :]
-        ref_len = jnp.where(refs_t >= 0, lengths[ref_c], -1)
-        # position j's query sees absolute positions <= lengths + j:
-        # j = 0 is exactly the decode step's mask (the pending token
-        # sees itself), each later draft position one more — the
-        # intra-draft causal structure falls out of the same rule
-        visible = (tok_pos[:, None, None, :]
-                   <= ref_len[:, :, None, None] + jnp.arange(S)[None, None, :, None]
-                   ).reshape(-1, n_lanes * S, ps)
+        if engine.decode_backend == "xla":
+            # sweep bookkeeping, one (page, lane, position) partial
+            # per element: exactly decode's (page, lane) routing with
+            # the S verify positions riding the query axis — segment
+            # ids key (slot, position) so the combine lands each
+            # position's output in its own row; empty lanes divert to
+            # the trash segment. (The pallas backend carries the same
+            # (slot, position) state in kernel scratch — the mask rule
+            # below lives in the kernel verbatim.)
+            refs_t = refs[1:]                             # (P, R)
+            n_lanes = refs_t.shape[1]
+            ref_c = jnp.clip(refs_t, 0, n_slots - 1)
+            seg = jnp.where(refs_t[:, :, None] >= 0,
+                            ref_c[:, :, None] * S + jnp.arange(S),
+                            n_slots * S).reshape(-1)
+            tok_pos = page_pos[1:, None] * ps + jnp.arange(ps)[None, :]
+            ref_len = jnp.where(refs_t >= 0, lengths[ref_c], -1)
+            # position j's query sees absolute positions <= lengths +
+            # j: j = 0 is exactly the decode step's mask (the pending
+            # token sees itself), each later draft position one more —
+            # the intra-draft causal structure falls out of the same
+            # rule
+            visible = (tok_pos[:, None, None, :]
+                       <= ref_len[:, :, None, None]
+                       + jnp.arange(S)[None, None, :, None]
+                       ).reshape(-1, n_lanes * S, ps)
 
         def layer(x, inputs):
             bp, pk, pv = inputs
@@ -267,6 +277,17 @@ def make_verify_fn(engine):
                     new_v = pv.at[w_page, w_off].set(
                         v_new.astype(pv.dtype))
                     rk, rv = new_k[1:], new_v[1:]
+                if engine.decode_backend == "pallas":
+                    # the fused kernel pass: all S verify positions
+                    # ride the kernel's query-block axis, so ONE
+                    # in-kernel table walk scores the whole burst —
+                    # the mask tok_pos <= lengths + j and the
+                    # (slot, position) state keying are the kernel's
+                    # own (ops/paged_attention.py)
+                    o = paged_attention(
+                        q, new_k, new_v, work_pages, work_refs,
+                        work_pos, lengths, page_size=ps)
+                    return o.astype(q.dtype), (new_k, new_v)
                 # ONE pool read serves all S positions of every lane:
                 # queries gather to (P, R·S, H, Dh) — the small side —
                 # while the pool stream stays exactly the decode
