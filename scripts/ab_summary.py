@@ -104,7 +104,8 @@ def main() -> None:
     multi_key = ("decode", "decode_int8", "cifar_acc", "comms",
                  "comms_cpu8", "serve_prefix", "serve_prefix_int8",
                  "serve_spec", "serve_spec_int8", "serve_http",
-                 "serve_http_prio", "serve_kernel", "serve_kernel_spec")
+                 "serve_http_prio", "serve_kernel", "serve_kernel_spec",
+                 "obs_trace")
     for name in sorted(attempts):
         if name in METRICS or (name in multi_key and name in latest):
             continue  # multi-key ok rows print below; failures fall through
@@ -233,6 +234,31 @@ def main() -> None:
                     f"| {hit} "
                     f"| {r.get(f'serve_http_{arm}_shed_rate', '—')} "
                     f"| {r.get(f'serve_http_{arm}_decode_compiles', '—')} |")
+
+    # obs_trace row: the request-tracing A/B rendered as an
+    # off-vs-on sub-table (decode tok/s, compile proof) plus the
+    # trace-file verdict (Perfetto-loadable, preempted + cancelled
+    # request tracks present) and the <3% overhead headline
+    e = latest.get("obs_trace")
+    if e is not None:
+        r = e.get("result") or {}
+        print(f"\nobs_trace (overhead "
+              f"{r.get('obs_trace_overhead_pct', '?')}% of limit 3%, "
+              f"zero new compiles "
+              f"{r.get('obs_trace_zero_new_compiles', '?')}, chrome "
+              f"valid {r.get('obs_trace_chrome_valid', '?')} with "
+              f"preempted/cancelled tracks "
+              f"{r.get('obs_trace_has_preempted_track', '?')}/"
+              f"{r.get('obs_trace_has_cancelled_track', '?')}, "
+              f"verdict ok={r.get('obs_trace_ok', '?')}):")
+        print("| arm | decode tok/s | decode/prefill compiles |")
+        print("|---|---|---|")
+        for arm in ("off", "on"):
+            print(f"| tracing {arm} "
+                  f"| {r.get(f'obs_trace_tok_s_{arm}', '—')} "
+                  f"| {r.get(f'obs_trace_decode_compiles_{arm}', '—')}"
+                  f"/{r.get(f'obs_trace_prefill_compiles_{arm}', '—')}"
+                  " |")
 
     # comms rows: bytes-moved + step-time deltas across the gradient
     # sync arms, rendered as a compact sub-table (one row per arm)

@@ -40,10 +40,11 @@ RULE_ID = "config-doc-drift"
 CONFIG_REL = "torchbooster_tpu/config.py"
 DOC_REL = "docs/config.md"
 
-# documented YAML block name -> config class. "frontend" is the
-# serving.frontend SUB-block — docs/config.md documents it as a
-# standalone fence precisely so this rule checks its keys both ways
-# (a nested fence's sub-sub-keys are invisible to the reverse walk).
+# documented YAML block name -> config class. "frontend"/"tracing" are
+# the serving.frontend / observability.tracing SUB-blocks —
+# docs/config.md documents each as a standalone fence precisely so
+# this rule checks their keys both ways (a nested fence's sub-sub-keys
+# are invisible to the reverse walk).
 BLOCKS = {
     "env": "EnvConfig",
     "loader": "LoaderConfig",
@@ -54,6 +55,7 @@ BLOCKS = {
     "frontend": "FrontendConfig",
     "comms": "CommsConfig",
     "observability": "ObservabilityConfig",
+    "tracing": "TracingConfig",
 }
 
 _FENCE = re.compile(r"^```yaml\s*$")

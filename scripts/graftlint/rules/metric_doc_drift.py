@@ -1,0 +1,198 @@
+"""metric-doc-drift rule: metric names vs docs/observability.md.
+
+The observability doc's "what is instrumented" story is the contract
+dashboards and alert rules are written against — and PRs 2/5/7/10 each
+grew the metric surface by hand (``serving_*`` families, the batcher's
+stable metric-dict keys, SLO quantile gauges) with nothing checking
+the doc kept up. An unlisted series is a dashboard nobody can build;
+a doc'd name the code dropped is an alert that silently never fires.
+
+Both directions are checked statically (AST + two fenced catalogs in
+docs/observability.md; nothing is imported):
+
+- **registry series**: every first-argument string literal of a
+  ``.counter("name", ...)`` / ``.gauge(...)`` / ``.histogram(...)``
+  call under ``torchbooster_tpu/`` must appear in the doc's
+  ```` ```metrics-registry ```` fence (one name per line), and every
+  fence line must correspond to such a call site;
+- **batcher metric keys**: every string key of the dict literals the
+  batcher's metrics surface builds (``ContinuousBatcher._metrics`` and
+  the stable-key empty-trace return in ``run``) must appear in the
+  ```` ```metrics-batcher-keys ```` fence, and vice versa.
+
+The fenced catalogs make the reverse direction deterministic — the
+same both-ways shape as ``config-doc-drift``, anchored to explicit
+lint-checked blocks instead of guessing which backticked prose tokens
+were meant as metric names.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+
+from scripts.graftlint.core import Finding, Rule
+
+RULE_ID = "metric-doc-drift"
+
+PACKAGE_REL = "torchbooster_tpu"
+BATCHER_REL = "torchbooster_tpu/serving/batcher.py"
+DOC_REL = "docs/observability.md"
+
+_REGISTRY_METHODS = {"counter", "gauge", "histogram"}
+
+_FENCE = re.compile(r"^```(?P<tag>metrics-registry|metrics-batcher-keys)\s*$")
+_FENCE_END = re.compile(r"^```\s*$")
+
+
+def registry_series(package: Path, repo: Path) -> dict[str, tuple[str, int]]:
+    """``{series name: (rel path, lineno)}`` for every
+    ``.counter/.gauge/.histogram("name", ...)`` call under the
+    package (first occurrence wins the anchor)."""
+    out: dict[str, tuple[str, int]] = {}
+    for path in sorted(package.rglob("*.py")):
+        try:
+            tree = ast.parse(path.read_text())
+        except SyntaxError:
+            continue  # the syntax-error meta rule owns this
+        rel = path.relative_to(repo).as_posix()
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _REGISTRY_METHODS
+                    and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)):
+                continue
+            name = node.args[0].value
+            out.setdefault(name, (rel, node.lineno))
+    return out
+
+
+def batcher_keys(batcher_path: Path) -> dict[str, int]:
+    """``{key: lineno}`` for every string key of every dict literal
+    inside ``ContinuousBatcher._metrics`` / ``run`` — the batcher's
+    stable metrics-dict surface (the per-class sub-dicts included)."""
+    tree = ast.parse(batcher_path.read_text())
+    out: dict[str, int] = {}
+    for cls in ast.walk(tree):
+        if not (isinstance(cls, ast.ClassDef)
+                and cls.name == "ContinuousBatcher"):
+            continue
+        for fn in cls.body:
+            if not (isinstance(fn, ast.FunctionDef)
+                    and fn.name in ("_metrics", "run")):
+                continue
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Dict):
+                    continue
+                for key in node.keys:
+                    if isinstance(key, ast.Constant) \
+                            and isinstance(key.value, str):
+                        out.setdefault(key.value, key.lineno)
+    return out
+
+
+def doc_catalogs(doc_text: str) -> dict[str, dict[str, int]]:
+    """``{fence tag: {name: doc lineno}}`` from the two catalog
+    fences (one name per line; blanks and ``#`` comments skipped)."""
+    out: dict[str, dict[str, int]] = {
+        "metrics-registry": {}, "metrics-batcher-keys": {}}
+    lines = doc_text.splitlines()
+    i = 0
+    while i < len(lines):
+        match = _FENCE.match(lines[i])
+        if match is None:
+            i += 1
+            continue
+        tag = match.group("tag")
+        i += 1
+        while i < len(lines) and not _FENCE_END.match(lines[i]):
+            name = lines[i].strip()
+            if name and not name.startswith("#"):
+                out[tag].setdefault(name, i + 1)  # 1-based lineno
+            i += 1
+        i += 1
+    return out
+
+
+class MetricDocDriftRule(Rule):
+    id = RULE_ID
+    summary = ("registry series names and batcher metric keys must "
+               "agree with docs/observability.md's catalogs both ways")
+    doc = """\
+Why: the observability doc is the contract Prometheus dashboards and
+alert rules are written against. A registry series or batcher metric
+key missing from docs/observability.md is telemetry nobody can
+discover; a doc'd name the code dropped is an alert that silently
+never fires. Every metric-surface PR so far grew both by hand.
+
+Flags:
+- a `.counter("name")`/`.gauge(...)`/`.histogram(...)` first-arg
+  string literal under torchbooster_tpu/ absent from the doc's
+  ```metrics-registry fence — anchored at the registration call;
+- a string dict key of ContinuousBatcher._metrics/run absent from the
+  ```metrics-batcher-keys fence — anchored at the key's line;
+- a fence line matching neither — stale doc, anchored at the doc line.
+
+The fix is almost always the doc: docs/observability.md carries the
+two fenced catalogs precisely so this rule stays green.
+"""
+
+    # test seams: repo-relative paths the rule reads
+    package_rel = PACKAGE_REL
+    batcher_rel = BATCHER_REL
+    doc_rel = DOC_REL
+
+    def check_repo(self, repo: Path) -> list[Finding]:
+        package = repo / self.package_rel
+        batcher_path = repo / self.batcher_rel
+        doc_path = repo / self.doc_rel
+        if not package.is_dir() or not doc_path.exists():
+            return []
+        findings: list[Finding] = []
+        doc_text = doc_path.read_text()
+        doc_lines = doc_text.splitlines()
+        catalogs = doc_catalogs(doc_text)
+
+        series = registry_series(package, repo)
+        listed = catalogs["metrics-registry"]
+        for name, (rel, lineno) in sorted(series.items()):
+            if name not in listed:
+                findings.append(Finding(
+                    self.id, rel, lineno,
+                    f"registry series {name!r} is not listed in "
+                    f"{self.doc_rel}'s ```metrics-registry catalog",
+                    f'"{name}"'))
+        for name, lineno in sorted(listed.items()):
+            if name not in series:
+                findings.append(Finding(
+                    self.id, self.doc_rel, lineno,
+                    f"{self.doc_rel} lists registry series {name!r} "
+                    "but nothing under torchbooster_tpu/ registers it "
+                    "— stale catalog line; delete it",
+                    doc_lines[lineno - 1].strip()
+                    if lineno - 1 < len(doc_lines) else ""))
+
+        keys: dict[str, int] = {}
+        if batcher_path.exists():
+            keys = batcher_keys(batcher_path)
+        listed_keys = catalogs["metrics-batcher-keys"]
+        for name, lineno in sorted(keys.items()):
+            if name not in listed_keys:
+                findings.append(Finding(
+                    self.id, self.batcher_rel, lineno,
+                    f"batcher metric key {name!r} is not listed in "
+                    f"{self.doc_rel}'s ```metrics-batcher-keys "
+                    "catalog",
+                    f'"{name}"'))
+        for name, lineno in sorted(listed_keys.items()):
+            if name not in keys:
+                findings.append(Finding(
+                    self.id, self.doc_rel, lineno,
+                    f"{self.doc_rel} lists batcher metric key "
+                    f"{name!r} but the batcher's metrics surface has "
+                    "no such key — stale catalog line; delete it",
+                    doc_lines[lineno - 1].strip()
+                    if lineno - 1 < len(doc_lines) else ""))
+        return findings

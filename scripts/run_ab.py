@@ -128,6 +128,14 @@ QUEUE: list[tuple[str, str, dict, int]] = [
     # TTFT beats FCFS under contention)
     ("serve_http", "serve_http", {}, 1800),
     ("serve_http_prio", "serve_http", {"BENCH_HTTP_PRIO": "1"}, 1800),
+    # request-scoped tracing (the PR-10 observability tentpole A/B):
+    # the serve_http workload driven tracing-off vs tracing-on in one
+    # run — decode tok/s overhead must stay < 3% with zero new
+    # compiles (the sentinel's jit-cache observable), and the tracing
+    # arm must leave a Perfetto-loadable Chrome trace containing at
+    # least one preempted and one cancelled request track
+    # (bench.bench_obs_trace; obs_trace_ok is the verdict bit)
+    ("obs_trace", "obs_trace", {}, 1500),
     # recipe accuracy on chip (VERDICT r4 #3): the shipped ResNet
     # CIFAR recipe end to end, ref hyperparams, 20 epochs — real
     # CIFAR-10 if a binary release is under the dataset root (none in

@@ -56,11 +56,13 @@ def _engine(params, cfg, **kw):
 
 # ---- HTTP plumbing helpers ------------------------------------------
 
-async def _post(port, path, payload):
+async def _post(port, path, payload, headers=None):
     reader, writer = await asyncio.open_connection("127.0.0.1", port)
     body = json.dumps(payload).encode()
+    extra = "".join(f"{k}: {v}\r\n"
+                    for k, v in (headers or {}).items())
     writer.write(
-        f"POST {path} HTTP/1.1\r\nHost: t\r\n"
+        f"POST {path} HTTP/1.1\r\nHost: t\r\n{extra}"
         f"Content-Length: {len(body)}\r\n\r\n".encode() + body)
     await writer.drain()
     return reader, writer
@@ -101,12 +103,12 @@ async def _stream_completion(port, payload):
     return status, headers, events
 
 
-async def _unary(port, path, payload):
-    reader, writer = await _post(port, path, payload)
-    status, headers = await _read_head(reader)
+async def _unary(port, path, payload, headers=None):
+    reader, writer = await _post(port, path, payload, headers)
+    status, hdrs = await _read_head(reader)
     body = json.loads(await reader.read())
     writer.close()
-    return status, headers, body
+    return status, hdrs, body
 
 
 async def _get(port, path):

@@ -27,6 +27,8 @@ from scripts.graftlint.core import Suppression, scan        # noqa: E402
 from scripts.graftlint.rules import ALL_RULES, RULES_BY_ID  # noqa: E402
 from scripts.graftlint.rules.config_doc_drift import (      # noqa: E402
     ConfigDocDriftRule)
+from scripts.graftlint.rules.metric_doc_drift import (      # noqa: E402
+    MetricDocDriftRule)
 
 
 def _scan_fixture(tmp_path: Path, source: str, rule_id: str,
@@ -1053,6 +1055,141 @@ def test_config_doc_drift_live_rule_is_anchored_to_real_files():
     rule = RULES_BY_ID["config-doc-drift"]
     assert (REPO / rule.config_rel).exists()
     assert (REPO / rule.doc_rel).exists()
+
+
+# =========================================================================
+# metric-doc-drift
+# =========================================================================
+
+def _write_metric_fixture(tmp_path: Path, pkg_src: str,
+                          batcher_src: str, doc_src: str):
+    pkg = tmp_path / "pkg"
+    pkg.mkdir(parents=True, exist_ok=True)
+    (pkg / "mod.py").write_text(textwrap.dedent(pkg_src))
+    (pkg / "batcher.py").write_text(textwrap.dedent(batcher_src))
+    (tmp_path / "obs.md").write_text(textwrap.dedent(doc_src))
+    rule = MetricDocDriftRule()
+    rule.package_rel = "pkg"
+    rule.batcher_rel = "pkg/batcher.py"
+    rule.doc_rel = "obs.md"
+    return rule
+
+
+_METRIC_BATCHER_SRC = """\
+    class ContinuousBatcher:
+        def _metrics(self, s):
+            return {"decode_tok_s": 1.0, "brand_new_key": 2,
+                    "classes": {}}
+
+        def run(self, requests):
+            return {"decode_tok_s": 0.0, "brand_new_key": 0,
+                    "classes": {}}
+    """
+
+
+def test_metric_doc_drift_positive_both_directions(tmp_path):
+    rule = _write_metric_fixture(tmp_path, """\
+        reg.counter("serving_new_total", "fresh and undocumented")
+        reg.gauge("serving_listed_gauge", "doc'd")
+        """, _METRIC_BATCHER_SRC, """\
+        Catalogs:
+
+        ```metrics-registry
+        serving_listed_gauge
+        serving_ghost_total
+        ```
+
+        ```metrics-batcher-keys
+        decode_tok_s
+        classes
+        dead_key
+        ```
+        """)
+    findings = rule.check_repo(tmp_path)
+    messages = [f.message for f in findings]
+    assert any("serving_new_total" in m and "not listed" in m
+               for m in messages)
+    assert any("serving_ghost_total" in m and "stale" in m
+               for m in messages)
+    assert any("brand_new_key" in m and "not listed" in m
+               for m in messages)
+    assert any("dead_key" in m and "stale" in m for m in messages)
+    assert len(findings) == 4
+    ghost = next(f for f in findings
+                 if "serving_ghost_total" in f.message)
+    assert ghost.path == "obs.md" and ghost.line == 5
+    fresh = next(f for f in findings
+                 if "serving_new_total" in f.message)
+    assert fresh.path == "pkg/mod.py" and fresh.line == 1
+
+
+def test_metric_doc_drift_near_miss_in_sync_and_non_literals(tmp_path):
+    """Agreeing catalogs stay silent; computed metric names (the
+    device-gauge f-string idiom) and fence comments are ignored."""
+    rule = _write_metric_fixture(tmp_path, """\
+        reg.counter("serving_ok_total", "doc'd")
+        name = "computed"
+        reg.gauge(f"device_{name}")        # not a literal: invisible
+        reg.histogram(name)                # ditto
+        """, _METRIC_BATCHER_SRC.replace('"brand_new_key": 2,', '')
+        .replace('"brand_new_key": 0,', ''), """\
+        ```metrics-registry
+        # a comment line, ignored
+        serving_ok_total
+        ```
+
+        ```metrics-batcher-keys
+        decode_tok_s
+        classes
+        ```
+        """)
+    assert not rule.check_repo(tmp_path)
+
+
+def test_metric_doc_drift_suppression_round_trip(tmp_path):
+    rule = _write_metric_fixture(tmp_path, """\
+        reg.counter("serving_internal_total", "deliberately unlisted")
+        """, _METRIC_BATCHER_SRC.replace('"brand_new_key": 2,', '')
+        .replace('"brand_new_key": 0,', ''), """\
+        ```metrics-registry
+        ```
+
+        ```metrics-batcher-keys
+        decode_tok_s
+        classes
+        ```
+        """)
+    bare = scan([rule], paths=[], repo=tmp_path,
+                suppression_path=tmp_path / "absent.txt",
+                check_repo=True)
+    assert len(bare.findings) == 1
+    sup = tmp_path / "sup.txt"
+    sup.write_text(
+        "# internal-only series, deliberately out of the catalog\n"
+        "metric-doc-drift pkg/mod.py:serving_internal_total\n")
+    silenced = scan([rule], paths=[], repo=tmp_path,
+                    suppression_path=sup, check_stale=True,
+                    check_repo=True)
+    assert not silenced.findings, \
+        "\n".join(f.render() for f in silenced.findings)
+
+
+def test_metric_doc_drift_live_rule_is_anchored_to_real_files():
+    """The registered instance must point at the real package, the
+    real batcher module, and the real doc page — and the doc must
+    carry both catalog fences (deleting one would silently void that
+    direction)."""
+    from scripts.graftlint.rules.metric_doc_drift import doc_catalogs
+
+    rule = RULES_BY_ID["metric-doc-drift"]
+    assert (REPO / rule.package_rel).is_dir()
+    assert (REPO / rule.batcher_rel).exists()
+    assert (REPO / rule.doc_rel).exists()
+    catalogs = doc_catalogs((REPO / rule.doc_rel).read_text())
+    assert catalogs["metrics-registry"], "registry catalog fence gone"
+    assert catalogs["metrics-batcher-keys"], "batcher catalog fence gone"
+    # the live catalogs carry this PR's additions
+    assert "serving_slo_ttft_quantile" in catalogs["metrics-registry"]
 
 
 # =========================================================================

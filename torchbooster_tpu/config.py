@@ -825,6 +825,52 @@ class CommsConfig(BaseConfig):
 
 
 @dataclass
+class TracingConfig(BaseConfig):
+    """Request-scoped tracing switch (torchbooster_tpu/observability/
+    tracing.py). Nested under ``observability:`` as its ``tracing:``
+    sub-block.
+
+    YAML block::
+
+        observability:
+          tracing:
+            enabled: false             # per-request lifecycle events
+            ring_size: 8192            # bounded event ring (oldest drop)
+            trace_path: ""             # '' = no JSONL trace file on close
+            chrome_path: ""            # '' = no Chrome trace file on close
+
+    ``enabled: false`` (the default) leaves the serving batcher's
+    metric values and compiled artifacts bit-for-bit unchanged — the
+    tracer is one branch per emit site and stamps its own monotonic
+    clock. ``make()`` builds the
+    :class:`~torchbooster_tpu.observability.tracing.RequestTracer`
+    (pass it to ``ContinuousBatcher(tracer=...)``); ``export(tracer)``
+    writes ``trace_path`` (JSONL) / ``chrome_path`` (Chrome
+    trace-event JSON, opens directly in Perfetto) when set."""
+
+    enabled: bool = False
+    ring_size: int = 8192
+    trace_path: str = ""               # JSONL event dump on export()
+    chrome_path: str = ""              # Chrome trace dump on export()
+
+    def make(self) -> Any:
+        from torchbooster_tpu.observability.tracing import RequestTracer
+
+        return RequestTracer(enabled=self.enabled,
+                             ring_size=self.ring_size)
+
+    def export(self, tracer: Any) -> list:
+        """Write the configured trace file(s) from ``tracer``'s ring;
+        returns the paths written (empty when both paths are '')."""
+        written = []
+        if self.trace_path:
+            written.append(tracer.write_jsonl(self.trace_path))
+        if self.chrome_path:
+            written.append(tracer.write_chrome(self.chrome_path))
+        return written
+
+
+@dataclass
 class ObservabilityConfig(BaseConfig):
     """Telemetry switch + exporter wiring (torchbooster_tpu/
     observability). No reference analogue — the reference's profiling
@@ -839,17 +885,25 @@ class ObservabilityConfig(BaseConfig):
           prom_path: logs/metrics.prom         # '' disables Prometheus
           cadence_s: 10                        # export tick
           on_recompile: warn                   # ignore | warn | raise
+          tracing:                             # request-scoped tracing
+            enabled: false
 
     ``make()`` returns an :class:`~torchbooster_tpu.observability.
     Observability` session handle (context-manager: flushes exporters
     on exit). With ``enabled: false`` the handle is inert and every
-    instrumented call site in the stack stays a single branch."""
+    instrumented call site in the stack stays a single branch.
+    ``tracing`` is the per-request trace sub-block
+    (:class:`TracingConfig` — build its tracer with
+    ``conf.observability.tracing.make()`` and hand it to the serving
+    batcher)."""
 
     enabled: bool = False
     jsonl_path: str = ""
     prom_path: str = ""
     cadence_s: float = 10.0
     on_recompile: str = "warn"         # ignore | warn | raise
+    tracing: TracingConfig = dataclasses.field(
+        default_factory=TracingConfig)  # request-scoped tracing
 
     def make(self) -> Any:
         from torchbooster_tpu import observability as obs
@@ -915,6 +969,7 @@ __all__ = [
     "OptimizerConfig",
     "SchedulerConfig",
     "ServingConfig",
+    "TracingConfig",
     "do_include",
     "parse_sweep",
     "read_lines",

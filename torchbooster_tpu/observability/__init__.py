@@ -20,7 +20,13 @@ that ship it all on a cadence thread.
 - :mod:`device`    — HBM gauges from ``memory_stats()``, XLA
   ``cost_analysis`` FLOP cross-checks for bench MFU denominators;
 - :mod:`export`    — JSONL event log + Prometheus text snapshots on a
-  background cadence thread.
+  background cadence thread;
+- :mod:`tracing`   — per-request lifecycle events on a bounded sink,
+  exported as JSONL / Chrome trace-event JSON (one Perfetto track per
+  request, one per engine step kind);
+- :mod:`flight`    — always-on fixed-size ring of per-step engine
+  records (provably bounded memory) + a stall/recompile watchdog,
+  dumped by the front door when the pump dies and on demand.
 
 Everything is OFF by default: importing this package (or the modules
 it instruments) configures nothing, starts no threads, and adds one
@@ -41,6 +47,9 @@ from torchbooster_tpu.observability.export import (
     MetricsExporter,
     prometheus_text,
 )
+from torchbooster_tpu.observability.flight import (
+    FlightRecorder,
+)
 from torchbooster_tpu.observability.recompile import (
     RecompileError,
     RecompileSentinel,
@@ -59,13 +68,18 @@ from torchbooster_tpu.observability.spans import (
     span_events_subscribe,
     trace,
 )
+from torchbooster_tpu.observability.tracing import (
+    RequestTracer,
+    write_chrome_trace,
+)
 
 __all__ = [
-    "Counter", "Gauge", "Histogram", "JsonlExporter", "MetricsExporter",
-    "Observability", "RecompileError", "RecompileSentinel", "Registry",
-    "annotate", "cost_analysis", "enable", "flop_check", "get_registry",
+    "Counter", "FlightRecorder", "Gauge", "Histogram", "JsonlExporter",
+    "MetricsExporter", "Observability", "RecompileError",
+    "RecompileSentinel", "Registry", "RequestTracer", "annotate",
+    "cost_analysis", "enable", "flop_check", "get_registry",
     "prometheus_text", "record_memory_gauges", "set_enabled", "span",
-    "span_events_subscribe", "trace", "xla_flops",
+    "span_events_subscribe", "trace", "write_chrome_trace", "xla_flops",
 ]
 
 

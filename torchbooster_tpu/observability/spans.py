@@ -26,6 +26,7 @@ docs/observability.md).
 """
 from __future__ import annotations
 
+import os
 import threading
 import time
 from typing import Any, Callable
@@ -38,6 +39,21 @@ __all__ = ["annotate", "span", "span_events_subscribe", "trace"]
 
 
 _tls = threading.local()
+
+# stamped once (re-reading os.getpid() per span close would cost a
+# syscall on a path serving pumps hit thousands of times a second);
+# forked workers restamp via the at-fork hook so their span events
+# land on their OWN Chrome-trace process track, not the parent's
+_PID = os.getpid()
+
+
+def _restamp_pid() -> None:
+    global _PID
+    _PID = os.getpid()
+
+
+if hasattr(os, "register_at_fork"):       # absent on non-posix
+    os.register_at_fork(after_in_child=_restamp_pid)
 
 # event sinks: callables receiving one dict per closed span
 _sinks: list[Callable[[dict], None]] = []
@@ -110,12 +126,20 @@ class _Span:
                 dur, name=self.name)
         # skip event construction entirely when nothing subscribed
         # (Prometheus-only / LogCallback-only sessions): the benign
-        # unlocked truthiness read keeps sink-less span close cheap
+        # unlocked truthiness read keeps sink-less span close cheap.
+        # The event is a VALID Chrome trace event (ph/pid/tid +
+        # microsecond ts/dur on the perf_counter timebase — the same
+        # timebase tracing.py's request events use, so one
+        # write_chrome_trace call merges both onto one timeline);
+        # dur_s/ok/path/depth ride along for the JSONL readers.
         if _sinks:
             _emit({"event": "span", "name": self.name,
                    "path": "/".join((*_tls.stack, self.name)),
                    "depth": self._depth, "dur_s": round(dur, 6),
-                   "ts": time.time(), "ok": exc[0] is None})
+                   "ph": "X", "cat": "span", "pid": _PID,
+                   "tid": threading.get_ident(),
+                   "ts": int(self._t0 * 1e6), "dur": int(dur * 1e6),
+                   "ok": exc[0] is None})
 
 
 def span(name: str, registry: Registry | None = None):

@@ -58,6 +58,7 @@ turns the engine's zero-recompile contract into a runtime guard
 from __future__ import annotations
 
 import time
+import uuid
 from collections import deque
 from dataclasses import dataclass, field
 
@@ -67,7 +68,12 @@ from torchbooster_tpu.observability import (
     RecompileSentinel,
     get_registry,
 )
+from torchbooster_tpu.observability.flight import (
+    FlightRecorder,
+    step_kind_code,
+)
 from torchbooster_tpu.observability.recompile import POLICIES
+from torchbooster_tpu.observability.tracing import RequestTracer
 from torchbooster_tpu.serving.engine import PagedEngine
 from torchbooster_tpu.serving.frontend.scheduler import (
     FCFSPolicy,
@@ -100,6 +106,10 @@ class Request:
     priority: str = ""
     deadline_ms: float | None = None
     arrival_time: float | None = None
+    # stable identity for tracing and the HTTP surface: auto-generated
+    # when empty; the front door honors a client X-Request-Id header
+    # by passing it through here
+    request_id: str = ""
     # filled by the batcher
     tokens: list = field(default_factory=list)
     admitted_at: float | None = None
@@ -129,6 +139,12 @@ class Request:
             raise ValueError(
                 f"arrival_time must be a non-negative timestamp, got "
                 f"{self.arrival_time}")
+        if not isinstance(self.request_id, str):
+            raise TypeError(
+                f"request_id must be a str ('' = auto-generate), got "
+                f"{type(self.request_id).__name__}")
+        if not self.request_id:
+            self.request_id = "req-" + uuid.uuid4().hex[:16]
         # the ORIGINAL prompt length: preemption folds generated tokens
         # into ``prompt`` for the re-prefill, so the true context length
         # is base_len + len(tokens) — counting from the grown prompt
@@ -207,7 +223,9 @@ class ContinuousBatcher:
 
     def __init__(self, engine: PagedEngine, clock=time.perf_counter,
                  on_recompile: str = "warn",
-                 policy: SchedulerPolicy | None = None):
+                 policy: SchedulerPolicy | None = None,
+                 tracer: RequestTracer | None = None,
+                 flight: FlightRecorder | None = None):
         # the zero-recompile contract as a RUNTIME guard, not just a
         # test assert: every run() watches the decode jit cache
         # (observability/recompile.py); policy ignore | warn | raise —
@@ -221,8 +239,25 @@ class ContinuousBatcher:
             raise TypeError(
                 f"policy must be a SchedulerPolicy (frontend."
                 f"scheduler), got {type(policy).__name__}")
+        if tracer is not None and not isinstance(tracer, RequestTracer):
+            raise TypeError(
+                f"tracer must be an observability.tracing."
+                f"RequestTracer, got {type(tracer).__name__}")
+        if flight is not None and not isinstance(flight, FlightRecorder):
+            raise TypeError(
+                f"flight must be an observability.flight."
+                f"FlightRecorder, got {type(flight).__name__}")
         self.on_recompile = on_recompile
         self.policy = policy if policy is not None else FCFSPolicy()
+        # request-scoped tracing: disabled-by-default sink — emits are
+        # one branch when off, and the tracer stamps its OWN monotonic
+        # clock, never this batcher's injectable one, so tracing
+        # on/off leaves every metric value bit-for-bit identical.
+        # The flight recorder is ALWAYS on (fixed-size ring, provably
+        # bounded bytes): one row write per step() from values this
+        # loop already holds.
+        self.tracer = tracer if tracer is not None else RequestTracer()
+        self.flight = flight if flight is not None else FlightRecorder()
         self.engine = engine
         self.clock = clock
         # usable pool capacity in tokens (page 0 is the reserved null)
@@ -454,6 +489,19 @@ class ContinuousBatcher:
                 "slo_tpot_rate": reg.gauge(
                     "serving_slo_tpot_hit_rate",
                     "TPOT deadline hit rate over this run (per class)"),
+                # LIVE client-facing quantiles from the session
+                # reservoirs (labels cls + q=p50|p99), refreshed on
+                # every completion so the Prometheus scrape can plot
+                # the SLO dashboard mid-run instead of waiting for the
+                # final session summary
+                "slo_ttft_q": reg.gauge(
+                    "serving_slo_ttft_quantile",
+                    "per-class TTFT quantile over the session "
+                    "reservoir (labels cls, q)"),
+                "slo_tpot_q": reg.gauge(
+                    "serving_slo_tpot_quantile",
+                    "per-class TPOT quantile over the session "
+                    "reservoir (labels cls, q)"),
             })
         self._inst = inst
         s = _Session(self)
@@ -498,6 +546,10 @@ class ContinuousBatcher:
             s.sample(s.ttft, req.first_token_at - req.arrival)
             inst["ttft"].observe(req.first_token_at - req.arrival)
         self.engine.retire(slot)
+        if self.tracer.enabled:
+            self.tracer.emit(req.request_id, "retired",
+                             reason=req.finish_reason or "",
+                             n_tokens=len(req.tokens))
         cs = self._class_stats(req)
         if cs is None:
             return
@@ -513,6 +565,21 @@ class ContinuousBatcher:
             inst["slo_tpot"].observe(tpot, cls=cls.name)
         else:
             tpot = None
+        # refresh the live per-class quantile gauges from the bounded
+        # reservoirs — one np.percentile over <= MAX_SAMPLES host
+        # floats per COMPLETION (never per step), so the exporters
+        # can plot p50/p99 TTFT/TPOT mid-session
+        q50, q99 = np.percentile(
+            np.asarray(cs["ttft"], np.float64), [50, 99]).tolist()
+        inst["slo_ttft_q"].set(round(q50, 6), cls=cls.name, q="p50")
+        inst["slo_ttft_q"].set(round(q99, 6), cls=cls.name, q="p99")
+        if cs["tpot"]:
+            q50, q99 = np.percentile(
+                np.asarray(cs["tpot"], np.float64), [50, 99]).tolist()
+            inst["slo_tpot_q"].set(round(q50, 6), cls=cls.name,
+                                   q="p50")
+            inst["slo_tpot_q"].set(round(q99, 6), cls=cls.name,
+                                   q="p99")
         deadline = self.policy.ttft_deadline_s(req)
         if deadline is not None:
             hit = ttft <= deadline
@@ -528,24 +595,40 @@ class ContinuousBatcher:
             inst["slo_hit" if hit else "slo_miss"].inc(
                 cls=cls.name, kind="tpot")
 
-    def _maybe_stop(self, slot: int, token: int) -> None:
+    def _maybe_stop(self, slot: int, token: int,
+                    finish: bool = True) -> bool:
+        """Append ``token`` and evaluate the stop conditions. Returns
+        True when the request is done; ``finish=False`` defers the
+        actual :meth:`_finish_request` to the caller — the spec arm
+        emits its whole-burst trace event first so ``retired`` stays
+        the LAST event on a request's timeline."""
         s = self._s
         req = s.live[slot]
         req.tokens.append(int(token))
         if req.first_token_at is None:
             req.first_token_at = self.clock() - s.t0
+            if self.tracer.enabled:
+                self.tracer.emit(
+                    req.request_id, "first_token",
+                    ttft_s=round(req.first_token_at - req.arrival, 6))
         hit_eos = req.eos_id is not None and token == req.eos_id
         full = (req.base_len + len(req.tokens)
                 >= self.engine.cfg.seq_len)
         if hit_eos or len(req.tokens) >= req.max_new_tokens or full:
             req.finish_reason = "stop" if hit_eos else "length"
-            self._finish_request(slot)
+            if finish:
+                self._finish_request(slot)
+            return True
+        return False
 
     def _cancel_request(self, req: Request, events: list) -> None:
         s = self._s
         req.cancelled = True
         req.finished_at = self.clock() - s.t0
         req.finish_reason = "cancelled"
+        if self.tracer.enabled:
+            self.tracer.emit(req.request_id, "cancelled",
+                             n_tokens=len(req.tokens))
         s.n_cancelled += 1
         s.new_tokens += len(req.tokens)  # delivered before the cancel
         events.append((req, []))
@@ -582,6 +665,10 @@ class ContinuousBatcher:
         req.shed = True
         req.finished_at = self.clock() - s.t0
         req.finish_reason = "shed"
+        if self.tracer.enabled:
+            self.tracer.emit(req.request_id, "shed",
+                             waited_s=round(req.finished_at
+                                            - req.arrival, 6))
         s.n_shed += 1
         events.append((req, []))
         cs = self._class_stats(req)
@@ -602,20 +689,69 @@ class ContinuousBatcher:
         spec burst is one event; shed/cancelled requests appear once
         with no tokens) — which the async front door streams out as
         SSE. ``run()`` ignores them (requests accumulate their own
-        ``tokens``)."""
+        ``tokens``).
+
+        Every iteration also lands ONE row in the (always-on, fixed
+        size) flight recorder — step kind, slots/pages/queue, tokens,
+        accept rate, wall time from the dts this loop already
+        measured, and a recompile flag from the engine's jit-cache
+        sizes (the sentinel's observable) — and, when tracing is
+        enabled, the per-request lifecycle events tracing.py
+        documents. Neither reads the device or this batcher's
+        injectable clock, so metric values are unchanged either
+        way."""
         if self._s is None:
             raise RuntimeError(
                 "no active session: start_session() first (run() "
                 "manages its own)")
         s = self._s
-        now = lambda: self.clock() - s.t0
+        eng = self.engine
+        c0 = (eng.decode_compiles + eng.verify_compiles
+              + eng.prefill_compiles)
+        st = {"wall": 0.0, "prefill": False, "decode": False,
+              "spec": False, "prop": 0, "acc": 0}
         events: list = []
+        try:
+            self._step_body(s, st, events)
+        finally:
+            # record in a finally so the step that KILLS the pump
+            # still lands its (partial) row — the crash dump's last
+            # record must be the fatal step, not the one before it
+            recompiled = (eng.decode_compiles + eng.verify_compiles
+                          + eng.prefill_compiles) > c0
+            self.flight.record(
+                kind=step_kind_code(st["prefill"], st["decode"],
+                                    st["spec"]),
+                slots_live=len(s.live),
+                slots_filling=len(s.filling),
+                pages_live=int(eng.tables.n_live_pages),
+                pages_free=int(eng.tables.n_free_pages),
+                pages_cached=int(eng.tables.n_cached_pages),
+                queue_depth=len(s.queue),
+                tokens=sum(len(toks) for _, toks in events),
+                accept_rate=(st["acc"] / st["prop"]) if st["prop"]
+                else 0.0,
+                wall_s=st["wall"], recompiled=recompiled,
+                inflight=([r.request_id
+                           for r in (*s.filling.values(),
+                                     *s.live.values())]
+                          if recompiled else ()))
+        return events
+
+    def _step_body(self, s: _Session, st: dict,
+                   events: list) -> list:
+        now = lambda: self.clock() - s.t0
         # submits drain BEFORE cancels: a request submitted and then
         # cancelled between two steps must be found in the queue
         while self._inbox_submit:
             req = self._inbox_submit.popleft()
             s.n_seen += 1
             s.queue.append(req)
+            if self.tracer.enabled:
+                self.tracer.emit(req.request_id, "enqueued",
+                                 prompt_len=int(req.base_len),
+                                 priority=req.priority,
+                                 arrival=round(req.arrival, 6))
             cs = self._class_stats(req)
             if cs is not None:
                 cs["n"] += 1
@@ -636,6 +772,7 @@ class ContinuousBatcher:
             req = self.policy.next_admission(pool, now(), self)
             if req is None:
                 break
+            hits0 = self.engine.prefix_hit_pages
             slot = self.engine.admit_begin(req.prompt)
             if slot is None:
                 if self.policy.stop_on_admit_failure:
@@ -647,17 +784,40 @@ class ContinuousBatcher:
             s.admit_order.append(slot)
             s.n_admissions += 1
             self._inst["admissions"].inc()
+            if self.tracer.enabled:
+                self.tracer.emit(
+                    req.request_id, "seated", slot=slot,
+                    prefix_hit_pages=int(
+                        self.engine.prefix_hit_pages - hits0),
+                    readmission=req.admitted_at is not None)
             if req.admitted_at is None:
                 req.admitted_at = now()
         # --- ONE prefill chunk per iteration, interleaved with
         # decode: long prompts stream in while the live slots keep
         # producing tokens ---
         if self.engine.has_pending:
+            # the chunk's slot, read only when tracing will use it
+            # (pending_slots builds a list — not free on the hot loop)
+            fill_slot = (self.engine.pending_slots[0]
+                         if self.tracer.enabled else -1)
             t_chunk = self.clock()
             done = self.engine.prefill_step()
             dt = self.clock() - t_chunk
             self.est_chunk_s = dt if not self.est_chunk_s \
                 else 0.8 * self.est_chunk_s + 0.2 * dt
+            st["prefill"] = True
+            st["wall"] += dt
+            if self.tracer.enabled:
+                # the engine-track slice shares its name with the
+                # serving_prefill_chunk profiler span (spans.py), so
+                # a host trace and a device capture cross-link
+                self.tracer.emit(None, "serving_prefill_chunk",
+                                 dur_s=round(dt, 6), slot=fill_slot)
+                fr = s.filling.get(fill_slot)
+                if fr is not None:
+                    self.tracer.emit(fr.request_id, "prefill_chunk",
+                                     slot=fill_slot,
+                                     dur_s=round(dt, 6))
             if done is not None:
                 slot, first = done
                 req = s.filling.pop(slot)
@@ -688,6 +848,10 @@ class ContinuousBatcher:
             # folded tokens, so the folded count is its excess; a
             # mid-prefill victim has no tokens and folds nothing)
             folded = len(req.prompt) - req.base_len
+            if self.tracer.enabled:
+                self.tracer.emit(req.request_id, "preempted",
+                                 slot=victim,
+                                 fold_tokens=len(req.tokens) - folded)
             req.prompt = np.concatenate(
                 [req.prompt,
                  np.asarray(req.tokens[folded:], np.int32)])
@@ -705,11 +869,23 @@ class ContinuousBatcher:
             # token IN ORDER, so EOS or max_new_tokens mid-burst
             # truncates exactly where sequential decode would have
             # stopped
+            prop0 = self.engine.spec_proposed
+            acc0 = self.engine.spec_accepted
             emitted = self.engine.spec_step()
             dt = self.clock() - t_step
             s.decode_time += dt
             self.est_step_s = dt if not self.est_step_s \
                 else 0.8 * self.est_step_s + 0.2 * dt
+            st["spec"] = True
+            st["wall"] += dt
+            st["prop"] = int(self.engine.spec_proposed - prop0)
+            st["acc"] = int(self.engine.spec_accepted - acc0)
+            if self.tracer.enabled:
+                self.tracer.emit(None, "spec_verify_step",
+                                 dur_s=round(dt, 6),
+                                 slots=len(emitted),
+                                 proposed=st["prop"],
+                                 accepted=st["acc"])
             # a cancel that landed while the step ran drops the whole
             # burst (the slot leaves ``live`` here, before emission)
             self._drain_cancels(events)
@@ -722,16 +898,26 @@ class ContinuousBatcher:
             for slot in sorted(emitted):
                 burst: list[int] = []
                 req = s.live.get(slot)
+                finished = False
                 for tok in emitted[slot]:
-                    if slot not in s.live:
+                    if finished or slot not in s.live:
                         break
                     delivered += 1
                     burst.append(int(tok))
-                    self._maybe_stop(slot, int(tok))
+                    # retirement DEFERRED past the burst event below:
+                    # the per-burst token delta must precede retired
+                    # on the request's trace timeline
+                    finished = self._maybe_stop(slot, int(tok),
+                                                finish=False)
                 if burst:
                     # the whole accepted burst is ONE event — the SSE
                     # contract is one message per pool read's yield
+                    if self.tracer.enabled:
+                        self.tracer.emit(req.request_id, "tokens",
+                                         n=len(burst), spec=True)
                     events.append((req, burst))
+                if finished and slot in s.live:
+                    self._finish_request(slot)
             s.decoded += delivered
             self._inst["tokens"].inc(delivered)
         else:
@@ -740,14 +926,81 @@ class ContinuousBatcher:
             s.decode_time += dt
             self.est_step_s = dt if not self.est_step_s \
                 else 0.8 * self.est_step_s + 0.2 * dt
+            st["decode"] = True
+            st["wall"] += dt
+            if self.tracer.enabled:
+                self.tracer.emit(None, "decode_step",
+                                 dur_s=round(dt, 6),
+                                 slots=len(s.live))
             s.decoded += len(s.live)
             self._inst["tokens"].inc(len(s.live))
             self._drain_cancels(events)
             for slot in list(s.live):
                 req = s.live[slot]
+                # token delta BEFORE the stop-check: retired must be
+                # the last event on the request's trace timeline
+                if self.tracer.enabled:
+                    self.tracer.emit(req.request_id, "tokens", n=1)
                 self._maybe_stop(slot, int(tokens[slot]))
                 events.append((req, [int(tokens[slot])]))
         return events
+
+    def debug_snapshot(self, timeline_tail: int = 20) -> dict:
+        """Live per-request view for the ``/debug/requests`` endpoint:
+        every queued/filling/decoding request's state plus (when
+        tracing is enabled) the tail of its event timeline.
+
+        Must run on the thread that drives :meth:`step` — the front
+        door submits it to the pump executor, so the walk over the
+        session dicts is serialized with the scheduler loop and needs
+        no locks."""
+        s = self._s
+        # ONE pass over the (bounded) ring, then index lookups per
+        # request — a per-request ring scan would make one debug poll
+        # O(ring_size x requests) on the pump thread, which IS the
+        # decode loop's thread
+        timelines: dict[str, list] = {}
+        if self.tracer.enabled:
+            for e in self.tracer.events():
+                rid = e["request_id"]
+                if rid is not None:
+                    timelines.setdefault(rid, []).append(e)
+
+        def view(req: Request, state: str,
+                 slot: int | None = None) -> dict:
+            d = {
+                "request_id": req.request_id, "state": state,
+                "priority": req.priority,
+                "prompt_len": int(req.base_len),
+                "n_tokens": len(req.tokens),
+                "arrival_s": round(req.arrival, 6),
+                "admitted_at_s": None if req.admitted_at is None
+                else round(req.admitted_at, 6),
+                "first_token_at_s": None if req.first_token_at is None
+                else round(req.first_token_at, 6),
+            }
+            if slot is not None:
+                d["slot"] = slot
+            if self.tracer.enabled:
+                evs = timelines.get(req.request_id, [])
+                d["timeline_tail"] = evs[-timeline_tail:]
+            return d
+
+        out: dict = {"active_session": s is not None,
+                     "tracing_enabled": self.tracer.enabled,
+                     "queue_depth": self.queue_depth if s is not None
+                     else len(self._inbox_submit),
+                     "requests": []}
+        if s is None:
+            return out
+        out["session_now_s"] = round(self.clock() - s.t0, 6)
+        for req in s.queue:
+            out["requests"].append(view(req, "queued"))
+        for slot, req in sorted(s.filling.items()):
+            out["requests"].append(view(req, "prefill", slot))
+        for slot, req in sorted(s.live.items()):
+            out["requests"].append(view(req, "decode", slot))
+        return out
 
     def _land(self, s: _Session) -> None:
         """Exception or not, the gauges land on engine truth at exit
@@ -886,6 +1139,12 @@ class ContinuousBatcher:
         self._s = s
         s.n_seen = len(requests)
         s.queue = sorted(requests, key=lambda r: r.arrival)
+        if self.tracer.enabled:
+            for r in s.queue:
+                self.tracer.emit(r.request_id, "enqueued",
+                                 prompt_len=int(r.base_len),
+                                 priority=r.priority,
+                                 arrival=round(r.arrival, 6))
         if self.policy.slo:
             for r in requests:
                 s.per_class[self.policy.cls_of(r).name]["n"] += 1

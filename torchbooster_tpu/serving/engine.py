@@ -775,6 +775,37 @@ class PagedEngine:
             self._drafter.reset(slot)
         self.tables.retire(slot)
 
+    def debug_stats(self) -> dict:
+        """Engine introspection snapshot for ``GET /debug/engine``:
+        pool occupancy, prefix-cache stats, compile counts, backend —
+        host integers only (table bookkeeping and jit cache sizes),
+        never a device read, so a debug poll cannot stall the decode
+        loop."""
+        t = self.tables
+        return {
+            "backend": self.decode_backend,
+            "speculative": self.speculative,
+            "quantized": self.quantized,
+            "page_size": self.page_size,
+            "n_pages": self.n_pages,
+            "max_slots": self.max_slots,
+            "pages_live": int(t.n_live_pages),
+            "pages_free": int(t.n_free_pages),
+            "pages_cached": int(t.n_cached_pages),
+            "pages_available": int(t.n_available_pages),
+            "pending_prefill_chunks": self.pending_chunk_count,
+            "prefill_chunks": self.prefill_chunks,
+            "prefix_hit_pages": self.prefix_hit_pages,
+            "prefix_lookup_pages": self.prefix_lookup_pages,
+            "prefix_hit_rate": round(self.prefix_hit_rate, 4),
+            "spec_steps": self.spec_steps,
+            "spec_proposed": self.spec_proposed,
+            "spec_accepted": self.spec_accepted,
+            "compiles": {"decode": self.decode_compiles,
+                         "prefill": self.prefill_compiles,
+                         "verify": self.verify_compiles},
+        }
+
     @property
     def prefix_hit_rate(self) -> float:
         """Fraction of eligible prompt pages served from the cache."""

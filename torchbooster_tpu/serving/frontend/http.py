@@ -29,9 +29,9 @@ MAX_BODY_BYTES = 8 * 1024 * 1024
 _REASONS = {
     200: "OK", 400: "Bad Request", 404: "Not Found",
     405: "Method Not Allowed", 408: "Request Timeout",
-    413: "Payload Too Large", 429: "Too Many Requests",
-    500: "Internal Server Error", 501: "Not Implemented",
-    503: "Service Unavailable",
+    409: "Conflict", 413: "Payload Too Large",
+    429: "Too Many Requests", 500: "Internal Server Error",
+    501: "Not Implemented", 503: "Service Unavailable",
 }
 
 
@@ -157,11 +157,12 @@ def text_response(status: int, text: str,
     return _head(status, content_type, len(body)) + body
 
 
-def sse_head() -> bytes:
+def sse_head(headers: dict[str, str] | None = None) -> bytes:
     """Response head opening a Server-Sent-Events stream (sent before
-    the first event; unknown length, closed by connection close)."""
+    the first event; unknown length, closed by connection close).
+    ``headers`` ride along (the echoed X-Request-Id)."""
     return _head(200, "text/event-stream",
-                 None, {"Cache-Control": "no-cache"})
+                 None, {"Cache-Control": "no-cache", **(headers or {})})
 
 
 def sse_event(payload: Any) -> bytes:

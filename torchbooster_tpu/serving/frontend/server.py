@@ -9,11 +9,28 @@ asyncio HTTP server exposing
 
 - ``POST /v1/completions`` and ``POST /v1/chat/completions`` —
   OpenAI-dialect JSON, ``stream: true`` for SSE (one event per decoded
-  token, or per accepted speculative burst), request ids, usage
-  accounting, ``finish_reason`` stop/length;
+  token, or per accepted speculative burst), request ids (a client
+  ``X-Request-Id`` header is honored, echoed on the response, and
+  becomes the id tracing files carry), usage accounting,
+  ``finish_reason`` stop/length;
 - ``GET /metrics`` — the telemetry registry's Prometheus exposition
   (the ``serving_*``/``serving_slo_*`` series, scrape-ready);
-- ``GET /healthz`` — liveness + pool occupancy.
+- ``GET /healthz`` — liveness + pool occupancy;
+- ``GET /debug/requests`` — live per-request scheduler state (+ each
+  request's trace-timeline tail when tracing is on);
+- ``GET /debug/engine`` — pool occupancy, prefix-cache stats, compile
+  counts, backend, the flight-recorder tail and its watchdog
+  anomalies;
+- ``GET /debug/trace?id=<request_id>`` — one request's full event
+  list from the tracing ring.
+
+The ``/debug`` reads run ON the pump executor, serialized with
+``batcher.step()`` — introspection can never race the scheduler's
+session dicts, and (being host bookkeeping only) can never stall a
+device dispatch. When the pump DIES, the terminal-error path dumps
+the engine flight recorder (and the request trace, when enabled) to
+``crash_dump_path`` before the exception resurfaces at ``stop()`` —
+the post-mortem survives the process.
 
 The engine never runs on the event loop: a single pump task drives
 ``batcher.step()`` through a one-thread executor (the compiled
@@ -36,7 +53,7 @@ from __future__ import annotations
 import asyncio
 import concurrent.futures
 import time
-import uuid
+from urllib.parse import parse_qs
 
 import numpy as np
 
@@ -114,7 +131,8 @@ class ServingFrontend:
     def __init__(self, batcher: ContinuousBatcher,
                  host: str = "127.0.0.1", port: int = 0, *,
                  codec=None, max_queue: int = 64,
-                 model_name: str = "torchbooster-tpu"):
+                 model_name: str = "torchbooster-tpu",
+                 crash_dump_path: str | None = None):
         if max_queue < 1:
             raise ValueError(f"max_queue must be >= 1, got {max_queue}")
         self.batcher = batcher
@@ -123,6 +141,12 @@ class ServingFrontend:
         self.codec = codec if codec is not None else IdCodec()
         self.max_queue = max_queue
         self.model_name = model_name
+        # pump post-mortem: a PREFIX — the terminal-error path writes
+        # <prefix>.flight.jsonl (the engine ring) and, when tracing is
+        # enabled, <prefix>.trace.json (Chrome trace). None keeps the
+        # dump in memory only (self.last_flight).
+        self.crash_dump_path = crash_dump_path
+        self.last_flight: dict | None = None
         self._server: asyncio.AbstractServer | None = None
         self._pump_task: asyncio.Task | None = None
         self._exec = None
@@ -224,11 +248,32 @@ class ServingFrontend:
                         stream.queue.put_nowait((tokens, done))
         except Exception:
             self._stopping = True
+            # the post-mortem FIRST: persist what the engine was doing
+            # when the pump died, before any handler unwinds state
+            self._crash_dump()
             for stream in list(self._streams.values()):
                 if stream.req.finished_at is None:
                     stream.req.finish_reason = "error"
                 stream.queue.put_nowait(([], True))
             raise
+
+    def _crash_dump(self) -> None:
+        """Terminal-error flight dump: snapshot the engine ring into
+        ``last_flight`` and (when ``crash_dump_path`` is set) write
+        ``<prefix>.flight.jsonl`` + ``<prefix>.trace.json``. Must
+        never raise — a failed dump must not mask the pump's own
+        error."""
+        try:
+            self.last_flight = self.batcher.flight.dump()
+            if self.crash_dump_path:
+                prefix = str(self.crash_dump_path)
+                self.batcher.flight.write_jsonl(
+                    prefix + ".flight.jsonl")
+                if self.batcher.tracer.enabled:
+                    self.batcher.tracer.write_chrome(
+                        prefix + ".trace.json")
+        except Exception:  # noqa: BLE001 — diagnostics only
+            pass
 
     def _register(self, req: Request) -> _Stream:
         stream = _Stream(req)
@@ -262,7 +307,8 @@ class ServingFrontend:
                 return
             if self._stopping:
                 raise HttpError(503, "server is shutting down")
-            route = (request.method, request.path)
+            path, _, query = request.path.partition("?")
+            route = (request.method, path)
             if route == ("POST", "/v1/completions"):
                 await self._completion(request, reader, writer,
                                        chat=False)
@@ -282,17 +328,72 @@ class ServingFrontend:
                     "pages_free": int(eng.tables.n_free_pages),
                     "occupancy": round(self.batcher.occupancy, 4),
                 }))
-            elif request.path in ("/v1/completions",
-                                  "/v1/chat/completions",
-                                  "/metrics", "/healthz"):
+            elif route == ("GET", "/debug/requests"):
+                # serialized with step() on the pump executor: the
+                # snapshot walks the scheduler's session dicts
+                snap = await asyncio.get_running_loop() \
+                    .run_in_executor(self._exec,
+                                     self.batcher.debug_snapshot)
+                writer.write(json_response(200, snap))
+            elif route == ("GET", "/debug/engine"):
+                payload = await asyncio.get_running_loop() \
+                    .run_in_executor(self._exec, self._engine_debug)
+                writer.write(json_response(200, payload))
+            elif route == ("GET", "/debug/trace"):
+                writer.write(json_response(200, self._trace_of(query)))
+            elif path in ("/v1/completions", "/v1/chat/completions",
+                          "/metrics", "/healthz", "/debug/requests",
+                          "/debug/engine", "/debug/trace"):
                 raise HttpError(405,
                                 f"{request.method} not allowed here")
             else:
-                raise HttpError(404, f"no route {request.path}")
+                raise HttpError(404, f"no route {path}")
             await writer.drain()
         except HttpError as err:
             writer.write(error_response(err))
             await writer.drain()
+
+    # ---- introspection -------------------------------------------
+    def _engine_debug(self) -> dict:
+        """The ``/debug/engine`` payload (runs on the pump executor):
+        engine stats + the flight-recorder tail and its watchdog
+        anomalies."""
+        flight = self.batcher.flight
+        return {
+            "engine": self.batcher.engine.debug_stats(),
+            "occupancy": round(self.batcher.occupancy, 4),
+            "queue_depth": self.batcher.queue_depth,
+            "flight": {
+                "n_recorded": flight.n_recorded,
+                "capacity": flight.capacity,
+                "nbytes": flight.nbytes,
+                "records": flight.tail(128),
+                "anomalies": flight.anomaly_log(),
+            },
+        }
+
+    def _trace_of(self, query: str) -> dict:
+        """The ``/debug/trace?id=`` payload: one request's full event
+        list from the tracing ring (a plain deque snapshot — no pump
+        round-trip needed)."""
+        rid = (parse_qs(query).get("id") or [""])[0]
+        if not rid:
+            raise HttpError(400, "pass ?id=<request_id> (ids are in "
+                            "/debug/requests and on X-Request-Id)")
+        tracer = self.batcher.tracer
+        if not tracer.enabled:
+            raise HttpError(
+                404, "tracing is disabled — enable the "
+                "observability.tracing block (or RequestTracer"
+                "(enabled=True)) to record request timelines")
+        events = tracer.events(rid)
+        if not events:
+            raise HttpError(
+                404, f"no trace events for request id {rid!r} (ring "
+                "holds the last "
+                f"{tracer.ring_size} events; known ids are in "
+                "/debug/requests)")
+        return {"request_id": rid, "events": events}
 
     # ---- request construction ------------------------------------
     def _prompt_ids(self, payload: dict, chat: bool) -> np.ndarray:
@@ -331,7 +432,25 @@ class ServingFrontend:
             raise HttpError(400, "prompt tokenizes to nothing")
         return np.asarray(ids, np.int32)
 
-    def _build_request(self, payload: dict, chat: bool) -> Request:
+    @staticmethod
+    def _request_id_of(request) -> str:
+        """The client's ``X-Request-Id`` header, validated — or ``""``
+        so the Request auto-generates one. Honoring the header is what
+        lets a caller correlate its own logs with ``/debug/trace`` and
+        the exported Perfetto tracks."""
+        rid = request.headers.get("x-request-id", "").strip()
+        if not rid:
+            return ""
+        if len(rid) > 128 or not all(
+                (c.isascii() and c.isalnum()) or c in "-_.:"
+                for c in rid):
+            raise HttpError(
+                400, "X-Request-Id must be <= 128 chars of "
+                "[A-Za-z0-9._:-]")
+        return rid
+
+    def _build_request(self, payload: dict, chat: bool,
+                       request_id: str = "") -> Request:
         if not isinstance(payload, dict):
             raise HttpError(400, "body must be a JSON object")
         ids = self._prompt_ids(payload, chat)
@@ -346,6 +465,7 @@ class ServingFrontend:
                 deadline_ms=(float(deadline) if deadline is not None
                              else None),
                 arrival_time=time.time(),
+                request_id=request_id,
             )
         except (TypeError, ValueError) as exc:
             raise HttpError(400, str(exc)) from None
@@ -367,9 +487,25 @@ class ServingFrontend:
     async def _completion(self, request, reader, writer,
                           chat: bool) -> None:
         payload = request.json()
-        req = self._build_request(payload, chat)
+        rid_header = self._request_id_of(request)
+        if rid_header and any(
+                s.req.request_id == rid_header
+                for s in self._streams.values()):
+            # two CONCURRENT requests on one id would interleave
+            # their tracer timelines and Perfetto tracks into one
+            # merged lie — reject the duplicate while the first is
+            # in flight (sequential reuse, e.g. a retry after a
+            # failure, is legitimate and keeps the id's history)
+            raise HttpError(
+                409, f"X-Request-Id {rid_header!r} is already in "
+                "flight; wait for it to finish or pick a fresh id")
+        req = self._build_request(payload, chat, rid_header)
         stream_mode = bool(payload.get("stream"))
-        rid = ("chatcmpl-" if chat else "cmpl-") + uuid.uuid4().hex[:24]
+        # the OpenAI envelope id carries the REQUEST id (client-chosen
+        # via X-Request-Id or auto-generated), so the response, the
+        # /debug/trace query key, and the Perfetto track name all
+        # agree on one identifier
+        rid = ("chatcmpl-" if chat else "cmpl-") + req.request_id
         created = int(req.arrival_time)
         stream = self._register(req)
         # the disconnect watchdog: this dialect sends nothing after
@@ -443,7 +579,8 @@ class ServingFrontend:
                 raise HttpError(500, "engine failure mid-request; "
                                 "see server logs")
             if not head_sent:
-                writer.write(sse_head())
+                writer.write(sse_head(
+                    {"X-Request-Id": req.request_id}))
                 head_sent = True
             if tokens:
                 # one SSE event per decode step's delivery: a single
@@ -491,7 +628,8 @@ class ServingFrontend:
             "model": self.model_name, "choices": [choice],
             "usage": {"prompt_tokens": req.base_len,
                       "completion_tokens": len(tokens),
-                      "total_tokens": req.base_len + len(tokens)}}))
+                      "total_tokens": req.base_len + len(tokens)}},
+            {"X-Request-Id": req.request_id}))
 
 
 __all__ = ["IdCodec", "ServingFrontend", "install_uvloop"]
