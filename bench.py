@@ -62,6 +62,19 @@ serve_http workload: decode tok/s delta < 3%, zero new compiles, and
 a Perfetto-loadable Chrome trace containing preempted + cancelled
 request tracks; BENCH_OBS_TRACE_REQUESTS/RATE/SLOTS/PAGE/PAGES/SEQ/
 LAYERS/KV_HEADS/RUNS/CHROME shape it, BENCH_SKIP_OBS_TRACE skips);
+the replay sub-bench (the loadgen capture/replay round trip: a
+mixed-priority SSE workload served with workload capture off vs on —
+decode tok/s delta < 3%, zero new compiles — then the capture
+replayed in-process at x1 and xN with the report's counts/cancel
+offsets checked against the original trace, plus the
+max-sustainable-x binary search; BENCH_REPLAY_REQUESTS/RATE/SLOTS/
+PAGE/PAGES/SEQ/LAYERS/KV_HEADS/RUNS/SPEED/KIND/CAPTURE shape it,
+BENCH_SKIP_REPLAY skips);
+the replay_http sub-bench (the same workload replayed open-loop over
+real HTTP at xBENCH_REPLAY_SPEED against a live SLO front door —
+client-observed per-class conformance report + the workload
+fingerprint; BENCH_REPLAY_HTTP_TTFT_MS prices the interactive class,
+BENCH_SKIP_REPLAY_HTTP skips);
 the obs sub-bench (telemetry-on vs telemetry-off A/B over the GPT
 step + recompile-sentinel verification; BENCH_SKIP_OBS skips);
 the comms sub-bench (gradient-sync A/B over the GPT step: implicit
@@ -88,6 +101,11 @@ import optax
 
 from torchbooster_tpu.models.resnet import ResNet
 from torchbooster_tpu.ops.losses import cross_entropy
+# the ONE comparability predicate the A/B gates share (scripts/
+# ab_summary.py mirrors it verbatim; tests pin the two together):
+# arms carrying different workload fingerprints must not be compared
+from torchbooster_tpu.serving.loadgen.report import (
+    fingerprints_comparable)
 from torchbooster_tpu.utils import TrainState, make_step
 
 # torch-CPU ResNet-50 fwd+bwd+SGD, measured on this image's host
@@ -1313,6 +1331,285 @@ def bench_obs_trace() -> dict:
     }
 
 
+def _replay_env() -> dict:
+    """The replay sub-benches' shared knob set (one read point so the
+    in-process and HTTP rows can never drift onto different
+    workload/geometry defaults)."""
+    return {
+        "n_req": int(os.environ.get("BENCH_REPLAY_REQUESTS", 12)),
+        "rate": float(os.environ.get("BENCH_REPLAY_RATE", 16.0)),
+        "slots": int(os.environ.get("BENCH_REPLAY_SLOTS", 4)),
+        "page": int(os.environ.get("BENCH_REPLAY_PAGE", 16)),
+        # usable capacity deliberately BELOW the 4-slot worst-case
+        # live demand (4 x 4 pages vs 14 usable) so the replayed
+        # trace exercises real preemptions, like the obs_trace row
+        "n_pages": int(os.environ.get("BENCH_REPLAY_PAGES", 15)),
+        "seq": int(os.environ.get("BENCH_REPLAY_SEQ", 256)),
+        "n_layers": int(os.environ.get("BENCH_REPLAY_LAYERS", 2)),
+        "kv": int(os.environ.get("BENCH_REPLAY_KV_HEADS", 4)),
+        "speed": float(os.environ.get("BENCH_REPLAY_SPEED", 4.0)),
+        "kind": os.environ.get("BENCH_REPLAY_KIND", "poisson"),
+    }
+
+
+def _replay_workload(k: dict):
+    """The mixed-priority workload both replay rows offer: Poisson (or
+    BENCH_REPLAY_KIND) arrivals, 1/3 interactive 2/3 batch, prompts
+    1..2 pages, plus ONE recorded client disconnect after 2 tokens so
+    the round trip proves cancel offsets survive capture -> replay."""
+    from torchbooster_tpu.serving.loadgen import synthesize
+
+    wl = synthesize(
+        k["kind"], n_requests=k["n_req"], rate=k["rate"], seed=0,
+        vocab=50257, prompt_len=(k["page"], 2 * k["page"]),
+        max_new_tokens=(8, 24), classes="interactive:1,batch:2")
+    wl.requests[k["n_req"] // 2].cancel_after_tokens = 2
+    return wl
+
+
+def bench_replay() -> dict:
+    """The loadgen capture/replay round trip (the PR-11 tentpole A/B):
+
+    1. **Capture overhead**: the SAME mixed-priority SSE workload —
+       driven by the loadgen HTTP replay driver itself, so synthetic
+       traffic and captures flow through one driver — served with
+       workload capture OFF vs ON, interleaved alternating order,
+       overhead = min over adjacent pairs (the obs_trace discipline).
+       Acceptance: decode tok/s delta **< 3%** and zero new compiles
+       per the jit-cache observable.
+    2. **Round trip**: the written capture is loaded and replayed
+       IN-PROCESS at x1 under the deterministic clock — per-class
+       request counts, served token counts, and the cancellation
+       offset must match the original trace exactly — then at
+       xBENCH_REPLAY_SPEED compressed.
+    3. **Capacity**: `max_sustainable_speed` binary-searches the
+       largest x-factor the stack still meets a tight interactive
+       TTFT SLO at (deterministic modeled capacity — the number later
+       perf PRs regress-test against).
+
+    The emitted `workload_fingerprint` is the capture's content hash:
+    any A/B against this row must carry the same hash or the
+    comparison gates (bench._ab_best / scripts/ab_summary.py /
+    scripts/replay_diff.py) refuse it."""
+    import asyncio
+
+    from torchbooster_tpu.models.gpt import GPT, GPTConfig
+    from torchbooster_tpu.observability.flight import FlightRecorder
+    from torchbooster_tpu.serving import (ContinuousBatcher,
+                                          PagedEngine, Request)
+    from torchbooster_tpu.serving.frontend import (
+        ServingFrontend, SLOPolicy, parse_classes)
+    from torchbooster_tpu.serving.loadgen import (
+        Workload, max_sustainable_speed, replay_http, replay_inprocess)
+
+    k = _replay_env()
+    runs = int(os.environ.get("BENCH_REPLAY_RUNS", 3))
+    capture_path = os.environ.get("BENCH_REPLAY_CAPTURE", os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "logs",
+        "replay_capture.jsonl"))
+    workload = _replay_workload(k)
+    # serving deadlines HUGE so nothing sheds: the round-trip count/
+    # token equality below needs every offered request served in both
+    # the original trace and the replays
+    classes_spec = "interactive:60000:0,batch:0:0"
+    classes = parse_classes(classes_spec)
+
+    cfg = GPTConfig(n_layers=k["n_layers"], seq_len=k["seq"],
+                    n_kv_heads=k["kv"])
+    params = GPT.init(jax.random.PRNGKey(0), cfg)
+    # decisive head (the serving-test trick): greedy picks must not
+    # sit in bf16 near-ties, or replay "determinism" would measure
+    # float tie-breaking instead of the harness
+    params = {**params, "wte": {"table": params["wte"]["table"] * 4.0}}
+    rs = np.random.RandomState(9)
+    warm = rs.randint(0, 50257, 2 * k["page"] + 3, dtype=np.int32)
+
+    def build():
+        engine = PagedEngine(params, cfg, page_size=k["page"],
+                             n_pages=k["n_pages"],
+                             max_slots=k["slots"])
+        batcher = ContinuousBatcher(
+            engine, policy=SLOPolicy(classes, default="batch"),
+            flight=FlightRecorder(capacity=max(4096, k["n_req"] * 256)))
+        # warm the chunk+decode executables out of every measured
+        # window (and out of the capture — run() is its own session)
+        batcher.run([Request(prompt=warm, max_new_tokens=2)])
+        return batcher, engine
+
+    async def drive(batcher, cap_path):
+        fe = ServingFrontend(batcher, port=0, max_queue=4 * k["n_req"],
+                             capture_path=cap_path)
+        await fe.start()
+        flight0 = batcher.flight.n_recorded
+        await replay_http(fe.port, workload, speed=1.0,
+                          classes=classes)
+        await fe.stop()
+        # decode tok/s from the flight recorder's own unrounded
+        # per-step records (the obs_trace discipline — the metrics
+        # dict's 0.1-rounding alone can exceed the 3% bar on CPU)
+        recs = batcher.flight.tail(batcher.flight.n_recorded - flight0)
+        dec = [r for r in recs if r["kind"] == "decode"]
+        return (sum(r["tokens"] for r in dec)
+                / max(sum(r["wall_s"] for r in dec), 1e-9))
+
+    b_off, e_off = build()
+    b_on, e_on = build()
+    tok = {"off": 0.0, "on": 0.0}
+    overheads = []
+    for i in range(max(runs, 1)):
+        pair = {}
+        order = (("off", b_off, None), ("on", b_on, capture_path))
+        if i % 2:
+            order = order[::-1]
+        for arm, batcher, cap_path in order:
+            pair[arm] = asyncio.run(drive(batcher, cap_path))
+            tok[arm] = max(tok[arm], pair[arm])
+        overheads.append((pair["off"] - pair["on"])
+                         / max(pair["off"], 1e-9) * 100.0)
+    overhead = min(overheads)
+    compiles = {"off": (e_off.decode_compiles, e_off.prefill_compiles),
+                "on": (e_on.decode_compiles, e_on.prefill_compiles)}
+    zero_new = compiles["off"] == compiles["on"] == (1, 1)
+
+    # ---- the round trip: load the capture, replay it in-process ----
+    cap = Workload.load(capture_path)
+    by_id = {rec.request_id: rec for rec in cap.requests}
+    reports = {}
+    matches = {"counts": len(cap) == k["n_req"], "tokens": True,
+               "cancel": True}
+    for label, spd in (("x1", 1.0), ("xn", k["speed"])):
+        batcher = ContinuousBatcher(
+            e_off, policy=SLOPolicy(classes, default="batch"))
+        res = replay_inprocess(batcher, cap, speed=spd)
+        reports[label] = res.report
+        if label == "x1":
+            for req in res.requests:
+                rec = by_id[req.request_id]
+                want = rec.cancel_after_tokens or rec.max_new_tokens
+                if len(req.tokens) != want:
+                    matches["tokens"] = False
+                if rec.cancel_after_tokens is not None and (
+                        not req.cancelled
+                        or len(req.tokens) != rec.cancel_after_tokens):
+                    matches["cancel"] = False
+            # per-class offered counts must round-trip exactly
+            for cls, blk in res.report["classes"].items():
+                offered = sum(1 for rec in cap.requests
+                              if (rec.priority or "default") == cls)
+                if blk["n"] != offered:
+                    matches["counts"] = False
+
+    # ---- max sustainable x under a TIGHT interactive deadline ----
+    maxx_spec = parse_classes(
+        f"interactive:"
+        f"{float(os.environ.get('BENCH_REPLAY_MAXX_TTFT_MS', 250)):g}"
+        ":0,batch:0:0")
+
+    def run_at(spd):
+        b = ContinuousBatcher(
+            e_off, policy=SLOPolicy(maxx_spec, default="batch"))
+        return replay_inprocess(b, cap, speed=spd).report
+
+    maxx = max_sustainable_speed(
+        run_at, lo=1.0,
+        hi=float(os.environ.get("BENCH_REPLAY_MAXX_HI", 16.0)),
+        iters=int(os.environ.get("BENCH_REPLAY_MAXX_ITERS", 3)))
+
+    ok = (overhead < 3.0 and zero_new and matches["counts"]
+          and matches["tokens"] and matches["cancel"])
+    if not ok:
+        print(f"REPLAY FAIL: overhead {overhead:.2f}% (limit 3%), "
+              f"zero_new_compiles={zero_new}, counts_match="
+              f"{matches['counts']}, tokens_match={matches['tokens']}, "
+              f"cancel_match={matches['cancel']}", file=sys.stderr)
+    return {
+        "workload_fingerprint": cap.fingerprint(),
+        "replay_capture_path": capture_path,
+        "replay_n_requests": k["n_req"],
+        "replay_capture_tok_s_off": round(tok["off"], 2),
+        "replay_capture_tok_s_on": round(tok["on"], 2),
+        "replay_capture_overhead_pct": round(overhead, 2),
+        "replay_capture_overhead_pcts": [round(o, 2)
+                                         for o in overheads],
+        "replay_capture_zero_new_compiles": zero_new,
+        "replay_roundtrip_counts_match": matches["counts"],
+        "replay_roundtrip_tokens_match": matches["tokens"],
+        "replay_roundtrip_cancel_match": matches["cancel"],
+        "replay_x1_goodput_tok_s": reports["x1"]["goodput_tok_s"],
+        "replay_x1_total_tok_s": reports["x1"]["total_tok_s"],
+        "replay_x1_n_preemptions": reports["x1"]["n_preemptions"],
+        "replay_xn_speed": k["speed"],
+        "replay_xn_goodput_tok_s": reports["xn"]["goodput_tok_s"],
+        "replay_xn_total_tok_s": reports["xn"]["total_tok_s"],
+        "replay_max_sustainable_x": maxx,
+        "replay_ok": ok,
+    }
+
+
+def bench_replay_http() -> dict:
+    """The HTTP replay row: the SAME loadgen workload (same knobs as
+    `replay`) offered open-loop over real HTTP against a live SLO
+    front door at xBENCH_REPLAY_SPEED compression — client-observed
+    per-class TTFT/TPOT percentiles, goodput, shed rate, and the
+    workload fingerprint (this row's and `replay`'s serve different
+    traces — capture vs synthetic — so the comparison gates refuse a
+    cross-row delta by construction, which is the point)."""
+    import asyncio
+
+    from torchbooster_tpu.models.gpt import GPT, GPTConfig
+    from torchbooster_tpu.serving import (ContinuousBatcher,
+                                          PagedEngine, Request)
+    from torchbooster_tpu.serving.frontend import (
+        ServingFrontend, SLOPolicy, parse_classes)
+    from torchbooster_tpu.serving.loadgen import replay_http
+
+    k = _replay_env()
+    ttft_ms = float(os.environ.get("BENCH_REPLAY_HTTP_TTFT_MS", 2000))
+    workload = _replay_workload(k)
+    classes = parse_classes(f"interactive:{ttft_ms:g}:0,batch:0:0")
+
+    cfg = GPTConfig(n_layers=k["n_layers"], seq_len=k["seq"],
+                    n_kv_heads=k["kv"])
+    params = GPT.init(jax.random.PRNGKey(0), cfg)
+    params = {**params, "wte": {"table": params["wte"]["table"] * 4.0}}
+    engine = PagedEngine(params, cfg, page_size=k["page"],
+                         n_pages=k["n_pages"], max_slots=k["slots"])
+    batcher = ContinuousBatcher(
+        engine, policy=SLOPolicy(classes, default="batch"))
+    rs = np.random.RandomState(9)
+    batcher.run([Request(prompt=rs.randint(0, 50257, 2 * k["page"] + 3,
+                                           dtype=np.int32),
+                         max_new_tokens=2)])
+
+    async def scenario():
+        fe = ServingFrontend(batcher, port=0, max_queue=4 * k["n_req"])
+        await fe.start()
+        res = await replay_http(fe.port, workload, speed=k["speed"],
+                                classes=classes)
+        await fe.stop()
+        return res
+
+    rep = asyncio.run(scenario()).report
+    out = {
+        "workload_fingerprint": rep["workload_fingerprint"],
+        "replay_http_speed": k["speed"],
+        "replay_http_n_requests": rep["n_requests"],
+        "replay_http_goodput_tok_s": rep["goodput_tok_s"],
+        "replay_http_total_tok_s": rep["total_tok_s"],
+        "replay_http_deadline_hit_rate": rep["deadline_hit_rate"],
+        "replay_http_shed_rate": rep["shed_rate"],
+        "replay_http_cancel_rate": rep["cancel_rate"],
+        "replay_http_decode_compiles": engine.decode_compiles,
+        "replay_http_prefill_compiles": engine.prefill_compiles,
+    }
+    for cls, blk in rep["classes"].items():
+        out[f"replay_http_ttft_p50_s_{cls}"] = blk["ttft_p50_s"]
+        out[f"replay_http_ttft_p99_s_{cls}"] = blk["ttft_p99_s"]
+        out[f"replay_http_tpot_p50_s_{cls}"] = blk["tpot_p50_s"]
+        out[f"replay_http_tpot_p99_s_{cls}"] = blk["tpot_p99_s"]
+    return out
+
+
 def bench_obs(steps: int) -> dict:
     """Telemetry overhead A/B: the SAME GPT bench step (bench_gpt
     geometry + knobs) timed with observability disabled, then enabled
@@ -1932,6 +2229,10 @@ def _sub_main(name: str) -> None:
         print(json.dumps(bench_serve_http()))
     elif name == "obs_trace":
         print(json.dumps(bench_obs_trace()))
+    elif name == "replay":
+        print(json.dumps(bench_replay()))
+    elif name == "replay_http":
+        print(json.dumps(bench_replay_http()))
     elif name == "obs":
         print(json.dumps(bench_obs(max(4, steps // 4))))
     elif name == "comms":
@@ -2016,17 +2317,29 @@ def _ab_best(variants: dict[str, dict], baseline: str,
     if manual:
         label = ",".join(f"{k}={os.environ[k]}" for k in manual)
         return {}, f"manual({label})"
-    best = _collect_best(variants, value_key, path)
+    fps: dict[str, str | None] = {}
+    best = _collect_best(variants, value_key, path, fingerprints=fps)
     if baseline not in best:
         return {}, baseline
-    winner = max(best, key=lambda n: best[n])
-    if best[winner] <= best[baseline]:
+    # workload-fingerprint gate: an arm that served a DIFFERENT trace
+    # than the baseline arm (both carrying fingerprints, hashes
+    # unequal) is refused from the winner pick — a number measured on
+    # other traffic must never flip a gate. Families without
+    # fingerprints (resnet/gpt) compare exactly as before.
+    base_fp = {"workload_fingerprint": fps.get(baseline)}
+    comparable = {
+        n: v for n, v in best.items()
+        if fingerprints_comparable(
+            {"workload_fingerprint": fps.get(n)}, base_fp)}
+    winner = max(comparable, key=lambda n: comparable[n])
+    if comparable[winner] <= comparable[baseline]:
         winner = baseline
     return dict(variants[winner]), winner
 
 
 def _collect_best(variants: dict, value_key: str,
-                  path: str | None = None) -> dict[str, float]:
+                  path: str | None = None,
+                  fingerprints: dict | None = None) -> dict[str, float]:
     """Best recorded value per variant config from the A/B evidence
     base — THE single read point for both the gate flips (_ab_best)
     and the down-branch recorded summary, so the two can never
@@ -2049,10 +2362,17 @@ def _collect_best(variants: dict, value_key: str,
                     if e.get("status") != "ok":
                         continue
                     name = e.get("config")
-                    value = (e.get("result") or {}).get(value_key)
-                    if name in variants and value:
-                        best[name] = max(best.get(name, 0.0),
-                                         float(value))
+                    result = e.get("result") or {}
+                    value = result.get(value_key)
+                    if name in variants and value \
+                            and float(value) > best.get(name, 0.0):
+                        best[name] = float(value)
+                        if fingerprints is not None:
+                            # the fingerprint travels WITH the best
+                            # entry: _ab_best's comparability gate
+                            # judges the number it would actually use
+                            fingerprints[name] = result.get(
+                                "workload_fingerprint")
         except OSError:
             pass
 
@@ -2119,6 +2439,11 @@ _SECONDARY_BENCHES = (("gpt", 900), ("gpt_long", 1500), ("loader", 900),
                       ("serve_kernel", 1800),
                       ("serve_http", 1800),
                       ("obs_trace", 1500),
+                      # the loadgen capture/replay rows share their
+                      # run_ab QUEUE deadlines for the same
+                      # two-drivers-must-agree reason
+                      ("replay", 1500),
+                      ("replay_http", 1500),
                       ("obs", 900), ("comms", 900))
 
 

@@ -58,6 +58,19 @@ BASELINES = {"resnet img/s": "baseline", "gpt tok/s": "gpt",
              "loader img/s": "loader_thread"}
 
 
+def _fingerprints_comparable(a: dict | None, b: dict | None) -> bool:
+    """Two result dicts may be compared unless BOTH carry a
+    ``workload_fingerprint`` and the hashes differ — then they served
+    different traces and any delta is noise dressed as evidence.
+    (Mirror of torchbooster_tpu/serving/loadgen/report.py::
+    fingerprints_comparable — duplicated so this summary stays
+    importable without jax; tests/test_loadgen.py pins the two
+    together.)"""
+    fa = (a or {}).get("workload_fingerprint")
+    fb = (b or {}).get("workload_fingerprint")
+    return fa is None or fb is None or fa == fb
+
+
 def main() -> None:
     path = sys.argv[1] if len(sys.argv) > 1 else os.path.join(
         REPO, "logs", "ab_results.jsonl")
@@ -87,11 +100,19 @@ def main() -> None:
                       if attempts.get(name) else "pending")
             print(f"| {name} | {family} | — | — | {status} |")
             continue
-        value = (e.get("result") or {}).get(key)
+        result = e.get("result") or {}
+        value = result.get(key)
         base_e = latest.get(BASELINES[family])
-        base = (base_e.get("result") or {}).get(key) if base_e else None
-        delta = (f"{(value / base - 1) * 100:+.1f}%"
-                 if value and base and name != BASELINES[family] else "—")
+        base_r = (base_e.get("result") or {}) if base_e else {}
+        base = base_r.get(key) if base_e else None
+        if value and base and name != BASELINES[family]:
+            # refuse a delta between arms that served different
+            # traces (workload fingerprints present and unequal)
+            delta = (f"{(value / base - 1) * 100:+.1f}%"
+                     if _fingerprints_comparable(result, base_r)
+                     else "refused: fingerprint mismatch")
+        else:
+            delta = "—"
         extra = ""
         for flag in ("gpt_flash_engaged", "gpt_long_flash_engaged"):
             if flag in (e.get("result") or {}):
@@ -105,7 +126,7 @@ def main() -> None:
                  "comms_cpu8", "serve_prefix", "serve_prefix_int8",
                  "serve_spec", "serve_spec_int8", "serve_http",
                  "serve_http_prio", "serve_kernel", "serve_kernel_spec",
-                 "obs_trace")
+                 "obs_trace", "replay", "replay_http")
     for name in sorted(attempts):
         if name in METRICS or (name in multi_key and name in latest):
             continue  # multi-key ok rows print below; failures fall through
@@ -259,6 +280,56 @@ def main() -> None:
                   f"| {r.get(f'obs_trace_decode_compiles_{arm}', '—')}"
                   f"/{r.get(f'obs_trace_prefill_compiles_{arm}', '—')}"
                   " |")
+
+    # replay rows: the loadgen capture/replay harness — the capture
+    # overhead A/B + round-trip verdict, the x1/xN conformance
+    # numbers, and the max-sustainable-x capacity headline; the two
+    # rows' fingerprints differ by construction (capture vs offered
+    # synthetic), so no cross-row delta is ever printed
+    e = latest.get("replay")
+    if e is not None:
+        r = e.get("result") or {}
+        print(f"\nreplay (fingerprint "
+              f"{r.get('workload_fingerprint', '?')}, capture "
+              f"overhead {r.get('replay_capture_overhead_pct', '?')}% "
+              f"of limit 3%, zero new compiles "
+              f"{r.get('replay_capture_zero_new_compiles', '?')}, "
+              f"round trip counts/tokens/cancel "
+              f"{r.get('replay_roundtrip_counts_match', '?')}/"
+              f"{r.get('replay_roundtrip_tokens_match', '?')}/"
+              f"{r.get('replay_roundtrip_cancel_match', '?')}, "
+              f"max sustainable x"
+              f"{r.get('replay_max_sustainable_x', '?')}, "
+              f"verdict ok={r.get('replay_ok', '?')}):")
+        print("| arm | goodput tok/s | total tok/s |")
+        print("|---|---|---|")
+        print(f"| replay x1 "
+              f"| {r.get('replay_x1_goodput_tok_s', '—')} "
+              f"| {r.get('replay_x1_total_tok_s', '—')} |")
+        print(f"| replay x{r.get('replay_xn_speed', '?')} "
+              f"| {r.get('replay_xn_goodput_tok_s', '—')} "
+              f"| {r.get('replay_xn_total_tok_s', '—')} |")
+    e = latest.get("replay_http")
+    if e is not None:
+        r = e.get("result") or {}
+        print(f"\nreplay_http (fingerprint "
+              f"{r.get('workload_fingerprint', '?')}, "
+              f"x{r.get('replay_http_speed', '?')}, goodput "
+              f"{r.get('replay_http_goodput_tok_s', '?')} tok/s, "
+              f"deadline hit "
+              f"{r.get('replay_http_deadline_hit_rate', '?')}, shed "
+              f"{r.get('replay_http_shed_rate', '?')}):")
+        print("| class | ttft p50/p99 s | tpot p50/p99 s |")
+        print("|---|---|---|")
+        for cls in ("interactive", "batch"):
+            if f"replay_http_ttft_p50_s_{cls}" not in r:
+                continue
+            print(
+                f"| {cls} "
+                f"| {r.get(f'replay_http_ttft_p50_s_{cls}', '—')}"
+                f"/{r.get(f'replay_http_ttft_p99_s_{cls}', '—')} "
+                f"| {r.get(f'replay_http_tpot_p50_s_{cls}', '—')}"
+                f"/{r.get(f'replay_http_tpot_p99_s_{cls}', '—')} |")
 
     # comms rows: bytes-moved + step-time deltas across the gradient
     # sync arms, rendered as a compact sub-table (one row per arm)

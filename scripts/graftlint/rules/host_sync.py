@@ -39,7 +39,12 @@ HOT_PATHS = (
     # the frontend/ async scheduler loop (the event loop pumps step()
     # between dispatches — it must never block on device reads;
     # deferred registry reads only; tests/test_obs_lint.py pins the
-    # coverage)
+    # coverage). The serving/ prefix deliberately includes
+    # serving/loadgen/: the in-process replay driver pumps step() on
+    # the decode loop's own thread, so its pacing/bookkeeping is as
+    # step-cadence as the batcher itself — the open-loop pacer's
+    # wall-clock TIMESTAMPS are reasoned obs_allowlist.txt entries,
+    # never durations
     "torchbooster_tpu/serving/",
     # the paged flash-decode kernel wrapper sits INSIDE the compiled
     # decode/verify steps (serving/engine.py calls it per layer per

@@ -1,0 +1,86 @@
+"""Baseline-comparison gate for replay conformance reports.
+
+Diff two SLO conformance reports (JSON files written by the loadgen
+replay drivers — ``json.dump(result.report, f)``) and flag SLO
+regressions of the candidate vs the baseline: goodput or deadline-hit
+drops, shed-rate rises, per-class p99 TTFT/TPOT rises — each judged
+against a tolerance (relative for throughputs/latencies, absolute for
+rates; see ``loadgen/report.py::diff_reports`` for the exact rule
+set).
+
+The comparison REFUSES reports whose workload fingerprints differ:
+two arms that served different traces are not an A/B, and silently
+diffing them is how bogus regressions (and bogus all-clears) ship.
+Replay the same capture through both arms first.
+
+Usage:
+    python scripts/replay_diff.py baseline.json candidate.json [--tol 0.1]
+
+Exit codes: 0 = no regression, 1 = regression(s) flagged,
+2 = not comparable (fingerprint mismatch) or unreadable input.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from torchbooster_tpu.serving.loadgen.report import (  # noqa: E402
+    diff_reports,
+)
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    tol = 0.10
+    if "--tol" in argv:
+        i = argv.index("--tol")
+        try:
+            tol = float(argv[i + 1])
+        except (IndexError, ValueError):
+            print("--tol needs a number (e.g. --tol 0.1)",
+                  file=sys.stderr)
+            return 2
+        del argv[i:i + 2]
+    if len(argv) != 2:
+        print("usage: python scripts/replay_diff.py <baseline.json> "
+              "<candidate.json> [--tol 0.1]", file=sys.stderr)
+        return 2
+    reports = []
+    for path in argv:
+        try:
+            with open(path) as f:
+                reports.append(json.load(f))
+        except (OSError, ValueError) as exc:
+            print(f"cannot read report {path!r}: {exc}",
+                  file=sys.stderr)
+            return 2
+    base, cand = reports
+    try:
+        regressions = diff_reports(base, cand, tol=tol)
+    except ValueError as exc:
+        # fingerprint mismatch: refused, not "passed"
+        print(f"NOT COMPARABLE: {exc}", file=sys.stderr)
+        return 2
+    print(f"baseline  : {argv[0]} (speed x{base.get('speed', '?')}, "
+          f"fingerprint {base.get('workload_fingerprint', '?')})")
+    print(f"candidate : {argv[1]} (speed x{cand.get('speed', '?')}, "
+          f"fingerprint {cand.get('workload_fingerprint', '?')})")
+    for key in ("goodput_tok_s", "total_tok_s", "deadline_hit_rate",
+                "shed_rate"):
+        print(f"  {key}: {base.get(key)} -> {cand.get(key)}")
+    if regressions:
+        print(f"\n{len(regressions)} SLO regression(s) beyond "
+              f"tol={tol}:")
+        for line in regressions:
+            print(f"  REGRESSION {line}")
+        return 1
+    print(f"\nno SLO regressions beyond tol={tol}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

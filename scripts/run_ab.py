@@ -136,6 +136,18 @@ QUEUE: list[tuple[str, str, dict, int]] = [
     # least one preempted and one cancelled request track
     # (bench.bench_obs_trace; obs_trace_ok is the verdict bit)
     ("obs_trace", "obs_trace", {}, 1500),
+    # workload capture & deterministic replay (the PR-11 loadgen
+    # tentpole): the capture-overhead A/B (capture off vs on over the
+    # same SSE workload, < 3% decode tok/s + zero new compiles), the
+    # capture -> in-process replay round trip (counts/tokens/cancel
+    # offsets must match the original trace; replay_ok is the verdict
+    # bit), and the max-sustainable-x binary search; the http row
+    # replays the same workload open-loop over real HTTP at xSPEED
+    # for the client-observed conformance report. Both rows carry a
+    # workload_fingerprint — the comparison gates (bench._ab_best,
+    # ab_summary, replay_diff) refuse arms whose fingerprints differ
+    ("replay", "replay", {}, 1500),
+    ("replay_http", "replay_http", {}, 1500),
     # recipe accuracy on chip (VERDICT r4 #3): the shipped ResNet
     # CIFAR recipe end to end, ref hyperparams, 20 epochs — real
     # CIFAR-10 if a binary release is under the dataset root (none in

@@ -75,6 +75,14 @@ def test_hot_paths_cover_step_cadence_serving_files():
                 # decode pipeline exactly like one in the batcher
                 "torchbooster_tpu/serving/frontend/server.py",
                 "torchbooster_tpu/serving/frontend/scheduler.py",
+                # the loadgen replay driver pumps step() on the
+                # decode loop's own thread and the capture hook runs
+                # per submit — step-cadence both (PR 11); the pacer's
+                # wall-clock timestamps are reasoned allowlist
+                # entries, never durations
+                "torchbooster_tpu/serving/loadgen/replay.py",
+                "torchbooster_tpu/serving/loadgen/workload.py",
+                "torchbooster_tpu/serving/loadgen/report.py",
                 # the paged flash-decode kernel wrapper runs inside
                 # the compiled decode/verify steps (PR 8)
                 "torchbooster_tpu/ops/paged_attention.py"):

@@ -646,6 +646,15 @@ class FrontendConfig(BaseConfig):
     sheds later). ``max_queue`` bounds the HTTP submit queue —
     beyond it requests get 429 before touching the scheduler.
 
+    ``capture_path`` turns on workload capture (serving/loadgen):
+    every accepted submit is recorded — arrival offset, prompt ids,
+    priority class, deadline, output budget, and the client's cancel
+    offset, keyed by ``request_id`` — and the versioned JSONL trace
+    lands at that path when the server stops, ready for the replay
+    drivers (and the ``loadgen:`` block) to re-offer verbatim.
+    ``capture_scrub: true`` never persists prompt CONTENT: each
+    record keeps only a length + regeneration-seed recipe.
+
     The server itself is stdlib asyncio; install the ``[serve]``
     extra and call ``frontend.server.install_uvloop()`` for the
     optional event-loop swap. See docs/serving.md for the request
@@ -659,6 +668,8 @@ class FrontendConfig(BaseConfig):
     default_class: str = ""            # "" = first listed class
     shed_grace: float = 1.0
     max_queue: int = 64
+    capture_path: str = ""             # "" = no workload capture
+    capture_scrub: bool = False        # capture recipes, not prompts
 
     def make_policy(self) -> Any:
         """Build the scheduler policy object the batcher consumes."""
@@ -684,7 +695,9 @@ class FrontendConfig(BaseConfig):
 
         return ServingFrontend(batcher, host=self.host,
                                port=self.port, codec=codec,
-                               max_queue=self.max_queue)
+                               max_queue=self.max_queue,
+                               capture_path=self.capture_path or None,
+                               capture_scrub=self.capture_scrub)
 
 
 @dataclass
@@ -779,6 +792,82 @@ class ServingConfig(BaseConfig):
             decode_backend=self.decode_backend)
         return ContinuousBatcher(engine, on_recompile=on_recompile,
                                  policy=self.frontend.make_policy())
+
+
+@dataclass
+class LoadgenConfig(BaseConfig):
+    """Workload source for the capture/replay harness
+    (torchbooster_tpu/serving/loadgen). No reference analogue — this
+    is how serving perf claims get measured under realistic load
+    instead of ad-hoc Poisson loops.
+
+    ``source`` is either a synthetic generator name (``poisson`` |
+    ``bursty`` | ``diurnal`` | ``sharegpt``) or a path to a captured
+    workload JSONL (``serving.frontend.capture_path`` writes one; a
+    path is recognized by its ``.jsonl``/``.json`` suffix or by
+    existing on disk). Both produce the SAME versioned format, so
+    synthetic and captured traffic flow through one replay driver.
+
+    ``speed`` is the time-compression ×-factor replays default to:
+    ``make()`` records it as the workload's ``meta["speed"]``, which
+    ``replay_inprocess``/``replay_http`` use whenever their own
+    ``speed`` argument is omitted (arrival offsets divide by it;
+    relative order is preserved).
+    ``classes`` is a ``"name:weight,..."`` priority mix for the
+    synthetic kinds (class SLO targets come from the frontend's own
+    ``classes`` table); ``cancel_frac`` of synthetic requests get a
+    recorded client disconnect at a random token offset, so replay
+    exercises the cancel/abort paths. ``prompt_len`` /
+    ``max_new_tokens`` are inclusive ``(lo, hi)`` ranges.
+
+    ``make()`` returns the
+    :class:`~torchbooster_tpu.serving.loadgen.workload.Workload`;
+    drive it with ``replay_inprocess(batcher, wl, speed=...)`` or
+    ``replay_http(port, wl, speed=...)``. docs/observability.md has
+    the capture-and-replay walkthrough; the ``replay`` bench rows
+    (bench.py) prove the round trip.
+    """
+
+    source: str = "poisson"            # kind | capture-file path
+    n_requests: int = 32
+    rate: float = 8.0                  # offered req/s (synthetic)
+    speed: float = 1.0                 # replay time-compression x
+    seed: int = 0
+    vocab: int = 50257
+    prompt_len: tuple(int, int) = (16, 64)
+    max_new_tokens: tuple(int, int) = (8, 32)
+    classes: str = ""                  # "name:weight,..." mix
+    cancel_frac: float = 0.0           # recorded client disconnects
+
+    def make(self) -> Any:
+        from torchbooster_tpu.serving.loadgen.workload import (
+            SYNTHETIC_KINDS, Workload, synthesize)
+
+        if self.speed <= 0:
+            raise ValueError(
+                f"loadgen.speed must be > 0, got {self.speed}")
+        src = self.source.strip()
+        if src.endswith((".jsonl", ".json")) or Path(src).exists():
+            wl = Workload.load(src)
+        elif src not in SYNTHETIC_KINDS:
+            raise ValueError(
+                f"loadgen.source={src!r}: expected a synthetic kind "
+                f"{SYNTHETIC_KINDS} or a capture file path (got "
+                "neither — a typo'd path would silently synthesize "
+                "the wrong traffic)")
+        else:
+            wl = synthesize(
+                src, n_requests=self.n_requests, rate=self.rate,
+                seed=self.seed, vocab=self.vocab,
+                prompt_len=tuple(self.prompt_len),
+                max_new_tokens=tuple(self.max_new_tokens),
+                classes=self.classes, cancel_frac=self.cancel_frac)
+        # the block's replay default: drivers called without an
+        # explicit speed= read it back from the workload, so the
+        # YAML knob actually governs the replay (meta never enters
+        # the content fingerprint)
+        wl.meta["speed"] = float(self.speed)
+        return wl
 
 
 @dataclass
@@ -964,6 +1053,7 @@ __all__ = [
     "EnvConfig",
     "EnvironementConfig",
     "HyperParameterConfig",
+    "LoadgenConfig",
     "LoaderConfig",
     "ObservabilityConfig",
     "OptimizerConfig",

@@ -23,6 +23,13 @@ package stops streaming dead bytes:
   (``speculative: true``): model-free prompt-lookup drafting plus ONE
   compiled multi-token verify step, so each pool read yields
   ``accepted + 1`` tokens instead of one (greedy-parity-exact);
+- :mod:`loadgen` — the workload capture & deterministic replay
+  harness: a versioned JSONL workload format with content
+  fingerprints, front-door capture (``frontend.capture_path``),
+  synthetic generators (Poisson/bursty/diurnal/sharegpt), open-loop
+  replay drivers (in-process deterministic clock, or real HTTP
+  clients, at ×N time compression), and SLO conformance reports with
+  a baseline-diff gate (``scripts/replay_diff.py``);
 - :mod:`frontend` — the request-facing surface: scheduler policies
   (:class:`FCFSPolicy`/:class:`SLOPolicy` — priority classes,
   deadline-driven admission, cost-aware preemption, load shedding)

@@ -132,7 +132,9 @@ class ServingFrontend:
                  host: str = "127.0.0.1", port: int = 0, *,
                  codec=None, max_queue: int = 64,
                  model_name: str = "torchbooster-tpu",
-                 crash_dump_path: str | None = None):
+                 crash_dump_path: str | None = None,
+                 capture_path: str | None = None,
+                 capture_scrub: bool = False):
         if max_queue < 1:
             raise ValueError(f"max_queue must be >= 1, got {max_queue}")
         self.batcher = batcher
@@ -147,6 +149,18 @@ class ServingFrontend:
         # dump in memory only (self.last_flight).
         self.crash_dump_path = crash_dump_path
         self.last_flight: dict | None = None
+        # workload capture (serving/loadgen): every accepted submit is
+        # observed, and stop() writes the versioned JSONL trace —
+        # arrival offsets, prompts (or scrubbed recipes), priorities,
+        # deadlines, and client cancel offsets keyed by request_id —
+        # that `replay_inprocess`/`replay_http` re-offer verbatim
+        self.capture_path = capture_path
+        self.capture = None
+        if capture_path:
+            from torchbooster_tpu.serving.loadgen.workload import (
+                WorkloadCapture)
+
+            self.capture = WorkloadCapture(scrub=capture_scrub)
         self._server: asyncio.AbstractServer | None = None
         self._pump_task: asyncio.Task | None = None
         self._exec = None
@@ -203,6 +217,17 @@ class ServingFrontend:
         self._server = None
         self._pump_task = None
         self.last_metrics = self.batcher.finish_session()
+        if self.capture is not None:
+            # every observed request is terminal by now (drained, or
+            # cancelled by the no-drain shutdown above), so cancel
+            # offsets are final — write the replayable trace. A
+            # failed write is loud on a clean stop, but must never
+            # MASK the pump's own terminal error below.
+            try:
+                self.capture.write(self.capture_path)
+            except Exception:
+                if pump_exc is None:
+                    raise
         if pump_exc is not None:
             raise pump_exc
         return self.last_metrics
@@ -481,6 +506,11 @@ class ServingFrontend:
             self.batcher.submit(req)
         except (TypeError, ValueError) as exc:
             raise HttpError(400, str(exc)) from None
+        if self.capture is not None:
+            # AFTER the submit: a rejected request never joined the
+            # trace, and the batcher has already stamped req.arrival
+            # (the capture's offset source)
+            self.capture.observe(req)
         self._wake.set()
 
     # ---- completion serving --------------------------------------
