@@ -895,6 +895,181 @@ def bench_serve_kernel() -> dict:
     return out
 
 
+def bench_serve_tp() -> dict:
+    """Tensor-parallel serving A/B (the PR-12 tentpole): the SAME
+    mixed-length Poisson trace served at ``tp=1`` (the single-chip
+    control) and ``tp=N`` (heads + KV pool sharded over a ``tp`` mesh
+    axis of virtual CPU devices — the ``BENCH_COMMS_HOST_DEVICES``
+    pattern) on identical engine geometry.
+
+    The claim under test is the per-chip byte divide: decode is
+    HBM-bound on KV bytes, and head-sharding splits every page's
+    KV rows ÷ tp per chip — so besides per-arm decode tok/s the row
+    emits the MODELED per-chip live MB/step (live pages sampled from
+    the block tables before every step, × the per-chip row bytes —
+    the single-chip number ÷ tp), the modeled psum wire bytes/step
+    (serving/tp.py ``step_traffic`` — the one collective the sharded
+    step pays), token parity across arms (the split is only evidence
+    if every arm emitted EXACTLY the control's tokens), and the
+    per-arm compile counts (zero-recompile through the sharded path).
+
+    The accounting-vs-HLO gate (the PR 3 10% pattern): the compiled
+    decode step of the widest tp arm must carry EXACTLY ONE
+    all-reduce instruction (the per-layer decode-output psum inside
+    the layer scan), and ``xla_collective_traffic``'s priced wire
+    bytes must agree with the closed-form per-layer model within 10%.
+
+    ``BENCH_TP`` is the comma list of tp arms (default ``1,2``; wall
+    clock on virtual devices is NOT the chip story — the modeled
+    bytes are; tok/s is reported for completeness). ``BENCH_TP_
+    BACKEND`` picks the decode backend for EVERY arm (``xla`` |
+    ``pallas`` — the serve_tp_pallas QUEUE row), validated loudly."""
+    from torchbooster_tpu.comms.accounting import xla_collective_traffic
+    from torchbooster_tpu.distributed import make_mesh
+    from torchbooster_tpu.models.gpt import GPT, GPTConfig
+    from torchbooster_tpu.serving import (ContinuousBatcher,
+                                          PagedEngine, Request)
+
+    backend = os.environ.get("BENCH_TP_BACKEND", "xla").strip()
+    if backend not in ("xla", "pallas"):
+        raise ValueError(
+            f"BENCH_TP_BACKEND must be 'xla' or 'pallas', got "
+            f"{backend!r}: a typo would silently A/B the wrong "
+            "decode path")
+    arms_raw = os.environ.get("BENCH_TP", "1,2")
+    try:
+        arms = [int(a) for a in arms_raw.split(",") if a.strip()]
+    except ValueError:
+        raise ValueError(
+            f"BENCH_TP must be a comma list of ints, got {arms_raw!r}")
+    if not arms or any(a < 1 for a in arms):
+        raise ValueError(
+            f"BENCH_TP arms must be >= 1, got {arms_raw!r}")
+    if 1 not in arms and len(arms) > 1:
+        raise ValueError(
+            f"BENCH_TP={arms_raw!r} has no tp=1 control arm — the "
+            "parity and ratio fields would compare nothing")
+    n_dev = jax.device_count()
+    if max(arms) > n_dev:
+        raise ValueError(
+            f"BENCH_TP wants tp={max(arms)} but only {n_dev} devices "
+            "exist — raise BENCH_TP_HOST_DEVICES")
+    n_req = int(os.environ.get("BENCH_TP_REQUESTS", 8))
+    rate = float(os.environ.get("BENCH_TP_RATE", 16.0))
+    slots = int(os.environ.get("BENCH_TP_SLOTS", 4))
+    page = int(os.environ.get("BENCH_TP_PAGE", 32))
+    n_pages = int(os.environ.get("BENCH_TP_PAGES", 48))
+    seq = int(os.environ.get("BENCH_TP_SEQ", 512))
+    n_layers = int(os.environ.get("BENCH_TP_LAYERS", 4))
+    kv = int(os.environ.get("BENCH_TP_KV_HEADS", 4))
+    cache_dtype = os.environ.get("BENCH_TP_CACHE_DTYPE") or None
+    if cache_dtype not in (None, "int8"):
+        raise ValueError(
+            f"BENCH_TP_CACHE_DTYPE must be '' or 'int8', got "
+            f"{cache_dtype!r}")
+    # fp32 default: XLA:CPU's float-normalization pass widens bf16
+    # collectives to f32 in the compiled module, which would put the
+    # accounting-vs-HLO gate off by exactly 2x on the CPU rig — fp32
+    # keeps model == compiler byte-exact; "bf16" measures the real
+    # serving dtype (per-chip MB/step halves) at the cost of that gate
+    compute = os.environ.get("BENCH_TP_COMPUTE", "fp32").strip()
+    if compute not in ("fp32", "bf16"):
+        raise ValueError(
+            f"BENCH_TP_COMPUTE must be 'fp32' or 'bf16', got "
+            f"{compute!r}")
+    compute_dtype = jnp.float32 if compute == "fp32" else jnp.bfloat16
+    pre = "serve_tp_pallas" if backend == "pallas" else "serve_tp"
+
+    rs = np.random.RandomState(0)
+    buckets = [b for b in (32, 64, 96, 128, 160)
+               if b < seq // 2] or [max(1, min(seq // 2, seq - 8))]
+    out_hi = max(2, min(65, seq - max(buckets)))
+    arrivals = np.cumsum(rs.exponential(1.0 / rate, n_req))
+    prompts = [rs.randint(0, 50257, int(n), dtype=np.int32)
+               for n in rs.choice(buckets, n_req)]
+    out_lens = rs.randint(min(16, out_hi - 1), out_hi, n_req)
+    warm_ids = rs.randint(0, 50257,
+                          min(max(buckets) + out_hi - 2, seq - 2),
+                          dtype=np.int32)
+
+    def trace():
+        return [Request(prompt=p, max_new_tokens=int(o),
+                        arrival=float(a))
+                for p, o, a in zip(prompts, out_lens, arrivals)]
+
+    cfg = GPTConfig(n_layers=n_layers, seq_len=seq, n_kv_heads=kv)
+    params = GPT.init(jax.random.PRNGKey(0), cfg)
+    head_dim = cfg.d_model // cfg.n_heads
+    elem = (1 + 2 / head_dim) if cache_dtype \
+        else jnp.dtype(compute_dtype).itemsize
+    # per-chip bytes per K/V row at a given tp: the kv_heads axis is
+    # what the pool shards, so the row bytes divide exactly by tp
+    row_mb = 2 * n_layers * cfg.kv_heads * head_dim * elem / 1e6
+
+    out = {}
+    tokens_by_arm = {}
+    hlo_engine = None
+    for tp in arms:
+        mesh = make_mesh(f"tp:{tp}", n_devices=tp) if tp > 1 else None
+        engine = PagedEngine(params, cfg, page_size=page,
+                             n_pages=n_pages, max_slots=slots,
+                             cache_dtype=cache_dtype,
+                             compute_dtype=compute_dtype,
+                             decode_backend=backend,
+                             tp=tp, mesh=mesh)
+        samples: list[int] = []
+        inner = engine.step
+
+        def sampled(engine=engine, samples=samples, inner=inner):
+            samples.append(engine.tables.n_live_pages)
+            return inner()
+
+        engine.step = sampled
+        batcher = ContinuousBatcher(engine)
+        batcher.run([Request(prompt=warm_ids, max_new_tokens=2)])
+        samples.clear()
+        reqs = trace()
+        m = batcher.run(reqs)
+        tokens_by_arm[tp] = [list(r.tokens) for r in reqs]
+        live = float(np.mean(samples)) if samples else 0.0
+        out[f"{pre}_tok_s_tp{tp}"] = m["decode_tok_s"]
+        out[f"{pre}_latency_tp{tp}_s"] = m["latency_mean_s"]
+        out[f"{pre}_decode_compiles_tp{tp}"] = engine.decode_compiles
+        out[f"{pre}_live_mb_step_chip_tp{tp}"] = round(
+            live * page * row_mb / tp, 3)
+        out[f"{pre}_psum_bytes_step_tp{tp}"] = \
+            engine.tp_step_traffic(1)["wire_bytes"]
+        if tp == max(arms) and tp > 1:
+            hlo_engine = engine
+    out[f"{pre}_arms"] = arms
+    if len(arms) > 1:
+        base = tokens_by_arm[1]
+        out[f"{pre}_token_parity"] = all(
+            tokens_by_arm[t] == base for t in arms)
+        big = max(arms)
+        c1 = out[f"{pre}_live_mb_step_chip_tp1"]
+        cb = out[f"{pre}_live_mb_step_chip_tp{big}"]
+        # the headline: per-chip live bytes at tp=N are the
+        # single-chip engine's ÷ N (same trace → same live pages)
+        out[f"{pre}_chip_bytes_ratio"] = round(c1 / max(cb, 1e-9), 2)
+    if hlo_engine is not None:
+        # accounting vs compiler: the sharded decode step must carry
+        # exactly ONE all-reduce (the per-layer output psum in the
+        # scan body) whose priced wire bytes match the closed-form
+        # model within 10%
+        traffic = xla_collective_traffic(hlo_engine.decode_hlo_text())
+        psums = [op for op in traffic["ops"] if op["op"] == "all-reduce"]
+        model = hlo_engine.tp_step_traffic(1)["per_layer_wire_bytes"]
+        measured = sum(op["wire_bytes"] for op in psums)
+        out[f"{pre}_hlo_psum_ops"] = len(psums)
+        out[f"{pre}_hlo_psum_bytes_layer"] = round(measured, 1)
+        out[f"{pre}_model_psum_bytes_layer"] = model
+        out[f"{pre}_psum_model_ok"] = bool(
+            len(psums) == 1
+            and abs(measured - model) <= 0.1 * max(model, 1e-9))
+    return out
+
+
 async def _serve_post(port, payload):
     """POST /v1/completions to a localhost ServingFrontend — the ONE
     wire helper the serve_http and obs_trace sub-benches share, so
@@ -2173,6 +2348,18 @@ def _sub_main(name: str) -> None:
                 + f" --xla_force_host_platform_device_count={hosts}"
             ).strip()
             os.environ["JAX_PLATFORMS"] = "cpu"
+    if name == "serve_tp":
+        # same pattern for the tensor-parallel serving arms: the tp>1
+        # mesh needs virtual CPU devices, forced BEFORE the first
+        # backend touch (default 8, like the test suite's conftest;
+        # "0" opts out for a box with real chips)
+        hosts = os.environ.get("BENCH_TP_HOST_DEVICES", "8").strip()
+        if hosts and hosts != "0":
+            os.environ["XLA_FLAGS"] = (
+                os.environ.get("XLA_FLAGS", "")
+                + f" --xla_force_host_platform_device_count={hosts}"
+            ).strip()
+            os.environ["JAX_PLATFORMS"] = "cpu"
     if os.environ.get("JAX_PLATFORMS", "") == "cpu":
         # see main(): sitecustomize overrides the env var
         jax.config.update("jax_platforms", "cpu")
@@ -2225,6 +2412,8 @@ def _sub_main(name: str) -> None:
         print(json.dumps(bench_serve_spec()))
     elif name == "serve_kernel":
         print(json.dumps(bench_serve_kernel()))
+    elif name == "serve_tp":
+        print(json.dumps(bench_serve_tp()))
     elif name == "serve_http":
         print(json.dumps(bench_serve_http()))
     elif name == "obs_trace":

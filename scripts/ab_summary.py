@@ -126,6 +126,7 @@ def main() -> None:
                  "comms_cpu8", "serve_prefix", "serve_prefix_int8",
                  "serve_spec", "serve_spec_int8", "serve_http",
                  "serve_http_prio", "serve_kernel", "serve_kernel_spec",
+                 "serve_tp", "serve_tp_pallas",
                  "obs_trace", "replay", "replay_http")
     for name in sorted(attempts):
         if name in METRICS or (name in multi_key and name in latest):
@@ -222,6 +223,35 @@ def main() -> None:
                 f"| {r.get(f'{pre}_live_mb_step_{backend}', '—')} "
                 f"| {r.get(f'{pre}_decode_compiles_{backend}', '—')}"
                 f"/{r.get(f'{pre}_verify_compiles_{backend}', '—')} |")
+
+    # serve_tp rows: the tensor-parallel serving A/B rendered as a
+    # per-arm sub-table (tok/s, modeled per-chip live MB/step — the
+    # ÷tp headline — and modeled psum bytes/step) with the
+    # accounting-vs-HLO gate verdict in the header
+    for name in ("serve_tp", "serve_tp_pallas"):
+        e = latest.get(name)
+        if e is None:
+            continue
+        r = e.get("result") or {}
+        pre = name
+        print(f"\n{name} (per-chip bytes ratio "
+              f"{r.get(f'{pre}_chip_bytes_ratio', '?')}x, token parity "
+              f"{r.get(f'{pre}_token_parity', '?')}, psum model-vs-HLO "
+              f"ok {r.get(f'{pre}_psum_model_ok', '?')} "
+              f"[{r.get(f'{pre}_hlo_psum_ops', '?')} all-reduce, "
+              f"{r.get(f'{pre}_hlo_psum_bytes_layer', '?')} vs "
+              f"{r.get(f'{pre}_model_psum_bytes_layer', '?')} B/layer]):")
+        print("| tp | decode tok/s | mean latency s "
+              "| per-chip live MB/step | psum B/step | decode compiles |")
+        print("|---|---|---|---|---|---|")
+        for tp in r.get(f"{pre}_arms", ()):
+            print(
+                f"| {tp} "
+                f"| {r.get(f'{pre}_tok_s_tp{tp}', '—')} "
+                f"| {r.get(f'{pre}_latency_tp{tp}_s', '—')} "
+                f"| {r.get(f'{pre}_live_mb_step_chip_tp{tp}', '—')} "
+                f"| {r.get(f'{pre}_psum_bytes_step_tp{tp}', '—')} "
+                f"| {r.get(f'{pre}_decode_compiles_tp{tp}', '—')} |")
 
     # serve_http rows: the front-door A/B rendered as a per-class SLO
     # sub-table (client-observed TTFT/TPOT percentiles per arm x

@@ -117,6 +117,17 @@ QUEUE: list[tuple[str, str, dict, int]] = [
     ("serve_kernel", "serve_kernel", {}, 1800),
     ("serve_kernel_spec", "serve_kernel",
      {"BENCH_KERNEL_SPEC": "1"}, 1800),
+    # tensor-parallel serving (the PR-12 tentpole A/B): the SAME
+    # mixed-length Poisson trace at tp=1 vs tp=2 over a virtual-CPU
+    # tp mesh (BENCH_TP_HOST_DEVICES, the BENCH_COMMS pattern) —
+    # modeled per-chip live MB/step (the ÷tp headline), modeled psum
+    # bytes/step vs the compiled HLO's one all-reduce (10% gate),
+    # token parity across arms, one-compile proof through the
+    # sharded path (bench.bench_serve_tp); the pallas row drives the
+    # same arms through the in-kernel block-table walk
+    ("serve_tp", "serve_tp", {}, 1800),
+    ("serve_tp_pallas", "serve_tp",
+     {"BENCH_TP_BACKEND": "pallas"}, 1800),
     # the serving FRONT DOOR (the PR-7 tentpole A/B): real asyncio
     # HTTP clients streaming SSE from the live server over localhost
     # — client-observed p50/p99 TTFT/TPOT per priority class,

@@ -83,6 +83,10 @@ def test_hot_paths_cover_step_cadence_serving_files():
                 "torchbooster_tpu/serving/loadgen/replay.py",
                 "torchbooster_tpu/serving/loadgen/workload.py",
                 "torchbooster_tpu/serving/loadgen/report.py",
+                # the tensor-parallel sharded decode driver (PR 12):
+                # its wrappers run on the step cadence around every
+                # compiled decode/verify dispatch
+                "torchbooster_tpu/serving/tp.py",
                 # the paged flash-decode kernel wrapper runs inside
                 # the compiled decode/verify steps (PR 8)
                 "torchbooster_tpu/ops/paged_attention.py"):

@@ -328,3 +328,121 @@ def test_engine_and_config_backend_validation():
         decode_backend="pallas").make(params, cfg,
                                       compute_dtype=jnp.float32)
     assert batcher.engine.decode_backend == "pallas"
+
+
+# ---- tensor-parallel kernel path (serving/tp.py) -----------------
+
+
+def _tp_mesh(tp):
+    from torchbooster_tpu.distributed import make_mesh
+
+    return make_mesh(f"tp:{tp}", n_devices=tp)
+
+
+@pytest.mark.parametrize("tp,compute_dtype,cache_dtype,kv", [
+    (2, jnp.bfloat16, "int8", 2),   # the acceptance pair (GQA+int8)
+    (2, jnp.float32, None, 0),      # full-MHA cache width
+    pytest.param(4, jnp.bfloat16, None, 0, marks=pytest.mark.slow),
+    pytest.param(4, jnp.bfloat16, "int8", 0,
+                 marks=pytest.mark.slow),
+])
+def test_kernel_tp_decode_parity(tp, compute_dtype, cache_dtype, kv):
+    """The kernel path at tp>1: the in-kernel block-table walk runs
+    per-shard over the heads-sliced pool UNMODIFIED (the work lists
+    are sharding-oblivious host values) and the greedy stream equals
+    the tp-sharded XLA sweep's AND the dense control's, with one
+    decode compile."""
+    from torchbooster_tpu.serving import PagedEngine
+
+    params, cfg = _decisive_model(n_kv_heads=kv)
+    ids = np.asarray(jax.random.randint(
+        jax.random.PRNGKey(1), (1, 5), 0, cfg.vocab)[0])
+    n_new = 8
+    mesh = _tp_mesh(tp)
+    streams = {}
+    for backend in ("xla", "pallas"):
+        engine = PagedEngine(params, cfg, page_size=4, n_pages=16,
+                             max_slots=2, cache_dtype=cache_dtype,
+                             compute_dtype=compute_dtype,
+                             decode_backend=backend, tp=tp, mesh=mesh)
+        streams[backend] = _paged_tokens(engine, ids, n_new)
+        engine.tables.check()
+        assert engine.decode_compiles == 1
+    np.testing.assert_array_equal(
+        _dense(params, cfg, ids, n_new, compute_dtype, cache_dtype),
+        streams["pallas"])
+    assert streams["pallas"] == streams["xla"]
+
+
+@pytest.mark.parametrize("cache_dtype", [
+    None, pytest.param("int8", marks=pytest.mark.slow)])
+def test_kernel_tp_spec_verify_parity(cache_dtype):
+    """The fused speculative verify through the kernel at tp=2: one
+    head-sharded kernel walk scores the whole draft burst, emitting
+    token-for-token the single-chip pallas spec engine's stream, with
+    exactly one verify compile."""
+    from torchbooster_tpu.serving import PagedEngine
+
+    compute_dtype = jnp.bfloat16 if cache_dtype else jnp.float32
+    params, cfg = _decisive_model()
+    rs = np.random.RandomState(2)
+    prompt = np.tile(rs.randint(0, 97, 3).astype(np.int32), 3)
+    n_new = 10
+    kw = dict(page_size=8, n_pages=16, max_slots=2,
+              compute_dtype=compute_dtype, cache_dtype=cache_dtype,
+              speculative=True, draft_len=3, decode_backend="pallas")
+
+    ref = PagedEngine(params, cfg, **kw)
+    want = _spec_tokens(ref, prompt, n_new)
+    eng = PagedEngine(params, cfg, tp=2, mesh=_tp_mesh(2), **kw)
+    got = _spec_tokens(eng, prompt, n_new)
+    assert got == want
+    assert eng.verify_compiles == 1
+    assert eng.spec_accepted > 0, (
+        "the repetitive stream never accepted a draft — the fused "
+        "multi-token path was not exercised at tp=2")
+
+
+def test_kernel_tp_prefix_shared_and_churn_one_compile():
+    """Prefix-shared decode through the kernel at tp=2 (the shared
+    page is one work entry serving both sharers on every chip's head
+    shard), then admit/retire churn: exactly one decode compile
+    end to end."""
+    from torchbooster_tpu.serving import PagedEngine
+
+    params, cfg = _decisive_model()
+    rs = np.random.RandomState(4)
+    shared = rs.randint(0, 97, 8).astype(np.int32)     # 2 full pages
+    p_a = np.concatenate([shared, rs.randint(0, 97, 3).astype(np.int32)])
+    p_b = np.concatenate([shared, rs.randint(0, 97, 5).astype(np.int32)])
+    n_new = 5
+
+    def serve_pair(**kw):
+        eng = PagedEngine(params, cfg, page_size=4, n_pages=16,
+                          max_slots=2, prefix_cache=True,
+                          prefill_chunk_pages=1,
+                          decode_backend="pallas", **kw)
+        slot_a, first_a = eng.admit(p_a)
+        slot_b, first_b = eng.admit(p_b)
+        assert int(eng.tables.refcount.max()) >= 2
+        toks = {slot_a: [first_a], slot_b: [first_b]}
+        for _ in range(n_new - 1):
+            assert eng.grow_slots() == []
+            t = eng.step()
+            toks[slot_a].append(int(t[slot_a]))
+            toks[slot_b].append(int(t[slot_b]))
+        eng.retire(slot_a)
+        eng.retire(slot_b)
+        # churn: a fresh admission decodes through the SAME executable
+        slot_c, _ = eng.admit(rs.randint(0, 97, 6).astype(np.int32))
+        assert eng.grow_slots() == []
+        eng.step()
+        eng.retire(slot_c)
+        eng.tables.check()
+        return toks[slot_a], toks[slot_b], eng
+
+    want_a, want_b, _ = serve_pair()
+    got_a, got_b, eng = serve_pair(tp=2, mesh=_tp_mesh(2))
+    assert got_a == want_a and got_b == want_b
+    assert eng.decode_compiles == 1
+    assert eng.prefill_compiles == 1

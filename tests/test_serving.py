@@ -1170,3 +1170,245 @@ def test_serving_config_builds_batcher():
     es = scs.make(params, cfg, compute_dtype=jnp.float32).engine
     assert es.speculative and es.draft_len == 3
     assert es.verify_compiles == 0          # built, never traced yet
+
+
+# ---- tensor-parallel serving (serving/tp.py) ---------------------
+
+
+def _tp_mesh(tp):
+    from torchbooster_tpu.distributed import make_mesh
+
+    return make_mesh(f"tp:{tp}", n_devices=tp)
+
+
+@pytest.mark.parametrize("tp,compute_dtype,cache_dtype,kv", [
+    (2, jnp.bfloat16, "int8", 2),   # the acceptance pair: GQA + int8
+    (2, jnp.float32, None, 0),      # full-MHA cache width
+    pytest.param(4, jnp.bfloat16, None, 0, marks=pytest.mark.slow),
+    pytest.param(4, jnp.bfloat16, "int8", 0,
+                 marks=pytest.mark.slow),
+    pytest.param(2, jnp.bfloat16, None, 2, marks=pytest.mark.slow),
+])
+def test_tp_decode_matches_dense_jit_generate(tp, compute_dtype,
+                                              cache_dtype, kv):
+    """The headline tp parity: the head-sharded engine (pool sharded
+    on KV heads, qkv/proj Megatron-split, one psum per layer) decodes
+    the EXACT greedy tokens of the dense ``jit_generate`` control —
+    MHA+GQA × bf16+int8 pages, tp ∈ {2, 4} on the forced-8-device CPU
+    mesh (tp=1 is the whole pre-existing suite)."""
+    from torchbooster_tpu.serving import PagedEngine
+
+    params, cfg = _decisive_model(n_kv_heads=kv)
+    ids = jax.random.randint(jax.random.PRNGKey(1), (1, 5), 0,
+                             cfg.vocab)
+    n_new = 8
+    want = GPT.generate(params, ids, cfg, n_new=n_new, temperature=0.0,
+                        compute_dtype=compute_dtype,
+                        cache_dtype=cache_dtype)
+    engine = PagedEngine(params, cfg, page_size=4, n_pages=16,
+                         max_slots=2, cache_dtype=cache_dtype,
+                         compute_dtype=compute_dtype,
+                         tp=tp, mesh=_tp_mesh(tp))
+    got = _paged_tokens(engine, np.asarray(ids[0]), n_new)
+    np.testing.assert_array_equal(np.asarray(want[0, 5:]), got)
+    assert engine.decode_compiles == 1
+    assert engine.tp == tp
+    engine.tables.check()
+
+
+def test_tp_prefix_shared_two_slot_parity():
+    """Two LIVE slots sharing prefix pages through the multi-lane
+    sweep at tp=2 emit exactly the tp=1 engine's tokens — the
+    prefix-shared acceptance path: the shared page's one pool read
+    serves both sharers on every chip's head shard."""
+    from torchbooster_tpu.serving import PagedEngine
+
+    params, cfg = _decisive_model()
+    rs = np.random.RandomState(3)
+    shared = rs.randint(0, 97, 8).astype(np.int32)     # 2 full pages
+    p_a = np.concatenate([shared, rs.randint(0, 97, 3).astype(np.int32)])
+    p_b = np.concatenate([shared, rs.randint(0, 97, 2).astype(np.int32)])
+    n_new = 6
+
+    def serve_pair(**kw):
+        eng = PagedEngine(params, cfg, page_size=4, n_pages=24,
+                          max_slots=2, prefix_cache=True, **kw)
+        slot_a, first_a = eng.admit(p_a)
+        slot_b, first_b = eng.admit(p_b)
+        toks = {slot_a: [first_a], slot_b: [first_b]}
+        for _ in range(n_new - 1):
+            assert eng.grow_slots() == []
+            step = eng.step()
+            for s in (slot_a, slot_b):
+                toks[s].append(int(step[s]))
+        eng.tables.check()
+        return toks[slot_a], toks[slot_b], eng
+
+    want_a, want_b, _ = serve_pair()
+    got_a, got_b, eng = serve_pair(tp=2, mesh=_tp_mesh(2))
+    assert got_a == want_a and got_b == want_b
+    assert eng.decode_compiles == 1
+
+
+@pytest.mark.parametrize("cache_dtype", [
+    None, pytest.param("int8", marks=pytest.mark.slow)])
+def test_tp_spec_greedy_parity(cache_dtype):
+    """Speculative verify at tp=2: the head-sharded multi-token
+    verify step emits token-for-token the tp=1 speculative engine's
+    greedy stream, through ONE verify compile."""
+    from torchbooster_tpu.serving import PagedEngine
+
+    params, cfg = _decisive_model()
+    prompt = _repetitive_prompt(np.random.RandomState(5))
+    n_new = 10
+
+    def serve(**kw):
+        eng = PagedEngine(params, cfg, page_size=8, n_pages=16,
+                          max_slots=2, cache_dtype=cache_dtype,
+                          speculative=True, draft_len=3, **kw)
+        toks = _spec_tokens(eng, prompt, n_new)
+        return toks, eng
+
+    want, _ = serve()
+    got, eng = serve(tp=2, mesh=_tp_mesh(2))
+    assert got == want
+    assert eng.verify_compiles == 1
+    assert eng.decode_compiles == 0     # spec engines never decode
+
+
+def test_tp_zero_recompile_churn():
+    """The zero-recompile contract holds at tp>1: exactly one decode
+    and one prefill-chunk compile across admit/retire/evict and
+    mixed prompt-length churn on the sharded engine."""
+    from torchbooster_tpu.serving import PagedEngine
+
+    params, cfg = _decisive_model()
+    rs = np.random.RandomState(7)
+    engine = PagedEngine(params, cfg, page_size=4, n_pages=12,
+                         max_slots=2, prefix_cache=True,
+                         tp=2, mesh=_tp_mesh(2))
+    for ln in (3, 9, 5, 13, 7):        # mixed lengths, pool pressure
+        prompt = rs.randint(0, 97, ln).astype(np.int32)
+        slot, _ = engine.admit(prompt)
+        for _ in range(2):
+            assert engine.grow_slots() == []
+            engine.step()
+        engine.retire(slot)
+        engine.tables.check()
+    assert engine.decode_compiles == 1
+    assert engine.prefill_compiles == 1
+
+
+def test_tp_randomized_churn_check_invariants():
+    """Randomized admit/decode/retire churn under tp=2 (prefix cache
+    on, eviction pressure): the block-table invariants (``check()``)
+    hold after every mutation — the host-side bookkeeping must be
+    byte-identical to the single-chip engine's."""
+    from torchbooster_tpu.serving import PagedEngine
+
+    params, cfg = _decisive_model()
+    rs = np.random.RandomState(11)
+    engine = PagedEngine(params, cfg, page_size=4, n_pages=10,
+                         max_slots=2, prefix_cache=True,
+                         tp=2, mesh=_tp_mesh(2))
+    live: list[int] = []
+    for _ in range(24):
+        op = rs.randint(3)
+        if op == 0 and len(live) < 2:
+            prompt = rs.randint(0, 97, rs.randint(2, 11)).astype(
+                np.int32)
+            if engine.can_admit(prompt):
+                got = engine.admit(prompt)
+                if got is not None:
+                    live.append(got[0])
+        elif op == 1 and live:
+            if engine.grow_slots() == []:
+                engine.step()
+        elif op == 2 and live:
+            engine.retire(live.pop(rs.randint(len(live))))
+        engine.tables.check()
+    assert engine.decode_compiles <= 1
+
+
+def test_tp_validation():
+    """The loud-validation satellites: tp must divide the KV-head
+    count (numbers in the message), a tp>1 build needs a committed
+    mesh, and the mesh's tp axis must exist and match exactly —
+    at the engine ctor AND at ServingConfig level."""
+    from torchbooster_tpu.config import ServingConfig
+    from torchbooster_tpu.serving import PagedEngine
+
+    params, cfg = _decisive_model()          # n_heads=4, n_kv_heads=2
+    # tp doesn't divide n_kv_heads (GQA): both numbers in the message
+    with pytest.raises(ValueError, match=r"tp=4.*n_kv_heads=2"):
+        PagedEngine(params, cfg, page_size=4, tp=4, mesh=_tp_mesh(4))
+    # tp>1 without a committed mesh
+    with pytest.raises(ValueError, match="committed mesh|no mesh"):
+        PagedEngine(params, cfg, page_size=4, tp=2)
+    # mesh without a tp axis
+    from torchbooster_tpu.distributed import make_mesh
+    with pytest.raises(ValueError, match="no 'tp' axis"):
+        PagedEngine(params, cfg, page_size=4, tp=2,
+                    mesh=make_mesh("dp:2", n_devices=2))
+    # tp exceeding the mesh's tp axis size: both numbers
+    with pytest.raises(ValueError, match=r"tp=2.*size 1"):
+        PagedEngine(params, cfg, page_size=4, tp=2,
+                    mesh=make_mesh("tp:1", n_devices=1))
+    with pytest.raises(ValueError, match=">= 1"):
+        PagedEngine(params, cfg, page_size=4, tp=0)
+    # the same rejections at YAML level, BEFORE any engine state
+    sc = ServingConfig(page_size=4, n_pages=16, max_slots=2, tp=4)
+    with pytest.raises(ValueError, match=r"tp=4.*n_kv_heads=2"):
+        sc.make(params, cfg, mesh=_tp_mesh(4))
+    sc2 = ServingConfig(page_size=4, n_pages=16, max_slots=2, tp=2)
+    with pytest.raises(ValueError, match="committed mesh|no mesh"):
+        sc2.make(params, cfg)
+    # MHA naming: the message blames n_heads when there is no GQA
+    _, mha = _decisive_model(n_kv_heads=0)
+    with pytest.raises(ValueError, match=r"tp=3.*n_heads=4"):
+        PagedEngine(params, mha, page_size=4, tp=3, mesh=_tp_mesh(2))
+
+
+def test_tp_yaml_config_roundtrip_builds_batcher(tmp_path):
+    """YAML → ``ServingConfig`` → batcher round-trip at tp=2: the
+    typed ``serving.tp`` key reaches the engine, the batcher serves a
+    request to the tp=1 config build's exact tokens, the
+    ``serving_tp_bytes_total`` counter accumulates the modeled psum
+    bytes, and the flight recorder's per-step records carry tp=2."""
+    from torchbooster_tpu.config import ServingConfig
+    from torchbooster_tpu.observability import get_registry
+    from torchbooster_tpu.serving import Request
+
+    params, cfg = _decisive_model()
+    path = tmp_path / "serving.yaml"
+    path.write_text(
+        "page_size: 4\nn_pages: 16\nmax_slots: 2\ntp: 2\n")
+    sc = ServingConfig.load(path)
+    assert sc.tp == 2
+    ids = np.asarray(
+        jax.random.randint(jax.random.PRNGKey(3), (5,), 0, cfg.vocab))
+
+    ref = ServingConfig(page_size=4, n_pages=16, max_slots=2)
+    req1 = Request(prompt=ids, max_new_tokens=4)
+    ref.make(params, cfg, compute_dtype=jnp.float32).run([req1])
+
+    batcher = sc.make(params, cfg, compute_dtype=jnp.float32,
+                      mesh=_tp_mesh(2))
+    assert batcher.engine.tp == 2
+    reg = get_registry()
+    enabled0 = reg.enabled
+    reg.enabled = True
+    try:
+        req2 = Request(prompt=ids, max_new_tokens=4)
+        batcher.run([req2])
+        total = reg.counter("serving_tp_bytes_total").value()
+    finally:
+        reg.enabled = enabled0
+    assert req2.tokens == req1.tokens
+    # the modeled psum counter landed (decode steps ran at tp=2)
+    per_step = batcher.engine.tp_step_traffic(1)["wire_bytes"]
+    assert total > 0 and total % per_step == 0
+    # ... and the flight ring records which topology each step took
+    tails = batcher.flight.tail(4)
+    assert tails and all(row["tp"] == 2 for row in tails)
+    assert batcher.engine.debug_stats()["tp"] == 2

@@ -741,6 +741,17 @@ class ServingConfig(BaseConfig):
     instead of the pool capacity — docs/performance.md has the
     two-regime roofline. ``xla`` (default) keeps the pool sweep and
     is the A/B control; both are token-exact for greedy decode.
+
+    ``tp > 1`` runs the engine TENSOR-PARALLEL over a committed mesh's
+    ``tp`` (heads) axis (serving/tp.py; pass the mesh to
+    :meth:`make`): Q/K/V/O projections and the KV page pool shard by
+    heads, so per-chip KV bytes/step — the decode roofline's
+    numerator — divide by ``tp``; block tables and all scheduling
+    stay host-side and replicated. ``tp`` must divide ``n_kv_heads``
+    (GQA shards by KV-head groups; ``n_heads`` under MHA) and must
+    equal the mesh's ``tp`` axis size — both rejected loudly with the
+    offending numbers, at YAML time here and again at engine build.
+    The default ``tp: 1`` is the single-chip engine, bit-for-bit.
     """
 
     page_size: int = 64
@@ -756,12 +767,14 @@ class ServingConfig(BaseConfig):
     draft_len: int = 4                 # drafted tokens per verify step
     ngram_min: int = 2                 # shortest prompt-lookup n-gram
     decode_backend: str = "xla"        # "xla" pool sweep | "pallas" kernel
+    tp: int = 1                        # tensor-parallel head shards (mesh "tp" axis)
     frontend: FrontendConfig = dataclasses.field(
         default_factory=FrontendConfig)  # HTTP front door + scheduler
 
     def make(self, params: Any, model_cfg: Any,
              compute_dtype: Any = None,
-             on_recompile: str = "warn") -> Any:
+             on_recompile: str = "warn",
+             mesh: Any = None) -> Any:
         """Build the engine + batcher for ``params``/``model_cfg`` (a
         :class:`~torchbooster_tpu.models.gpt.GPTConfig`). Returns the
         :class:`~torchbooster_tpu.serving.ContinuousBatcher` — with
@@ -771,11 +784,21 @@ class ServingConfig(BaseConfig):
         ``self.frontend.make(batcher)`` wraps it in the HTTP server.
         ``on_recompile`` is the batcher's runtime-guard policy — pass
         your ``ObservabilityConfig.on_recompile`` so the YAML policy
-        reaches the one region the docs advertise as guarded."""
+        reaches the one region the docs advertise as guarded.
+        ``mesh`` is the committed device mesh a ``tp > 1`` build
+        shards over (must carry a ``tp`` axis of exactly that size —
+        validated here with the offending numbers BEFORE any engine
+        state is built, and again by the engine ctor)."""
         import jax.numpy as jnp
 
         from torchbooster_tpu.serving import ContinuousBatcher, PagedEngine
+        from torchbooster_tpu.serving.tp import check_tp
 
+        # YAML-time rejection: a tp that does not divide the model's
+        # KV-head count, exceeds/mismatches the mesh's tp axis, or
+        # arrives without a committed mesh must fail HERE, with the
+        # numbers, not as a shard_map shape error mid-build
+        check_tp(self.tp, model_cfg, mesh)
         engine = PagedEngine(
             params, model_cfg,
             page_size=self.page_size, n_pages=self.n_pages,
@@ -789,7 +812,8 @@ class ServingConfig(BaseConfig):
             prefill_chunk_pages=self.prefill_chunk_pages,
             speculative=self.speculative,
             draft_len=self.draft_len, ngram_min=self.ngram_min,
-            decode_backend=self.decode_backend)
+            decode_backend=self.decode_backend,
+            tp=self.tp, mesh=mesh)
         return ContinuousBatcher(engine, on_recompile=on_recompile,
                                  policy=self.frontend.make_policy())
 

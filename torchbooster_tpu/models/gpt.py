@@ -757,6 +757,7 @@ def _block_core(bp: dict, x: jax.Array, cfg: GPTConfig, attend,
                 dropout: float = 0.0,
                 dropout_key: jax.Array | None = None,
                 tp: tuple[str, int] | None = None,
+                tp_attn: tuple[str, int] | None = None,
                 ep: tuple[str, int] | None = None
                 ) -> tuple[jax.Array, jax.Array, Any]:
     """The transformer block math, shared by every path (training
@@ -772,6 +773,12 @@ def _block_core(bp: dict, x: jax.Array, cfg: GPTConfig, attend,
     callers (the pipeline): bp holds per-rank Megatron slices —
     column-parallel qkv/fc1/fc3 (local head/hidden subset), row-
     parallel proj/fc2 (psum over ``axis`` before the bias).
+    ``tp_attn=(axis, size)``: MANUAL tensor parallelism over the
+    ATTENTION only (the serving engine's layout, serving/tp.py): bp
+    holds per-rank qkv/proj slices exactly as under ``tp`` but the
+    MLP (and MoE) weights are FULL and every rank computes them
+    redundantly with NO reduce — one psum per layer (after the
+    O projection) instead of two; mutually exclusive with ``tp``.
     ``ep=(axis, size)``: MANUAL expert parallelism — bp's expert
     tensors hold this rank's slice (``moe_apply(ep=...)``). The
     auto-SPMD paths leave both None and let XLA place the collectives.
@@ -780,11 +787,21 @@ def _block_core(bp: dict, x: jax.Array, cfg: GPTConfig, attend,
     n_heads, kv_heads = cfg.n_heads, cfg.kv_heads
     head_dim = d // n_heads
     reduce = lambda y: y
+    attn_reduce = reduce
+    if tp is not None and tp_attn is not None:
+        raise ValueError("_block_core: tp and tp_attn are mutually "
+                         "exclusive manual-parallelism modes")
     if tp is not None:
         tp_axis, tp_size = tp
         n_heads //= tp_size
         kv_heads //= tp_size
         reduce = lambda y: jax.lax.psum(y, tp_axis)
+        attn_reduce = reduce
+    elif tp_attn is not None:
+        tp_axis, tp_size = tp_attn
+        n_heads //= tp_size
+        kv_heads //= tp_size
+        attn_reduce = lambda y: jax.lax.psum(y, tp_axis)
     q_width = n_heads * head_dim
     aux = jnp.zeros((), jnp.float32)
 
@@ -806,7 +823,8 @@ def _block_core(bp: dict, x: jax.Array, cfg: GPTConfig, attend,
         k_attn = k_mlp = None
     o, extras = attend(q, k, v)
     x = constrain(x + _dropout(
-        _row_dense(bp["attn_proj"], o.reshape(b, s, q_width), reduce),
+        _row_dense(bp["attn_proj"], o.reshape(b, s, q_width),
+                   attn_reduce),
         dropout, k_attn))
     h = L.layer_norm(bp["ln2"], x)
     if cfg.n_experts > 0:

@@ -64,6 +64,9 @@ _DTYPE = np.dtype([
     ("pages_cached", np.int32), ("queue_depth", np.int32),
     ("tokens", np.int32), ("accept_rate", np.float32),
     ("wall_s", np.float32), ("recompiled", np.bool_),
+    # tensor-parallel head shards the step ran over (1 = single-chip):
+    # a post-mortem must show WHICH topology the recorded steps took
+    ("tp", np.int16),
 ])
 
 # watchdog cadence/thresholds: p99 refresh interval (records), minimum
@@ -110,7 +113,7 @@ class FlightRecorder:
                pages_live: int, pages_free: int, pages_cached: int,
                queue_depth: int, tokens: int, accept_rate: float,
                wall_s: float, recompiled: bool = False,
-               inflight: Iterable[str] = ()) -> None:
+               inflight: Iterable[str] = (), tp: int = 1) -> None:
         """Write one step record in place and run the watchdog."""
         seq = self._seq
         row = self._ring[seq % self.capacity]
@@ -126,6 +129,7 @@ class FlightRecorder:
         row["accept_rate"] = accept_rate
         row["wall_s"] = wall_s
         row["recompiled"] = recompiled
+        row["tp"] = tp
         self._seq = seq + 1
         if recompiled:
             self._anomalies.append({

@@ -30,6 +30,13 @@ package stops streaming dead bytes:
   replay drivers (in-process deterministic clock, or real HTTP
   clients, at ×N time compression), and SLO conformance reports with
   a baseline-diff gate (``scripts/replay_diff.py``);
+- :mod:`tp` — tensor-parallel serving (``tp: N``): every compiled
+  step's attention — Q/K/V/O projections, the KV page pool, the
+  decode sweep, the pallas table walk, the fused verify — sharded
+  over a committed mesh's ``tp`` (heads) axis via shard_map, so
+  per-chip KV bytes/step divide by ``tp`` for ONE activation psum
+  per layer; block tables and all scheduling stay host-side and
+  replicated (docs/parallelism.md "Tensor-parallel serving");
 - :mod:`frontend` — the request-facing surface: scheduler policies
   (:class:`FCFSPolicy`/:class:`SLOPolicy` — priority classes,
   deadline-driven admission, cost-aware preemption, load shedding)

@@ -459,6 +459,22 @@ class ContinuousBatcher:
                 "serving_spec_accept_rate",
                 "accepted/proposed draft tokens over this run"),
         }
+        if self.engine.tp > 1:
+            # tensor-parallel serving only (absent at tp=1 so the
+            # single-chip registry view is untouched): the modeled
+            # per-chip wire bytes of each decode/verify step's
+            # decode-output psum (serving/tp.py step_traffic — the
+            # closed-form model the serve_tp bench checks against the
+            # compiled HLO). One host-side float add per step, never
+            # a device read.
+            inst["tp_bytes"] = reg.counter(
+                "serving_tp_bytes_total",
+                "modeled per-chip decode-output psum wire bytes "
+                "(tensor-parallel serving)")
+            self._tp_decode_bytes = \
+                self.engine.tp_step_traffic(1)["wire_bytes"]
+            self._tp_verify_bytes = self.engine.tp_step_traffic(
+                1 + self.engine.draft_len)["wire_bytes"]
         if self.policy.slo:
             # per-class SLO families (absent entirely under FCFS so
             # the cold path's registry view is untouched); every
@@ -735,7 +751,8 @@ class ContinuousBatcher:
                 inflight=([r.request_id
                            for r in (*s.filling.values(),
                                      *s.live.values())]
-                          if recompiled else ()))
+                          if recompiled else ()),
+                tp=eng.tp)
         return events
 
     def _step_body(self, s: _Session, st: dict,
@@ -862,6 +879,13 @@ class ContinuousBatcher:
         if not s.live:
             return events
         # --- one compiled step over every live slot ---
+        if self.engine.tp > 1:
+            # the step about to run pays its decode-output psum on
+            # the wire: land the MODELED per-chip bytes (precomputed
+            # constants — one float add, no device read)
+            self._inst["tp_bytes"].inc(
+                self._tp_verify_bytes if self.engine.speculative
+                else self._tp_decode_bytes)
         t_step = self.clock()
         if self.engine.speculative:
             # draft → batched verify → accept: each slot emits

@@ -70,12 +70,20 @@ from torchbooster_tpu.models.gpt import (
     _lm_head,
     _make_pick,
     _quantize_kv,
+    qkv_to_tp_major,
 )
 from torchbooster_tpu.ops.paged_attention import paged_attention
 from torchbooster_tpu.serving.kv_pages import (
     NULL_PAGE,
     BlockTables,
     make_pool,
+)
+from torchbooster_tpu.serving.tp import (
+    check_tp,
+    param_specs as _tp_param_specs,
+    place as _tp_place,
+    shard_engine_fn as _shard_engine_fn,
+    step_traffic as _tp_step_traffic,
 )
 from torchbooster_tpu.serving.speculative import (
     PromptLookupDrafter,
@@ -134,6 +142,26 @@ class PagedEngine:
     per executable across churn, and the default ``"xla"`` leaves the
     engine — including its jitted call signatures — bit-for-bit
     unchanged.
+
+    ``tp > 1`` (with a committed ``mesh`` carrying a ``tp`` axis of
+    that size) shards every compiled step's ATTENTION over the mesh's
+    tp (heads) axis (serving/tp.py): qkv column-parallel with
+    rank-major columns, O-projection row-parallel with ONE psum per
+    layer, and the KV page pool sharded on its KV-head axis — per-chip
+    KV bytes/step are the single-chip engine's ÷ tp, which on the
+    HBM-bound decode loop is the tokens/s story (docs/parallelism.md
+    "Tensor-parallel serving"). GQA shards by KV-head groups (query
+    heads follow their group; ``tp`` must divide ``n_kv_heads`` — or
+    ``n_heads`` under MHA). Block tables, refcounts, the prefix
+    index, and all scheduling stay host-side and replicated — every
+    chip walks the same tables over its own head shard, so
+    seat/retire/evict/CoW logic is byte-identical to the single-chip
+    engine's, and both backends (the sweep and the pallas table walk)
+    shard the same way with no kernel changes. Greedy decode is
+    token-exact vs tp=1 and vs dense ``jit_generate``; the
+    zero-recompile contract holds per executable; the default
+    ``tp=1`` builds no shard_map wrapper at all — same compiled
+    artifacts, same call signatures.
     """
 
     def __init__(self, params: dict, cfg: GPTConfig, *,
@@ -148,7 +176,9 @@ class PagedEngine:
                  speculative: bool = False,
                  draft_len: int = 4,
                  ngram_min: int = 2,
-                 decode_backend: str = "xla"):
+                 decode_backend: str = "xla",
+                 tp: int = 1,
+                 mesh: Any = None):
         if cfg.seq_len % page_size:
             # a last partial page per slot would shift page_pos math;
             # geometry is static, so fail loudly at construction
@@ -179,6 +209,16 @@ class PagedEngine:
         # pos="learned" (or vice versa, or a tp-major-permuted tree)
         # must fail here, not decode garbage quietly
         _check_pos(params, cfg)
+        # tensor-parallel serving (serving/tp.py): tp > 1 shards the
+        # attention of every compiled step — Q/K/V/O projections and
+        # the KV page pool — over the mesh's tp (heads) axis; all
+        # host-side tables and scheduling stay replicated. tp == 1 is
+        # the single-chip engine, bit-for-bit: no mesh, no permute,
+        # no shard_map wrapper, the same jitted call signatures.
+        check_tp(tp, cfg, mesh)
+        self.tp = int(tp)
+        self.mesh = mesh if self.tp > 1 else None
+        self._tp_core = ("tp", self.tp) if self.tp > 1 else None
         self.params = params
         self.cfg = cfg
         self.page_size = page_size
@@ -198,6 +238,16 @@ class PagedEngine:
         self.pool = make_pool(cfg, page_size, n_pages,
                               cache_dtype=cache_dtype,
                               compute_dtype=compute_dtype)
+        if self.tp > 1:
+            # one-time layout work, never per step: permute the qkv
+            # columns rank-major (rank i holds [q_i | k_i | v_i] — a
+            # contiguous tp split of the canonical stack would hand
+            # rank 0 all of q) and place params + pool on the mesh —
+            # qkv column-parallel, O-projection row-parallel, pool
+            # sharded on KV heads, everything else replicated
+            self.params = qkv_to_tp_major(params, cfg, self.tp)
+            self.params, self.pool = _tp_place(self.params, self.pool,
+                                               mesh)
         # decode_backend selects HOW the decode/verify steps READ the
         # pool: "xla" (default) is the whole-pool sweep — the A/B
         # control, bit-for-bit the pre-kernel engine; "pallas" walks
@@ -225,10 +275,23 @@ class PagedEngine:
         # the pool crosses the jit boundary EVERY call — donate it so
         # XLA updates the pages in place; an undonated pool would copy
         # pool-sized bytes per step, re-taxing exactly the HBM traffic
-        # the pager removes (CPU backends ignore donation — harmless)
-        self._chunk_jit = jax.jit(self._chunk_fn, donate_argnums=(1, 2))
-        self._decode_jit = jax.jit(self._decode_fn,
-                                   donate_argnums=(1, 2))
+        # the pager removes (CPU backends ignore donation — harmless).
+        # At tp > 1 the SAME step bodies run under shard_map: pools
+        # sharded on KV heads, host tables replicated, outputs
+        # replicated post-psum; at tp == 1 the un-wrapped jits below
+        # are byte-identical to the single-chip engine's.
+        n_extra = 3 if decode_backend == "pallas" else 0
+        if self.tp > 1:
+            pspecs = _tp_param_specs(self.params)
+            self._chunk_jit = _shard_engine_fn(self._chunk_fn, mesh,
+                                               pspecs, 5, 1)
+            self._decode_jit = _shard_engine_fn(self._decode_fn, mesh,
+                                                pspecs, 7 + n_extra, 1)
+        else:
+            self._chunk_jit = jax.jit(self._chunk_fn,
+                                      donate_argnums=(1, 2))
+            self._decode_jit = jax.jit(self._decode_fn,
+                                       donate_argnums=(1, 2))
         # speculative mode (serving/speculative.py): the drafter and
         # the ONE multi-token verify executable exist only when it is
         # on — the cold engine's compiled artifacts and per-step work
@@ -241,8 +304,13 @@ class PagedEngine:
         if self.speculative:
             self._drafter = PromptLookupDrafter(draft_len,
                                                 ngram_min=ngram_min)
-            self._verify_jit = jax.jit(make_verify_fn(self),
-                                       donate_argnums=(1, 2))
+            verify_fn = make_verify_fn(self)
+            if self.tp > 1:
+                self._verify_jit = _shard_engine_fn(
+                    verify_fn, mesh, pspecs, 7 + n_extra, 2)
+            else:
+                self._verify_jit = jax.jit(verify_fn,
+                                           donate_argnums=(1, 2))
 
     @classmethod
     def dense_control(cls, params: dict, cfg: GPTConfig, *,
@@ -279,6 +347,10 @@ class PagedEngine:
         n_cp = C // ps
         mp = table_row.shape[0]
         head_dim = cfg.d_model // cfg.n_heads
+        # per-shard head count: cfg.n_heads / tp local query heads
+        # under the tp shard_map, == cfg.n_heads at tp=1 (python
+        # arithmetic — the single-chip jaxpr is unchanged)
+        n_heads_l = cfg.n_heads // self.tp
         positions = start + jnp.arange(C)
 
         x = L.embedding(params["wte"], ids, dtype=self.compute_dtype)
@@ -342,14 +414,15 @@ class PagedEngine:
                 # (B, g, rep, S_q) weights -> (B, S_q, g, rep, 1)
                 mv = lambda t: jnp.moveaxis(t, -1, 1)[..., None]
                 o = (oA * mv(wA) + oB * mv(wB)) / mv(l)
-                o = o.reshape(1, C, cfg.n_heads, head_dim)
+                o = o.reshape(1, C, n_heads_l, head_dim)
                 return o.astype(q.dtype), (new_k, new_v)
 
             x, _, (pk, pv) = _block_core(
                 bp, x, cfg, attend,
                 capacity_factor=max(cfg.capacity_factor,
                                     float(cfg.n_experts)),
-                positions=positions[None])      # per-slot rope depth
+                positions=positions[None],      # per-slot rope depth
+                tp_attn=self._tp_core)
             return x, (pk, pv)
 
         x, (pool_k, pool_v) = jax.lax.scan(
@@ -369,6 +442,7 @@ class PagedEngine:
         ``kernel_args()``); the XLA sweep never receives them."""
         cfg, ps = self.cfg, self.page_size
         n_slots = last_ids.shape[0]
+        n_heads_l = cfg.n_heads // self.tp    # local heads (tp shard)
 
         x = L.embedding(params["wte"], last_ids[:, None],
                         dtype=self.compute_dtype)
@@ -472,7 +546,7 @@ class PagedEngine:
                                           num_segments=n_slots + 1)
                 o = o_s[:n_slots] / jnp.maximum(
                     l_s[:n_slots], 1e-30)[..., None]
-                o = o.reshape(n_slots, 1, cfg.n_heads,
+                o = o.reshape(n_slots, 1, n_heads_l,
                               cfg.d_model // cfg.n_heads)
                 return o.astype(q.dtype), (new_k, new_v)
 
@@ -480,7 +554,8 @@ class PagedEngine:
                 bp, x, cfg, attend,
                 capacity_factor=max(cfg.capacity_factor,
                                     float(cfg.n_experts)),
-                positions=lengths[:, None])     # per-slot rope depth
+                positions=lengths[:, None],     # per-slot rope depth
+                tp_attn=self._tp_core)
             return x, (pk, pv)
 
         x, (pool_k, pool_v) = jax.lax.scan(
@@ -784,6 +859,7 @@ class PagedEngine:
         t = self.tables
         return {
             "backend": self.decode_backend,
+            "tp": self.tp,
             "speculative": self.speculative,
             "quantized": self.quantized,
             "page_size": self.page_size,
@@ -805,6 +881,31 @@ class PagedEngine:
                          "prefill": self.prefill_compiles,
                          "verify": self.verify_compiles},
         }
+
+    def tp_step_traffic(self, s_q: int = 1) -> dict:
+        """Modeled per-chip wire bytes of one decode (``s_q=1``) or
+        speculative-verify (``s_q = 1 + draft_len``) step's
+        decode-output psum — zeros at tp=1 (no collective exists).
+        Host arithmetic only; the ``serving_tp_bytes_total`` counter
+        and the serve_tp bench's accounting-vs-HLO gate both read
+        this model (serving/tp.py ``step_traffic``)."""
+        return _tp_step_traffic(self.tp, self.cfg, self.max_slots,
+                                self.compute_dtype, s_q=s_q)
+
+    def decode_hlo_text(self) -> str:
+        """The compiled decode step's HLO text, for OFFLINE collective
+        accounting (``comms/accounting.xla_collective_traffic`` — the
+        serve_tp bench's model-vs-compiler gate). An AOT lower +
+        compile with the engine's live operands: bench/debug only,
+        never on the decode hot path."""
+        args = self.tables.device_args()
+        extra = self._kernel_operands()
+        lowered = self._decode_jit.lower(
+            self.params, self.pool["k"], self.pool["v"],
+            args["tables"], args["lengths"], args["refs"],
+            args["page_pos"], args["active"], args["last_ids"],
+            self._rng, *extra)
+        return lowered.compile().as_text()
 
     @property
     def prefix_hit_rate(self) -> float:

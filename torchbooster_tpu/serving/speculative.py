@@ -191,6 +191,10 @@ def make_verify_fn(engine):
     k = engine.draft_len
     S = k + 1
     head_dim = cfg.d_model // cfg.n_heads
+    # per-shard head count under tensor-parallel serving
+    # (serving/tp.py): == cfg.n_heads at tp=1, so the single-chip
+    # trace is unchanged
+    n_heads_l = cfg.n_heads // engine.tp
     spec_pick = _make_spec_pick(engine.temperature, engine.top_k,
                                 engine.top_p, jnp.int32)
 
@@ -293,7 +297,7 @@ def make_verify_fn(engine):
                 # while the pool stream stays exactly the decode
                 # step's bytes (minus the statically-sliced null page)
                 q_lanes = q[ref_c].reshape(
-                    ref_c.shape[0], n_lanes * S, cfg.n_heads, head_dim)
+                    ref_c.shape[0], n_lanes * S, n_heads_l, head_dim)
                 o_p, m_p, l_p = _grouped_cache_attention(
                     q_lanes, rk, rv,
                     visible[:, None, None, :, :], state=True)
@@ -313,14 +317,15 @@ def make_verify_fn(engine):
                     num_segments=n_slots * S + 1)
                 o = o_s[:n_slots * S] / jnp.maximum(
                     l_s[:n_slots * S], 1e-30)[..., None]
-                o = o.reshape(n_slots, S, cfg.n_heads, head_dim)
+                o = o.reshape(n_slots, S, n_heads_l, head_dim)
                 return o.astype(q.dtype), (new_k, new_v)
 
             x, _, (pk, pv) = _block_core(
                 bp, x, cfg, attend,
                 capacity_factor=max(cfg.capacity_factor,
                                     float(cfg.n_experts)),
-                positions=pos_c)                # per-slot rope depths
+                positions=pos_c,                # per-slot rope depths
+                tp_attn=engine._tp_core)
             return x, (pk, pv)
 
         x, (pool_k, pool_v) = jax.lax.scan(
