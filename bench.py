@@ -1085,6 +1085,230 @@ async def _serve_post(port, payload):
     return reader, writer
 
 
+def bench_serve_parallel() -> dict:
+    """Copy-on-write parallel sampling A/B (the PR-13 tentpole): the
+    SAME prompt set served as n-way FORK families (one prefill, n
+    branches sharing every full prompt page through the refs lanes)
+    vs the n-INDEPENDENT-SLOTS control (every copy re-prefills and
+    holds its own pages) through identical engine geometry.
+
+    The decode roofline is live KV bytes per step; a fork family
+    holds ONE copy of the prompt pages however many branches decode,
+    so the modeled live MB/step PER COMPLETION — live pages sampled
+    off the block tables before every decode step, divided by the
+    live branch count — should approach 1/n x the control on
+    prompt-heavy traffic (the chat shape). Emitted per arm: decode
+    tok/s, TTFT mean, prefill chunks (the fork arm runs ~1/n of the
+    control's — the TTFT amortization), mean live MB/step per
+    completion; plus the per-completion byte ratio (acceptance:
+    <= 0.5 at the default n=4), a greedy token-parity bool (every
+    fork branch must emit EXACTLY its independent copy's stream), and
+    the one-decode-compile proof across fork churn.
+
+    ``BENCH_PAR_N`` is validated loudly against ``max_slots`` (a
+    family needs a slot per branch)."""
+    from torchbooster_tpu.models.gpt import GPT, GPTConfig
+    from torchbooster_tpu.serving import (ContinuousBatcher,
+                                          PagedEngine, Request)
+
+    n_req = int(os.environ.get("BENCH_PAR_REQUESTS", 6))
+    n_par = int(os.environ.get("BENCH_PAR_N", 4))
+    slots = int(os.environ.get("BENCH_PAR_SLOTS", 8))
+    page = int(os.environ.get("BENCH_PAR_PAGE", 64))
+    n_pages = int(os.environ.get("BENCH_PAR_PAGES", 192))
+    seq = int(os.environ.get("BENCH_PAR_SEQ", 2048))
+    n_layers = int(os.environ.get("BENCH_PAR_LAYERS", 12))
+    kv = int(os.environ.get("BENCH_PAR_KV_HEADS", 4))
+    out_tokens = int(os.environ.get("BENCH_PAR_OUT", 16))
+    cache_dtype = os.environ.get("BENCH_PAR_CACHE_DTYPE") or None
+    suffix = f"_{cache_dtype}" if cache_dtype else ""
+    if not 2 <= n_par <= slots:
+        raise ValueError(
+            f"BENCH_PAR_N ({n_par}) must satisfy 2 <= n <= max_slots "
+            f"({slots}): below 2 nothing forks and every branch "
+            "needs its own decode slot")
+
+    # prompt-heavy traffic (the chat shape the amortization targets):
+    # several full pages + a partial tail, so the fork shares the
+    # bulk and still exercises the CoW tail copy
+    prompt_len = min(4 * page + page // 3, seq - out_tokens - 1)
+    rs = np.random.RandomState(0)
+    prompts = [rs.randint(0, 50257, prompt_len, dtype=np.int32)
+               for _ in range(n_req)]
+    warm = rs.randint(0, 50257, prompt_len, dtype=np.int32)
+
+    cfg = GPTConfig(n_layers=n_layers, seq_len=seq, n_kv_heads=kv)
+    params = GPT.init(jax.random.PRNGKey(0), cfg)
+    head_dim = cfg.d_model // cfg.n_heads
+    elem = (1 + 2 / head_dim) if cache_dtype else 2
+    row_mb = 2 * n_layers * cfg.kv_heads * head_dim * elem / 1e6
+
+    out = {}
+    streams: dict[str, dict] = {}
+    for arm in ("ctrl", "fork"):
+        engine = PagedEngine(params, cfg, page_size=page,
+                             n_pages=n_pages, max_slots=slots,
+                             cache_dtype=cache_dtype,
+                             parallel_sampling=True)
+        # per-completion live bytes: (live pages, live branches)
+        # sampled off the host tables before every decode step
+        samples: list[tuple[int, int]] = []
+        inner = engine.step
+
+        def sampled(engine=engine, samples=samples, inner=inner):
+            live = int(np.count_nonzero(engine.tables.active))
+            if live:
+                samples.append((engine.tables.n_live_pages, live))
+            return inner()
+
+        engine.step = sampled
+        batcher = ContinuousBatcher(engine)
+        batcher.run([Request(prompt=warm, max_new_tokens=2)])
+        samples.clear()
+        if arm == "fork":
+            reqs = [Request(prompt=p, max_new_tokens=out_tokens,
+                            n=n_par, seed=i, request_id=f"f{i}")
+                    for i, p in enumerate(prompts)]
+        else:
+            reqs = [Request(prompt=p, max_new_tokens=out_tokens,
+                            seed=i, request_id=f"c{i}-{b}")
+                    for i, p in enumerate(prompts)
+                    for b in range(n_par)]
+        m = batcher.run(reqs)
+        if arm == "fork":
+            streams[arm] = {i: [list(b.tokens) for b in r.branches]
+                            for i, r in enumerate(reqs)}
+            out[f"serve_parallel_forks{suffix}"] = m["n_forks"]
+            out[f"serve_parallel_fork_pages{suffix}"] = m["fork_pages"]
+            out[f"serve_parallel_cow_copies{suffix}"] = \
+                m["n_cow_copies"]
+        else:
+            per: dict[int, list] = {}
+            for i, r in enumerate(reqs):
+                per.setdefault(i // n_par, []).append(list(r.tokens))
+            streams[arm] = per
+        mb = [p * row_mb * page / b for p, b in samples]
+        out[f"serve_parallel_live_mb_per_completion_{arm}{suffix}"] = \
+            round(float(np.mean(mb)), 4) if mb else 0.0
+        out[f"serve_parallel_tok_s_{arm}{suffix}"] = m["decode_tok_s"]
+        out[f"serve_parallel_ttft_{arm}_s{suffix}"] = m["ttft_mean_s"]
+        out[f"serve_parallel_chunks_{arm}{suffix}"] = \
+            m["n_prefill_chunks"]
+        out[f"serve_parallel_decode_compiles_{arm}{suffix}"] = \
+            engine.decode_compiles
+    out[f"serve_parallel_n{suffix}"] = n_par
+    # greedy parity: every fork branch must equal every independent
+    # copy of its prompt (greedy is deterministic per prompt, so all
+    # n streams of a prompt agree across arms)
+    out[f"serve_parallel_token_parity{suffix}"] = all(
+        streams["fork"][i] == streams["ctrl"][i]
+        for i in range(n_req))
+    # the headline: per-completion live bytes, fork over control —
+    # the acceptance gate says <= 0.5 at n=4 on prompt-heavy traffic
+    ctrl = out[f"serve_parallel_live_mb_per_completion_ctrl{suffix}"]
+    fork = out[f"serve_parallel_live_mb_per_completion_fork{suffix}"]
+    out[f"serve_parallel_byte_ratio{suffix}"] = round(
+        fork / max(ctrl, 1e-9), 3)
+    out[f"serve_parallel_chunk_ratio{suffix}"] = round(
+        out[f"serve_parallel_chunks_ctrl{suffix}"]
+        / max(out[f"serve_parallel_chunks_fork{suffix}"], 1), 2)
+    return out
+
+
+def bench_serve_tree() -> dict:
+    """Tree vs linear speculative decoding (the PR-13 tentpole's
+    other half): the SAME ambiguous-repetitive greedy workload served
+    with the linear draft chain vs the candidate TREE at the same
+    ``draft_len`` node budget.
+
+    The workload interleaves one shared pattern with ALTERNATING
+    continuations, so prompt-lookup history is genuinely ambiguous:
+    the linear drafter must bet the whole burst on the most recent
+    continuation (wrong roughly every other block), while the tree
+    proposes every observed continuation as a branch and the verify
+    pass keeps whichever the model confirms. Emitted: accepted
+    tokens/step per arm (the acceptance gate: tree >= linear), decode
+    tok/s, accept rates, the greedy token-parity bool across BOTH
+    arms (speculation is lossless — identical streams or the
+    comparison is meaningless), and the one-verify-compile proof
+    (adaptive per-step tree shapes are traced values)."""
+    from torchbooster_tpu.models.gpt import GPT, GPTConfig
+    from torchbooster_tpu.serving import (ContinuousBatcher,
+                                          PagedEngine, Request)
+
+    n_req = int(os.environ.get("BENCH_TREE_REQUESTS", 8))
+    slots = int(os.environ.get("BENCH_TREE_SLOTS", 8))
+    page = int(os.environ.get("BENCH_TREE_PAGE", 64))
+    n_pages = int(os.environ.get("BENCH_TREE_PAGES", 96))
+    seq = int(os.environ.get("BENCH_TREE_SEQ", 2048))
+    n_layers = int(os.environ.get("BENCH_TREE_LAYERS", 12))
+    kv = int(os.environ.get("BENCH_TREE_KV_HEADS", 4))
+    draft = int(os.environ.get("BENCH_TREE_DRAFT", 8))
+    width = int(os.environ.get("BENCH_TREE_WIDTH", 2))
+    period = int(os.environ.get("BENCH_TREE_PERIOD", 12))
+    if not 1 <= draft < page:
+        raise ValueError(
+            f"BENCH_TREE_DRAFT ({draft}) must satisfy 1 <= draft_len "
+            f"< page_size ({page}) — the engine's write-ahead bound")
+    if not 2 <= width <= draft:
+        raise ValueError(
+            f"BENCH_TREE_WIDTH ({width}) must satisfy 2 <= width <= "
+            f"draft_len ({draft}): every branch needs a node")
+
+    # ambiguous repetitive prompts: a shared pattern P followed by
+    # alternating continuation blocks A / B, tiled — the same n-gram
+    # is seen with two continuations, the tree drafter's case
+    rs = np.random.RandomState(0)
+    prompts = []
+    for _ in range(n_req):
+        base = rs.randint(0, 50257, period, dtype=np.int32)
+        alt_a = rs.randint(0, 50257, 2, dtype=np.int32)
+        alt_b = rs.randint(0, 50257, 2, dtype=np.int32)
+        block_a = np.concatenate([base, alt_a])
+        block_b = np.concatenate([base, alt_b])
+        reps = max(1, min(3 * page, seq // 2)
+                   // (2 * (period + 2)))
+        prompts.append(np.concatenate(
+            [np.concatenate([block_a, block_b]) for _ in range(reps)]
+        ).astype(np.int32))
+    out_hi = max(2, min(129, seq - max(len(p) for p in prompts)))
+    out_lens = rs.randint(min(32, out_hi - 1), out_hi, n_req)
+    warm = np.tile(rs.randint(0, 50257, period, dtype=np.int32), 4)
+
+    cfg = GPTConfig(n_layers=n_layers, seq_len=seq, n_kv_heads=kv)
+    params = GPT.init(jax.random.PRNGKey(0), cfg)
+
+    out = {}
+    tokens_by_arm = {}
+    for arm, tree in (("linear", False), ("tree", True)):
+        engine = PagedEngine(params, cfg, page_size=page,
+                             n_pages=n_pages, max_slots=slots,
+                             speculative=True, draft_len=draft,
+                             spec_tree=tree, tree_width=width)
+        batcher = ContinuousBatcher(engine)
+        batcher.run([Request(prompt=warm, max_new_tokens=4)])
+        reqs = [Request(prompt=p, max_new_tokens=int(o))
+                for p, o in zip(prompts, out_lens)]
+        m = batcher.run(reqs)
+        tokens_by_arm[arm] = [list(r.tokens) for r in reqs]
+        out[f"serve_tree_tok_s_{arm}"] = m["decode_tok_s"]
+        out[f"serve_tree_accept_rate_{arm}"] = m["spec_accept_rate"]
+        # the comparable yield: accepted DRAFT tokens per verify step
+        # (+1 bonus = tokens/step)
+        out[f"serve_tree_accepted_per_step_{arm}"] = \
+            m["spec_mean_accepted"]
+        out[f"serve_tree_verify_compiles_{arm}"] = \
+            engine.verify_compiles
+    out["serve_tree_draft_len"] = draft
+    out["serve_tree_width"] = width
+    out["serve_tree_token_parity"] = \
+        tokens_by_arm["tree"] == tokens_by_arm["linear"]
+    out["serve_tree_win"] = (
+        out["serve_tree_accepted_per_step_tree"]
+        >= out["serve_tree_accepted_per_step_linear"])
+    return out
+
+
 async def _serve_unary(port, prompt, max_tokens):
     """One unary completion; returns the response's token_ids."""
     reader, writer = await _serve_post(port, {
@@ -2412,6 +2636,10 @@ def _sub_main(name: str) -> None:
         print(json.dumps(bench_serve_spec()))
     elif name == "serve_kernel":
         print(json.dumps(bench_serve_kernel()))
+    elif name == "serve_parallel":
+        print(json.dumps(bench_serve_parallel()))
+    elif name == "serve_tree":
+        print(json.dumps(bench_serve_tree()))
     elif name == "serve_tp":
         print(json.dumps(bench_serve_tp()))
     elif name == "serve_http":
@@ -2626,6 +2854,11 @@ _SECONDARY_BENCHES = (("gpt", 900), ("gpt_long", 1500), ("loader", 900),
                       # them (serve_kernel compiles the mosaic kernel
                       # — first-compile on the tunnel is the slow tail)
                       ("serve_kernel", 1800),
+                      # the CoW parallel-sampling and tree-spec rows
+                      # share their run_ab QUEUE deadlines (the
+                      # two-drivers-must-agree rule)
+                      ("serve_parallel", 1800),
+                      ("serve_tree", 1800),
                       ("serve_http", 1800),
                       ("obs_trace", 1500),
                       # the loadgen capture/replay rows share their

@@ -127,6 +127,7 @@ def main() -> None:
                  "serve_spec", "serve_spec_int8", "serve_http",
                  "serve_http_prio", "serve_kernel", "serve_kernel_spec",
                  "serve_tp", "serve_tp_pallas",
+                 "serve_parallel", "serve_tree",
                  "obs_trace", "replay", "replay_http")
     for name in sorted(attempts):
         if name in METRICS or (name in multi_key and name in latest):
@@ -223,6 +224,56 @@ def main() -> None:
                 f"| {r.get(f'{pre}_live_mb_step_{backend}', '—')} "
                 f"| {r.get(f'{pre}_decode_compiles_{backend}', '—')}"
                 f"/{r.get(f'{pre}_verify_compiles_{backend}', '—')} |")
+
+    # serve_parallel row: the CoW n-way sampling A/B rendered as a
+    # fork-vs-control sub-table (per-completion live MB/step — the
+    # amortization headline — prefill chunks, TTFT, tok/s) with the
+    # byte-ratio acceptance bit and the parity/compile proof
+    e = latest.get("serve_parallel")
+    if e is not None:
+        r = e.get("result") or {}
+        print(f"\nserve_parallel (n {r.get('serve_parallel_n', '?')}, "
+              "per-completion byte ratio "
+              f"{r.get('serve_parallel_byte_ratio', '?')} "
+              "(gate <= 0.5), chunk amortization "
+              f"{r.get('serve_parallel_chunk_ratio', '?')}x, "
+              f"{r.get('serve_parallel_forks', '?')} forks / "
+              f"{r.get('serve_parallel_fork_pages', '?')} shared pages "
+              f"/ {r.get('serve_parallel_cow_copies', '?')} CoW "
+              "copies, token parity "
+              f"{r.get('serve_parallel_token_parity', '?')}):")
+        print("| arm | live MB/step/completion | decode tok/s "
+              "| ttft s | prefill chunks | decode compiles |")
+        print("|---|---|---|---|---|---|")
+        for arm in ("ctrl", "fork"):
+            print(
+                f"| {arm} "
+                f"| {r.get(f'serve_parallel_live_mb_per_completion_{arm}', '—')} "
+                f"| {r.get(f'serve_parallel_tok_s_{arm}', '—')} "
+                f"| {r.get(f'serve_parallel_ttft_{arm}_s', '—')} "
+                f"| {r.get(f'serve_parallel_chunks_{arm}', '—')} "
+                f"| {r.get(f'serve_parallel_decode_compiles_{arm}', '—')} |")
+
+    # serve_tree row: tree vs linear drafting at the same budget —
+    # accepted tokens/step per arm with the tree >= linear verdict
+    e = latest.get("serve_tree")
+    if e is not None:
+        r = e.get("result") or {}
+        print("\nserve_tree (draft_len "
+              f"{r.get('serve_tree_draft_len', '?')}, width "
+              f"{r.get('serve_tree_width', '?')}, win "
+              f"{r.get('serve_tree_win', '?')}, token parity "
+              f"{r.get('serve_tree_token_parity', '?')}):")
+        print("| arm | accepted/step | accept rate | decode tok/s "
+              "| verify compiles |")
+        print("|---|---|---|---|---|")
+        for arm in ("linear", "tree"):
+            print(
+                f"| {arm} "
+                f"| {r.get(f'serve_tree_accepted_per_step_{arm}', '—')} "
+                f"| {r.get(f'serve_tree_accept_rate_{arm}', '—')} "
+                f"| {r.get(f'serve_tree_tok_s_{arm}', '—')} "
+                f"| {r.get(f'serve_tree_verify_compiles_{arm}', '—')} |")
 
     # serve_tp rows: the tensor-parallel serving A/B rendered as a
     # per-arm sub-table (tok/s, modeled per-chip live MB/step — the

@@ -117,6 +117,20 @@ QUEUE: list[tuple[str, str, dict, int]] = [
     ("serve_kernel", "serve_kernel", {}, 1800),
     ("serve_kernel_spec", "serve_kernel",
      {"BENCH_KERNEL_SPEC": "1"}, 1800),
+    # copy-on-write parallel sampling (the PR-13 tentpole A/B): the
+    # SAME prompt-heavy prompts served as n=4 fork families (one
+    # prefill, shared prompt pages, per-branch PRNG keys) vs 4x
+    # independent requests — modeled live MB/step PER COMPLETION
+    # (acceptance: fork <= 0.5x control), prefill-chunk amortization,
+    # greedy branch==independent token parity, one-decode-compile
+    # proof across fork churn (bench.bench_serve_parallel)
+    ("serve_parallel", "serve_parallel", {}, 1800),
+    # tree vs linear speculative drafting on an ambiguous-repetitive
+    # workload at the SAME draft_len budget — accepted tokens/step
+    # per arm (acceptance: serve_tree_win, tree >= linear), greedy
+    # parity across arms, one-verify-compile proof with adaptive
+    # per-step tree shapes (bench.bench_serve_tree)
+    ("serve_tree", "serve_tree", {}, 1800),
     # tensor-parallel serving (the PR-12 tentpole A/B): the SAME
     # mixed-length Poisson trace at tp=1 vs tp=2 over a virtual-CPU
     # tp mesh (BENCH_TP_HOST_DEVICES, the BENCH_COMMS pattern) —

@@ -66,6 +66,10 @@ def test_hot_paths_cover_step_cadence_serving_files():
     pins it so a HOT_PATHS refactor to per-file entries cannot
     silently drop one."""
     lint = _load_lint()
+    # the PR 13 fork/tree decoding paths (CoW parallel sampling in
+    # kv_pages/engine/batcher, tree drafting + accept walk in
+    # speculative.py) all run at step cadence inside these files —
+    # the pins below are what keeps them under the host-sync rule
     for rel in ("torchbooster_tpu/serving/engine.py",
                 "torchbooster_tpu/serving/batcher.py",
                 "torchbooster_tpu/serving/speculative.py",

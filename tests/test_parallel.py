@@ -1,0 +1,670 @@
+"""Copy-on-write parallel sampling (n/best_of) + tree speculative
+decoding (PR 13) on CPU:
+
+- BlockTables.fork(): full pages shared through the refs lanes, the
+  partial tail private per child, CoW floors raised on every branch —
+  and check() holds through randomized fork/diverge/retire churn
+  (refcounts never negative, referenced ∪ cached ∪ free partition
+  exact, no leaks after all branches retire);
+- engine fork parity: every branch's stream — greedy AND seeded
+  sampling — is token-exact vs an independent single-slot run with
+  the same (seed, branch) key, and fork churn adds zero decode
+  compiles;
+- batcher n-way requests: one prefill, best_of branches, per-branch
+  logprob accounting, branch preemption folding, family cancellation,
+  stable metric keys, flight-recorder branch counts;
+- the HTTP surface: per-choice SSE `index`, best_of ranking,
+  aggregated usage, the stream/best_of validation;
+- tree speculative decoding: drafter chain-equivalence and ambiguity
+  splitting, ancestor masks, the unique accepted-path walk,
+  side-branch acceptance with K/V compaction (parity-exact vs the
+  non-speculative engine), one verify compile across adaptive tree
+  shapes;
+- loadgen workload format v2: n/best_of round-trip, fingerprint
+  coverage, v1 compatibility, malformed-value rejection, the n_frac
+  generator knob;
+- the serving YAML knobs round-trip.
+"""
+import asyncio
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torchbooster_tpu.models.gpt import GPT, GPTConfig
+
+from tests.test_frontend import (  # noqa: E402 — the one client dialect
+    _decisive_model,
+    _stream_completion,
+    _unary,
+)
+
+
+def _engine(params, cfg, **kw):
+    from torchbooster_tpu.serving import PagedEngine
+
+    kw.setdefault("page_size", 4)
+    kw.setdefault("n_pages", 32)
+    kw.setdefault("max_slots", 6)
+    kw.setdefault("compute_dtype", jnp.float32)
+    return PagedEngine(params, cfg, **kw)
+
+
+def _tables(page_size=4, n_pages=32, max_slots=6, seq_len=64,
+            **kw):
+    from torchbooster_tpu.serving import BlockTables
+
+    cfg = GPTConfig(vocab=97, n_layers=1, d_model=8, n_heads=2,
+                    seq_len=seq_len)
+    kw.setdefault("parallel", True)
+    return BlockTables(cfg, page_size, n_pages, max_slots, **kw)
+
+
+# ---- BlockTables.fork ----------------------------------------------
+
+def test_fork_shares_full_pages_and_copies_tail():
+    t = _tables()
+    prompt = np.arange(1, 11, dtype=np.int32)      # 10 tokens: 2.5 pages
+    t.seat(0, prompt)
+    t.activate(0, 42)
+    children = t.fork(0, 2)
+    t.check()
+    assert len(children) == 2
+    for c in children:
+        # full pages (idx 0, 1) shared, the partial tail (idx 2) private
+        assert (t.tables[c, :2] == t.tables[0, :2]).all()
+        assert t.tables[c, 2] != t.tables[0, 2]
+        assert int(t.lengths[c]) == 10
+        assert int(t.cow_len[c]) == 8
+        assert int(t.prompt_len[c]) == 10
+        assert not t.active[c]                      # caller activates
+    # parent's own CoW floor rose to the shared boundary
+    assert int(t.cow_len[0]) == 8
+    assert (t.refcount[t.tables[0, :2]] == 3).all()
+    assert t.refcount[t.tables[0, 2]] == 1
+    # a branch cannot rewind into the shared region once activated
+    t.activate(children[0], 1)
+    with pytest.raises(ValueError):
+        t.rewind(children[0], 7, last_id=1)
+
+
+def test_fork_requires_parallel_lanes_and_rolls_back():
+    t = _tables(parallel=False, prefix_cache=False)
+    t.seat(0, np.arange(1, 6, dtype=np.int32))
+    t.activate(0, 9)
+    with pytest.raises(RuntimeError, match="parallel=True"):
+        t.fork(0, 1)
+    # pool exhaustion mid-fork rolls every partial child back
+    t2 = _tables(n_pages=6, max_slots=6)           # 5 usable pages
+    t2.seat(0, np.arange(1, 11, dtype=np.int32))   # 3 pages
+    t2.activate(0, 9)
+    free_before = t2.n_free_pages
+    with pytest.raises(RuntimeError):
+        t2.fork(0, 3)                              # needs 3 tail pages
+    t2.check()
+    assert t2.n_free_pages == free_before
+    assert int(t2.lengths[1]) == 0                 # no child survived
+
+
+def test_fork_diverge_retire_churn_invariants():
+    """The satellite churn test: randomized seat/fork/diverge/retire
+    with check() after every mutation; at the end every page is back
+    in the free/cached partition — no leaks, no negative refcounts."""
+    rs = np.random.RandomState(0)
+    t = _tables(page_size=4, n_pages=64, max_slots=8, seq_len=64)
+    live: list[int] = []
+    for _ in range(300):
+        op = rs.randint(4)
+        if op == 0 and len(live) < 4:
+            slot = t.free_slot()
+            if slot is not None:
+                n = int(rs.randint(3, 14))
+                try:
+                    t.seat(slot, rs.randint(1, 97, n).astype(np.int32))
+                except RuntimeError:
+                    continue
+                t.activate(slot, int(rs.randint(97)))
+                live.append(slot)
+        elif op == 1 and live:
+            parent = int(rs.choice(live))
+            k = int(rs.randint(1, 3))
+            try:
+                kids = t.fork(parent, k)
+            except RuntimeError:
+                continue
+            for c in kids:
+                t.activate(c, int(rs.randint(97)))
+                live.append(c)
+        elif op == 2 and live:
+            slot = int(rs.choice(live))
+            # diverge: grow the branch a few tokens (private pages)
+            for _ in range(int(rs.randint(1, 6))):
+                if int(t.lengths[slot]) >= t.seq_len:
+                    break
+                if not t.ensure_next_page(slot):
+                    break
+                t.advance(slot, int(rs.randint(97)))
+        elif op == 3 and live:
+            slot = live.pop(int(rs.randint(len(live))))
+            t.retire(slot)
+        t.check()
+    for slot in live:
+        t.retire(slot)
+    t.check()
+    assert (t.refcount == 0).all()
+    assert t.n_free_pages + t.n_cached_pages == t.n_pages - 1
+
+
+# ---- engine fork: parity + zero recompiles --------------------------
+
+def test_engine_fork_greedy_parity_zero_recompiles():
+    """Greedy branches all reproduce the independent single-slot run,
+    across repeated fork/retire churn, with exactly ONE compiled
+    decode step."""
+    params, cfg = _decisive_model(seq_len=64)
+    engine = _engine(params, cfg, parallel_sampling=True)
+    prompt = np.asarray(jax.random.randint(
+        jax.random.PRNGKey(1), (6,), 0, cfg.vocab))
+
+    ref_engine = _engine(params, cfg, parallel_sampling=True)
+    rslot, rfirst = ref_engine.admit(prompt, seed=3)
+    ref = [rfirst]
+    for _ in range(5):
+        ref_engine.grow_slots()
+        ref.append(int(ref_engine.step()[rslot]))
+
+    for _ in range(3):                     # fork churn rounds
+        slot, first = engine.admit(prompt, seed=3)
+        branches = engine.fork(slot, 3)
+        assert branches[0][:2] == (slot, first)
+        streams = {s: [tok] for s, tok, _ in branches}
+        for _ in range(5):
+            engine.grow_slots()
+            toks = engine.step()
+            for s in streams:
+                streams[s].append(int(toks[s]))
+        for stream in streams.values():
+            assert stream == ref
+        engine.tables.check()
+        for s in streams:
+            engine.retire(s)
+        engine.tables.check()
+    assert engine.decode_compiles == 1
+    assert engine.tables.n_free_pages == engine.n_pages - 1
+
+
+def test_seeded_n2_sampling_parity_vs_independent_runs():
+    """The satellite regression: a seeded n=2 temperature-sampled
+    request's branches are token-exact vs independent single-slot
+    runs admitted with the same (seed, branch) — the per-branch
+    PRNG-key contract, end to end through the batcher."""
+    from torchbooster_tpu.serving import ContinuousBatcher, Request
+
+    params, cfg = _decisive_model(seq_len=64)
+
+    def build():
+        return ContinuousBatcher(_engine(
+            params, cfg, parallel_sampling=True,
+            temperature=0.8, top_k=20))
+
+    req = Request(prompt=np.arange(1, 7, dtype=np.int32),
+                  max_new_tokens=6, n=2, seed=17, request_id="p")
+    build().run([req])
+    fam = req.branches
+    assert len(fam) == 2
+    assert [r.branch for r in fam] == [0, 1]
+    # sampled branches genuinely diverge...
+    assert fam[0].tokens != fam[1].tokens
+    # ...and each equals its independent same-key run
+    for b in range(2):
+        ind = Request(prompt=np.arange(1, 7, dtype=np.int32),
+                      max_new_tokens=6, seed=17)
+        ind.branch = b
+        build().run([ind])
+        assert ind.tokens == fam[b].tokens
+
+
+def test_batcher_nway_metrics_flight_and_family():
+    from torchbooster_tpu.serving import ContinuousBatcher, Request
+
+    params, cfg = _decisive_model(seq_len=64)
+    batcher = ContinuousBatcher(_engine(params, cfg,
+                                        parallel_sampling=True))
+    req = Request(prompt=np.arange(1, 11, dtype=np.int32),
+                  max_new_tokens=4, n=2, best_of=3, seed=1,
+                  request_id="fam")
+    m = batcher.run([req])
+    assert [r.request_id for r in req.branches] == \
+        ["fam", "fam#1", "fam#2"]
+    assert all(len(r.tokens) == 4 for r in req.branches)
+    assert m["n_forks"] == 1
+    # 10-token prompt on 4-token pages: 2 full pages shared per child
+    assert m["fork_pages"] == 4
+    assert m["n_cow_copies"] == 2
+    assert any(rec["branches"] == 2 for rec in batcher.flight.tail())
+    # stable keys on the empty trace too
+    m0 = ContinuousBatcher(_engine(params, cfg)).run([])
+    for key in ("n_forks", "fork_pages", "n_cow_copies"):
+        assert m0[key] == 0
+    # engine-side validation: n-way on a non-parallel engine is loud
+    b2 = ContinuousBatcher(_engine(params, cfg))
+    with pytest.raises(ValueError, match="parallel_sampling"):
+        b2.run([Request(prompt=np.arange(1, 5, dtype=np.int32),
+                        max_new_tokens=2, n=2)])
+
+
+def test_branch_preemption_resumes_token_exact():
+    """A branch evicted mid-decode re-prefills from its folded
+    context and finishes with EXACTLY the unpreempted greedy stream —
+    the branch key is context-length-folded, so preemption cannot
+    shift it."""
+    from torchbooster_tpu.serving import ContinuousBatcher, Request
+
+    params, cfg = _decisive_model(seq_len=32)
+    prompt = np.asarray(jax.random.randint(
+        jax.random.PRNGKey(2), (5,), 0, cfg.vocab))
+
+    # reference: ample pool, no preemption
+    ref = Request(prompt=prompt, max_new_tokens=8, n=2, seed=5,
+                  request_id="ref")
+    ContinuousBatcher(_engine(params, cfg, n_pages=32,
+                              parallel_sampling=True)).run([ref])
+
+    # tight pool: the family + a filler force preemption churn
+    engine = _engine(params, cfg, n_pages=8, max_slots=4,
+                     parallel_sampling=True)
+    batcher = ContinuousBatcher(engine)
+    req = Request(prompt=prompt, max_new_tokens=8, n=2, seed=5,
+                  request_id="ref")
+    filler = Request(prompt=np.asarray(jax.random.randint(
+        jax.random.PRNGKey(3), (6,), 0, cfg.vocab)),
+        max_new_tokens=12, arrival=0.0)
+    m = batcher.run([req, filler])
+    assert m["n_preemptions"] > 0
+    assert [r.tokens for r in req.branches] == \
+        [r.tokens for r in ref.branches]
+    engine.tables.check()
+
+
+def test_fork_under_pool_pressure_preempts_and_retries():
+    """A fork whose sibling tail pages cannot allocate must evict a
+    policy victim and RETRY — the engine's fork stash survives the
+    failed attempt (a consumed stash would turn every retry into a
+    bogus 'not at its prefill boundary' error), and the family still
+    decodes branch-parity-exact."""
+    from torchbooster_tpu.serving import ContinuousBatcher, Request
+
+    params, cfg = _decisive_model(seq_len=32)
+    prompt = np.asarray(jax.random.randint(
+        jax.random.PRNGKey(2), (6,), 0, cfg.vocab))
+    ref = Request(prompt=prompt, max_new_tokens=4, n=3, seed=9)
+    ContinuousBatcher(_engine(params, cfg, n_pages=32,
+                              parallel_sampling=True)).run([ref])
+
+    # 7 usable pages: the filler (arrives first) eats most of the
+    # pool, so the family's fork-time tail allocation MUST preempt it
+    engine = _engine(params, cfg, n_pages=8, max_slots=5,
+                     parallel_sampling=True)
+    batcher = ContinuousBatcher(engine)
+    filler = Request(prompt=np.asarray(jax.random.randint(
+        jax.random.PRNGKey(4), (10,), 0, cfg.vocab)),
+        max_new_tokens=8, arrival=0.0)
+    fam = Request(prompt=prompt, max_new_tokens=4, n=3, seed=9,
+                  arrival=0.01)
+    m = batcher.run([filler, fam])
+    assert m["n_preemptions"] > 0
+    assert m["n_forks"] == 1
+    assert [r.tokens for r in fam.branches] == \
+        [r.tokens for r in ref.branches]
+    assert len(filler.tokens) == 8          # the victim still finished
+    engine.tables.check()
+
+
+def test_first_token_logprob_counted_once_for_all_paths():
+    """cum_logprob includes the FIRST token's logprob for n=1
+    requests and preempted-then-reseated branches alike — a missed
+    first-token term would bias best_of toward preempted branches."""
+    from torchbooster_tpu.serving import ContinuousBatcher, Request
+
+    params, cfg = _decisive_model(seq_len=64)
+    req = Request(prompt=np.arange(1, 7, dtype=np.int32),
+                  max_new_tokens=4, seed=3)
+    ContinuousBatcher(_engine(params, cfg, parallel_sampling=True,
+                              temperature=0.8)).run([req])
+    assert req.cum_logprob < 0.0            # 5 sampled tokens' mass
+    # an identical n=2 family's branch 0 must carry the SAME
+    # cumulative logprob as the standalone run (greedy would hide a
+    # missing term; sampling with the same key cannot)
+    fam = Request(prompt=np.arange(1, 7, dtype=np.int32),
+                  max_new_tokens=4, n=2, seed=3)
+    ContinuousBatcher(_engine(params, cfg, parallel_sampling=True,
+                              temperature=0.8)).run([fam])
+    assert fam.branches[0].tokens == req.tokens
+    assert abs(fam.branches[0].cum_logprob - req.cum_logprob) < 1e-6
+
+
+def test_family_cancel_reclaims_all_branches():
+    from torchbooster_tpu.serving import ContinuousBatcher, Request
+
+    params, cfg = _decisive_model(seq_len=64)
+    engine = _engine(params, cfg, parallel_sampling=True)
+    batcher = ContinuousBatcher(engine)
+    batcher.start_session()
+    req = Request(prompt=np.arange(1, 10, dtype=np.int32),
+                  max_new_tokens=30, n=3, seed=2)
+    batcher.submit(req)
+    for _ in range(6):                 # prefill + fork + a few steps
+        batcher.step()
+    assert req.branches is not None and len(req.branches) == 3
+    batcher.cancel(req)
+    batcher.step()
+    m = batcher.finish_session()
+    assert m["n_cancelled"] == 3
+    assert all(r.cancelled for r in req.branches)
+    engine.tables.check()
+    assert engine.tables.n_free_pages == engine.n_pages - 1
+
+
+# ---- the HTTP surface ----------------------------------------------
+
+def test_http_n_stream_indexes_best_of_ranking_and_usage():
+    from torchbooster_tpu.serving import ContinuousBatcher
+    from torchbooster_tpu.serving.frontend import ServingFrontend
+
+    params, cfg = _decisive_model(seq_len=64)
+    engine = _engine(params, cfg, parallel_sampling=True,
+                     temperature=0.7, top_k=30)
+    fe = ServingFrontend(ContinuousBatcher(engine))
+    prompt = [int(t) for t in np.arange(1, 9)]
+
+    async def scenario():
+        await fe.start()
+        # streaming n=2: per-choice `index` on every chunk, each
+        # branch's finishing chunk carries its finish_reason
+        status, _, events = await _stream_completion(
+            fe.port, {"prompt": prompt, "max_tokens": 5, "n": 2,
+                      "seed": 4})
+        assert status == 200
+        per_branch: dict[int, list] = {0: [], 1: []}
+        finishes = {}
+        for e in events:
+            c = e["choices"][0]
+            per_branch[c["index"]].extend(c["token_ids"])
+            if c["finish_reason"]:
+                finishes[c["index"]] = c["finish_reason"]
+        assert len(per_branch[0]) == 5 and len(per_branch[1]) == 5
+        assert finishes == {0: "length", 1: "length"}
+        # unary best_of=4, n=2: the two best by logprob, re-indexed,
+        # usage aggregated over every DECODED branch
+        status, _, body = await _unary(
+            fe.port, "/v1/completions",
+            {"prompt": prompt, "max_tokens": 5, "n": 2, "best_of": 4,
+             "seed": 4})
+        assert status == 200
+        # streaming best_of > n is the OpenAI 400
+        status400, _, err = await _unary(
+            fe.port, "/v1/completions",
+            {"prompt": prompt, "max_tokens": 5, "n": 1, "best_of": 2,
+             "stream": True})
+        await fe.stop()
+        return body, status400
+
+    body, status400 = asyncio.run(scenario())
+    assert [c["index"] for c in body["choices"]] == [0, 1]
+    assert body["usage"]["prompt_tokens"] == 8
+    assert body["usage"]["completion_tokens"] == 20     # 4 branches x 5
+    assert body["usage"]["total_tokens"] == 28
+    assert status400 == 400
+    assert engine.decode_compiles == 1
+    engine.tables.check()
+    assert engine.tables.n_free_pages == engine.n_pages - 1
+
+
+# ---- tree speculative decoding --------------------------------------
+
+def test_tree_drafter_chain_equivalence_and_ambiguity():
+    from torchbooster_tpu.serving.speculative import (
+        PromptLookupDrafter, TreeLookupDrafter)
+
+    tree = TreeLookupDrafter(6, ngram_min=2, width=2)
+    lin = PromptLookupDrafter(6, ngram_min=2)
+    # unambiguous stream: the tree IS the linear chain
+    s = np.tile(np.array([7, 8, 9, 10], np.int32), 5)
+    tree.begin(0, s)
+    lin.begin(0, s)
+    toks, parents = tree.draft_tree(0)
+    assert (toks == lin.draft(0)).all()
+    assert (parents == np.arange(6)).all()
+    # ambiguous: "1,2,3" seen with continuations 4 and 5 under
+    # distinct prefixes -> two branches off the root, distinct first
+    # tokens (the unique-accepted-path guarantee)
+    tree.begin(1, np.array([6, 1, 2, 3, 4, 7, 1, 2, 3, 5, 1, 2, 3],
+                           np.int32))
+    toks, parents = tree.draft_tree(1)
+    roots = [toks[j] for j in range(6) if parents[j] == 0
+             and toks[j] >= 0]
+    assert sorted(roots) == [4, 5]
+    with pytest.raises(ValueError, match="width"):
+        TreeLookupDrafter(4, width=1)
+    with pytest.raises(ValueError, match="width"):
+        TreeLookupDrafter(4, width=5)
+
+
+def test_tree_masks_and_accept_path():
+    from torchbooster_tpu.serving.speculative import (
+        accept_count, tree_accept_path, tree_masks)
+
+    # chain: depth = arange, vis = lower-triangular
+    depth, vis = tree_masks(np.tile(np.arange(4), (2, 1)))
+    assert (depth[0] == np.arange(5)).all()
+    assert (vis[0] == (np.arange(5)[None, :]
+                       <= np.arange(5)[:, None])).all()
+    # tree: nodes 1-2 a chain, nodes 3-4 a side branch off the root
+    parents = np.array([[0, 1, 0, 3]])
+    depth, vis = tree_masks(parents)
+    assert list(depth[0]) == [0, 1, 2, 1, 2]
+    assert list(np.flatnonzero(vis[0, 4])) == [0, 3, 4]
+    assert list(np.flatnonzero(vis[0, 2])) == [0, 1, 2]
+    # the walk picks the accepted side branch; on the chain it
+    # reduces to accept_count
+    assert tree_accept_path(
+        np.array([False, False, True, True]), parents[0]) == [3, 4]
+    chain = np.arange(4)
+    for row in ([True, True, False, False], [False] * 4, [True] * 4):
+        row = np.asarray(row)
+        want = list(range(1, accept_count(row) + 1))
+        assert tree_accept_path(row, chain) == want
+
+
+def test_tree_spec_side_branch_acceptance_compacts_parity_exact():
+    """The compaction acceptance: a RIGGED drafter proposes a wrong
+    primary chain and the true continuation on a side branch — the
+    verify pass must accept the side path, compact its K/V rows into
+    place, and every LATER token must still match the non-speculative
+    engine exactly (mis-compacted rows would corrupt the context and
+    flip later picks)."""
+    params, cfg = _decisive_model(seq_len=64)
+    prompt = np.asarray(jax.random.randint(
+        jax.random.PRNGKey(5), (9,), 0, cfg.vocab))
+
+    base = _engine(params, cfg)
+    s0, f0 = base.admit(prompt)
+    truth = [f0]
+    for _ in range(14):
+        base.grow_slots()
+        truth.append(int(base.step()[s0]))
+
+    engine = _engine(params, cfg, speculative=True, draft_len=3,
+                     spec_tree=True, tree_width=2)
+    st, ft = engine.admit(prompt)
+    assert ft == truth[0]
+    out = [ft]
+    calls = {"n": 0}
+
+    def rigged(slot):
+        calls["n"] += 1
+        i = len(out)
+        toks = np.full(3, -1, np.int32)
+        parents = np.arange(3, dtype=np.int32)
+        if calls["n"] in (1, 3) and i + 1 < len(truth):
+            # primary = wrong single node; side branch = 2 TRUE tokens
+            toks[:] = [(truth[i] + 1) % cfg.vocab,
+                       truth[i], truth[i + 1]]
+            parents[:] = [0, 0, 2]
+        return toks, parents
+
+    engine._drafter.draft_tree = rigged
+    for _ in range(10):
+        engine.grow_slots()
+        out.extend(engine.spec_step()[st])
+    n = min(len(out), len(truth))
+    assert out[:n] == truth[:n]
+    assert engine.spec_accepted >= 4          # both rigged side paths
+    assert engine.verify_compiles == 1
+    engine.tables.check()
+
+
+@pytest.mark.parametrize("backend", ["xla", "pallas"])
+def test_tree_spec_greedy_parity_both_backends(backend):
+    """Organic tree drafting (ambiguous repetitive prompts) stays
+    token-exact vs the non-speculative engine on BOTH decode
+    backends, with one verify compile across adaptive tree shapes."""
+    params, cfg = _decisive_model(seq_len=64)
+    rs = np.random.RandomState(1)
+    base_pat = rs.randint(0, cfg.vocab, 5).astype(np.int32)
+    prompts = [np.concatenate(
+        [base_pat, [11], base_pat, [13], base_pat, [11], base_pat])
+        .astype(np.int32) for _ in range(2)]
+
+    def drive(**kw):
+        e = _engine(params, cfg, **kw)
+        outs = []
+        for p in prompts:
+            slot, first = e.admit(p)
+            toks = [first]
+            while len(toks) < 9:
+                e.grow_slots()
+                if e.speculative:
+                    toks.extend(e.spec_step()[slot])
+                else:
+                    toks.append(int(e.step()[slot]))
+            e.retire(slot)
+            outs.append(toks[:9])
+        e.tables.check()
+        return outs, e
+
+    want, _ = drive(decode_backend=backend)
+    got, engine = drive(decode_backend=backend, speculative=True,
+                        draft_len=3, spec_tree=True, tree_width=2)
+    assert got == want
+    assert engine.verify_compiles == 1
+    assert engine.decode_compiles == 0
+
+
+def test_spec_tree_and_parallel_validation():
+    params, cfg = _decisive_model()
+    with pytest.raises(ValueError, match="speculative=True"):
+        _engine(params, cfg, spec_tree=True)
+    with pytest.raises(ValueError, match="greedy"):
+        _engine(params, cfg, speculative=True, spec_tree=True,
+                draft_len=3, temperature=0.5)
+    with pytest.raises(ValueError, match="mutually"):
+        _engine(params, cfg, speculative=True, draft_len=3,
+                parallel_sampling=True)
+    from torchbooster_tpu.models.gpt import _make_spec_pick
+    with pytest.raises(ValueError, match="greedy-only"):
+        _make_spec_pick(0.5, None, None, jnp.int32)(
+            jax.random.PRNGKey(0),
+            jnp.zeros((1, 3, 7)), jnp.zeros((1, 2), jnp.int32),
+            parent=jnp.zeros((1, 2), jnp.int32))
+
+
+# ---- loadgen workload v2 --------------------------------------------
+
+def test_workload_v2_n_fields_roundtrip_fingerprint_and_v1(tmp_path):
+    from torchbooster_tpu.serving.loadgen.workload import (
+        Workload, WorkloadRequest)
+
+    def wl(n, best_of=None):
+        return Workload(requests=[WorkloadRequest(
+            arrival_s=0.0, max_new_tokens=4,
+            prompt=np.arange(1, 5, dtype=np.int32),
+            request_id="r0", n=n, best_of=best_of)])
+
+    plain, fan = wl(1), wl(3, 4)
+    # the fingerprint covers n/best_of whenever set...
+    assert plain.fingerprint() != fan.fingerprint()
+    assert wl(3, 4).fingerprint() == fan.fingerprint()
+    # ...and round-trips through the v2 file format
+    path = fan.save(tmp_path / "w.jsonl")
+    header = json.loads(path.read_text().splitlines()[0])
+    assert header["version"] == 2
+    loaded = Workload.load(path)
+    assert loaded.requests[0].n == 3
+    assert loaded.requests[0].best_of == 4
+    assert loaded.fingerprint() == fan.fingerprint()
+    # a v1 file (no n fields, v1 fingerprint) still loads as n=1
+    v1 = tmp_path / "v1.jsonl"
+    lines = [json.loads(ln) for ln in
+             plain.save(tmp_path / "p.jsonl").read_text().splitlines()]
+    lines[0]["version"] = 1
+    for rec in lines[1:]:
+        del rec["n"], rec["best_of"]
+    v1.write_text("\n".join(json.dumps(d) for d in lines) + "\n")
+    assert Workload.load(v1).requests[0].n == 1
+    # malformed values are rejected loudly
+    with pytest.raises(ValueError, match="n must be"):
+        WorkloadRequest(arrival_s=0.0, max_new_tokens=1,
+                        prompt=np.asarray([1], np.int32), n=0)
+    with pytest.raises(ValueError, match="best_of"):
+        WorkloadRequest(arrival_s=0.0, max_new_tokens=1,
+                        prompt=np.asarray([1], np.int32), n=3,
+                        best_of=2)
+
+
+def test_synthesize_n_frac_deterministic_and_validated():
+    from torchbooster_tpu.serving.loadgen.workload import synthesize
+
+    a = synthesize("poisson", n_requests=40, seed=7, n_frac=0.5,
+                   n_max=3)
+    b = synthesize("poisson", n_requests=40, seed=7, n_frac=0.5,
+                   n_max=3)
+    assert a.fingerprint() == b.fingerprint()
+    ns = [r.n for r in a.requests]
+    assert any(n > 1 for n in ns) and any(n == 1 for n in ns)
+    assert all(1 <= n <= 3 for n in ns)
+    # off by default: fingerprints unchanged vs the pre-v2 generator
+    plain = synthesize("poisson", n_requests=8, seed=1)
+    assert all(r.n == 1 for r in plain.requests)
+    with pytest.raises(ValueError, match="n_frac"):
+        synthesize("poisson", n_frac=1.5)
+    with pytest.raises(ValueError, match="n_max"):
+        synthesize("poisson", n_frac=0.5, n_max=1)
+
+
+def test_serving_yaml_parallel_and_tree_knobs(tmp_path):
+    from torchbooster_tpu.config import LoadgenConfig, ServingConfig
+
+    yml = tmp_path / "s.yml"
+    yml.write_text("page_size: 4\nn_pages: 16\nmax_slots: 4\n"
+                   "parallel_sampling: true\n")
+    sc = ServingConfig.load(yml)
+    assert sc.parallel_sampling is True and sc.spec_tree is False
+    params, cfg = _decisive_model()
+    batcher = sc.make(params, cfg, compute_dtype=jnp.float32)
+    assert batcher.engine.parallel is True
+    yml2 = tmp_path / "t.yml"
+    yml2.write_text("page_size: 4\nn_pages: 16\nspeculative: true\n"
+                    "draft_len: 3\nspec_tree: true\n"
+                    "spec_tree_width: 2\n")
+    b2 = ServingConfig.load(yml2).make(params, cfg,
+                                       compute_dtype=jnp.float32)
+    assert b2.engine.spec_tree is True
+    assert b2.engine.tree_width == 2
+    yml3 = tmp_path / "l.yml"
+    yml3.write_text("source: poisson\nn_requests: 6\nn_frac: 0.5\n"
+                    "n_max: 3\n")
+    wl = LoadgenConfig.load(yml3).make()
+    assert all(1 <= r.n <= 3 for r in wl.requests)

@@ -325,7 +325,8 @@ _STABLE_KEYS = {
     "n_admissions", "n_preemptions", "n_prefill_chunks",
     "prefix_hit_pages", "prefix_hit_rate", "n_spec_steps",
     "n_spec_proposed", "n_spec_accepted", "spec_accept_rate",
-    "spec_mean_accepted", "n_shed", "n_cancelled",
+    "spec_mean_accepted", "n_forks", "fork_pages", "n_cow_copies",
+    "n_shed", "n_cancelled",
     "deadline_hit_rate", "classes",
 }
 

@@ -735,6 +735,24 @@ class ServingConfig(BaseConfig):
     drafter will match. ``draft_len`` must stay below ``page_size``
     (the engine validates loudly).
 
+    ``spec_tree: true`` (greedy speculative engines only) upgrades
+    the linear draft chain to a TREE of up to ``spec_tree_width``
+    candidate branches verified in the SAME fused pass through
+    ancestor-only visibility masks — when the stream's history is
+    ambiguous (the same n-gram seen with different continuations)
+    every plausible branch rides the verify step and the best
+    accepted root-to-leaf path wins; unambiguous streams degenerate
+    to the linear chain bit-for-bit.
+
+    ``parallel_sampling: true`` enables copy-on-write parallel
+    sampling — the OpenAI ``n``/``best_of`` surface: an n-way request
+    prefills ONCE and forks into ``best_of`` branches sharing every
+    full prompt page (one HBM read serves all branches), each branch
+    sampling with its own ``fold_in(PRNGKey(seed), branch)`` key and
+    accumulating token logprobs for ``best_of`` ranking. Mutually
+    exclusive with ``speculative``. Off (the default) the engine is
+    bit-for-bit the single-stream one.
+
     ``decode_backend: pallas`` swaps the decode/verify pool READ for
     the paged flash-decode kernel (ops/paged_attention.py): block
     tables walked in-kernel, so bytes/step are the live context
@@ -766,6 +784,9 @@ class ServingConfig(BaseConfig):
     speculative: bool = False          # draft + batched-verify decode
     draft_len: int = 4                 # drafted tokens per verify step
     ngram_min: int = 2                 # shortest prompt-lookup n-gram
+    spec_tree: bool = False            # tree-structured drafting (greedy)
+    spec_tree_width: int = 2           # max branches off the draft root
+    parallel_sampling: bool = False    # CoW fork n/best_of sampling
     decode_backend: str = "xla"        # "xla" pool sweep | "pallas" kernel
     tp: int = 1                        # tensor-parallel head shards (mesh "tp" axis)
     frontend: FrontendConfig = dataclasses.field(
@@ -812,6 +833,9 @@ class ServingConfig(BaseConfig):
             prefill_chunk_pages=self.prefill_chunk_pages,
             speculative=self.speculative,
             draft_len=self.draft_len, ngram_min=self.ngram_min,
+            spec_tree=self.spec_tree,
+            tree_width=self.spec_tree_width,
+            parallel_sampling=self.parallel_sampling,
             decode_backend=self.decode_backend,
             tp=self.tp, mesh=mesh)
         return ContinuousBatcher(engine, on_recompile=on_recompile,
@@ -842,7 +866,11 @@ class LoadgenConfig(BaseConfig):
     ``classes`` table); ``cancel_frac`` of synthetic requests get a
     recorded client disconnect at a random token offset, so replay
     exercises the cancel/abort paths. ``prompt_len`` /
-    ``max_new_tokens`` are inclusive ``(lo, hi)`` ranges.
+    ``max_new_tokens`` are inclusive ``(lo, hi)`` ranges. ``n_frac``
+    gives that fraction of synthetic requests parallel-sampling
+    fan-out (``n = best_of`` drawn in ``[2, n_max]``), so replays
+    carry OpenAI ``n``/``best_of`` traffic through the harness —
+    serve them against a ``serving.parallel_sampling: true`` engine.
 
     ``make()`` returns the
     :class:`~torchbooster_tpu.serving.loadgen.workload.Workload`;
@@ -862,6 +890,8 @@ class LoadgenConfig(BaseConfig):
     max_new_tokens: tuple(int, int) = (8, 32)
     classes: str = ""                  # "name:weight,..." mix
     cancel_frac: float = 0.0           # recorded client disconnects
+    n_frac: float = 0.0                # fraction with n/best_of > 1
+    n_max: int = 4                     # largest synthetic n
 
     def make(self) -> Any:
         from torchbooster_tpu.serving.loadgen.workload import (
@@ -885,7 +915,8 @@ class LoadgenConfig(BaseConfig):
                 seed=self.seed, vocab=self.vocab,
                 prompt_len=tuple(self.prompt_len),
                 max_new_tokens=tuple(self.max_new_tokens),
-                classes=self.classes, cancel_frac=self.cancel_frac)
+                classes=self.classes, cancel_frac=self.cancel_frac,
+                n_frac=self.n_frac, n_max=self.n_max)
         # the block's replay default: drivers called without an
         # explicit speed= read it back from the workload, so the
         # YAML knob actually governs the replay (meta never enters

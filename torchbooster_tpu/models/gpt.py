@@ -1062,6 +1062,41 @@ def _make_pick(temperature: float, top_k: int | None,
     return pick
 
 
+def _make_branch_pick(temperature: float, top_k: int | None,
+                      top_p: float | None, dtype: Any):
+    """``pick(keys, logits) -> (ids, logprobs)`` — the PER-BRANCH
+    next-token rule of copy-on-write parallel sampling
+    (serving/engine.py ``parallel_sampling=True``), built from the
+    same knobs as :func:`_make_pick` so filtering semantics cannot
+    drift.
+
+    ``keys`` is ``(B, 2)`` — one already-folded PRNG key per slot
+    (the engine folds the slot's branch key with its context length,
+    so a branch's token at depth d is a pure function of (branch key,
+    depth, logits) — token-exact vs an independent single-slot run
+    with the same key, whatever else shares the batch). ``logits`` is
+    ``(B, vocab)``. Returns the picked ids and their log-probability
+    under the distribution actually sampled from: the FILTERED
+    distribution at ``temperature > 0`` (what rejection-free
+    categorical draws land on), the raw softmax under greedy — the
+    per-branch sequence-logprob ``best_of`` ranks by."""
+
+    def pick(keys: jax.Array, logits: jax.Array
+             ) -> tuple[jax.Array, jax.Array]:
+        if temperature == 0:
+            ids = jnp.argmax(logits, axis=-1)
+            lp = jax.nn.log_softmax(
+                logits.astype(jnp.float32), axis=-1)
+        else:
+            f = _filter_logits(logits, temperature, top_k, top_p)
+            ids = jax.vmap(jax.random.categorical)(keys, f)
+            lp = jax.nn.log_softmax(f, axis=-1)
+        lp = jnp.take_along_axis(lp, ids[:, None], axis=-1)[:, 0]
+        return ids.astype(dtype), lp
+
+    return pick
+
+
 def _make_spec_pick(temperature: float, top_k: int | None,
                     top_p: float | None, dtype: Any):
     """``verify(rng_step, logits, draft) -> (accept, token)`` — the
@@ -1091,16 +1126,39 @@ def _make_spec_pick(temperature: float, top_k: int | None,
     chain emits a bonus sample from the untouched ``p_K``. The output
     distribution is exactly the autoregressive sampling distribution.
     Sentinel positions never accept and their fallback token is an
-    UNMASKED sample (no proposal to exclude)."""
+    UNMASKED sample (no proposal to exclude).
+
+    ``parent`` (greedy only) generalizes the chain to a TREE of
+    candidate branches (serving/speculative.py tree drafting):
+    ``(S, K)`` node indices where draft node ``j`` (verify input
+    ``j + 1``) hangs off node ``parent[s, j] ∈ [0, j]`` — node 0 is
+    the root/pending token. ``accept[s, j]`` then tests the pick AT
+    THE PARENT position against the node's token (the chain is
+    ``parent[j] = j``, which reproduces the linear rule bit-for-bit);
+    the host walks the accepted tree for the best root-to-leaf path.
+    Tree verification under ``temperature > 0`` needs
+    without-replacement residual bookkeeping across siblings and is
+    rejected loudly (the engine enforces greedy for tree mode)."""
 
     def verify(rng_step: jax.Array, logits: jax.Array,
-               draft: jax.Array) -> tuple[jax.Array, jax.Array]:
+               draft: jax.Array, parent: jax.Array | None = None
+               ) -> tuple[jax.Array, jax.Array]:
         k = draft.shape[1]
         valid = draft >= 0
         if temperature == 0:
             picks = jnp.argmax(logits, axis=-1).astype(dtype)
-            accept = valid & (picks[:, :k] == draft)
+            if parent is None:
+                accept = valid & (picks[:, :k] == draft)
+            else:
+                at_parent = jnp.take_along_axis(picks, parent, axis=1)
+                accept = valid & (at_parent == draft)
             return accept, picks
+        if parent is not None:
+            raise ValueError(
+                "tree-structured speculative verification is "
+                "greedy-only: sampling acceptance over sibling "
+                "branches needs without-replacement residuals "
+                "(set temperature=0 for spec_tree)")
         f = _filter_logits(logits, temperature, top_k, top_p)
         probs = jax.nn.softmax(f, axis=-1)
         d_c = jnp.clip(draft, 0, logits.shape[-1] - 1)

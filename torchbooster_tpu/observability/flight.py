@@ -67,6 +67,11 @@ _DTYPE = np.dtype([
     # tensor-parallel head shards the step ran over (1 = single-chip):
     # a post-mortem must show WHICH topology the recorded steps took
     ("tp", np.int16),
+    # live slots decoding as a fork branch b > 0 (copy-on-write
+    # parallel sampling): a stall under n-way fan-out looks identical
+    # to one under plain load unless the record says how many slots
+    # were branches
+    ("branches", np.int16),
 ])
 
 # watchdog cadence/thresholds: p99 refresh interval (records), minimum
@@ -113,7 +118,8 @@ class FlightRecorder:
                pages_live: int, pages_free: int, pages_cached: int,
                queue_depth: int, tokens: int, accept_rate: float,
                wall_s: float, recompiled: bool = False,
-               inflight: Iterable[str] = (), tp: int = 1) -> None:
+               inflight: Iterable[str] = (), tp: int = 1,
+               branches: int = 0) -> None:
         """Write one step record in place and run the watchdog."""
         seq = self._seq
         row = self._ring[seq % self.capacity]
@@ -130,6 +136,7 @@ class FlightRecorder:
         row["wall_s"] = wall_s
         row["recompiled"] = recompiled
         row["tp"] = tp
+        row["branches"] = branches
         self._seq = seq + 1
         if recompiled:
             self._anomalies.append({

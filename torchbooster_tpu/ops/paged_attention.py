@@ -68,8 +68,8 @@ NEG_INF = -1e30   # the XLA sweep's mask value (_grouped_cache_attention)
 
 
 def _paged_kernel(wp_ref, wr_ref, wpos_ref, len_ref,
-                  q_ref, k_ref, v_ref, ks_ref, vs_ref, o_ref,
-                  m_scr, l_scr, acc_scr, *, page_size: int,
+                  q_ref, k_ref, v_ref, ks_ref, vs_ref, tvis_ref,
+                  o_ref, m_scr, l_scr, acc_scr, *, page_size: int,
                   n_lanes: int, rep: int, sm_scale: float,
                   n_slots: int, s_q: int):
     """One grid step = one live page: dequantize the page tile, then
@@ -105,7 +105,13 @@ def _paged_kernel(wp_ref, wr_ref, wpos_ref, len_ref,
     # absolute position of the page's tokens, and each query row's
     # visibility horizon: position j of the verify block sees tokens
     # <= lengths + j (j = 0 is exactly the decode mask — the token
-    # written this step sits AT lengths and must see itself)
+    # written this step sits AT lengths and must see itself). In TREE
+    # verify mode (tvis_ref set) the draft region is ancestor-only
+    # instead: token at offset ``off = pos - lengths`` in (0, s_q) is
+    # visible to query row j iff node ``off`` is an ancestor-or-self
+    # of node j (``tvis[slot, j, off]``) — sibling branches of the
+    # candidate tree never attend each other; the chain matrix
+    # ``tvis[j, i] = i <= j`` reproduces the linear mask bit-for-bit.
     tok = wpos_ref[i] * page_size + jax.lax.broadcasted_iota(
         jnp.int32, (s_q, page_size), 1)
     qpos = jax.lax.broadcasted_iota(jnp.int32, (s_q, page_size), 0)
@@ -116,7 +122,22 @@ def _paged_kernel(wp_ref, wr_ref, wpos_ref, len_ref,
         @pl.when(slot >= 0)
         def _lane(slot=slot):
             s_c = jnp.clip(slot, 0, n_slots - 1)
-            visible = tok <= len_ref[s_c] + qpos   # (s_q, ps)
+            if tvis_ref is None:
+                visible = tok <= len_ref[s_c] + qpos   # (s_q, ps)
+            else:
+                off = tok - len_ref[s_c]               # (s_q, ps)
+                # one-hot the offsets (off's rows are identical and
+                # qpos is the row index, so ``off == qpos`` marks
+                # row r where the token offset equals r) so the
+                # per-row ancestor lookup is a tiny (s_q, s_q) @
+                # (s_q, ps) dot — no dynamic gather in the kernel
+                oh = (off == qpos).astype(jnp.float32)
+                sel = jax.lax.dot_general(
+                    tvis_ref[s_c].astype(jnp.float32), oh,
+                    (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32)
+                visible = (off <= 0) | (
+                    (off > 0) & (off < s_q) & (sel > 0.5))
             q3 = (q_ref[s_c].astype(jnp.float32) * sm_scale
                   ).transpose(1, 0, 2)             # (H, s_q, Dh)
             scores = jax.lax.dot_general(
@@ -151,6 +172,7 @@ def paged_attention(q: jax.Array, pool_k, pool_v,
                     work_pages: jax.Array, work_refs: jax.Array,
                     work_pos: jax.Array, lengths: jax.Array, *,
                     page_size: int, sm_scale: float | None = None,
+                    tree_vis: jax.Array | None = None,
                     interpret: bool | None = None) -> jax.Array:
     """Paged flash-decode attention over the serving page pool.
 
@@ -164,7 +186,13 @@ def paged_attention(q: jax.Array, pool_k, pool_v,
       kernel_args()``): pool page id, holder slots (-1 empty lanes),
       and page position per entry — padding entries are page 0 with
       all lanes empty;
-    - ``lengths (max_slots,)``: tokens currently visible per slot.
+    - ``lengths (max_slots,)``: tokens currently visible per slot;
+    - ``tree_vis (max_slots, S, S)`` (optional, tree speculative
+      verify): ancestor-or-self matrix of the per-slot candidate
+      TREE — query row j sees draft offset i iff ``tree_vis[slot, j,
+      i]``; prior context (offsets <= 0) is always visible. ``None``
+      (decode and linear verify) keeps the causal-chain mask
+      bit-for-bit.
 
     Returns the normalized ``(max_slots, S, n_heads, head_dim)``
     attention output in ``q.dtype`` (garbage rows at slots no work
@@ -185,14 +213,26 @@ def paged_attention(q: jax.Array, pool_k, pool_v,
     body = functools.partial(
         _paged_kernel, page_size=page_size, n_lanes=n_lanes, rep=rep,
         sm_scale=sm_scale, n_slots=n_slots, s_q=s_q)
-    if quantized:
+    tree = tree_vis is not None
+    # optional operands (int8 scales, the tree-visibility matrix) are
+    # spliced into the shared kernel body's signature as None refs
+    # when absent, so ONE body serves all four layouts
+    if quantized and tree:
         kernel = body
+    elif quantized:
+        def kernel(wp, wr, wpos, ln, q_r, k_r, v_r, ks_r, vs_r,
+                   o_r, m_s, l_s, a_s):
+            body(wp, wr, wpos, ln, q_r, k_r, v_r, ks_r, vs_r, None,
+                 o_r, m_s, l_s, a_s)
+    elif tree:
+        def kernel(wp, wr, wpos, ln, q_r, k_r, v_r, tv_r,
+                   o_r, m_s, l_s, a_s):
+            body(wp, wr, wpos, ln, q_r, k_r, v_r, None, None, tv_r,
+                 o_r, m_s, l_s, a_s)
     else:
-        # plain pools carry no scale operands: splice None refs into
-        # the shared kernel body's signature
         def kernel(wp, wr, wpos, ln, q_r, k_r, v_r, o_r, m_s, l_s, a_s):
-            body(wp, wr, wpos, ln, q_r, k_r, v_r, None, None, o_r,
-                 m_s, l_s, a_s)
+            body(wp, wr, wpos, ln, q_r, k_r, v_r, None, None, None,
+                 o_r, m_s, l_s, a_s)
 
     # the block-table walk: the page BlockSpec's index comes from the
     # PREFETCHED work list, so grid step i streams exactly pool page
@@ -213,6 +253,11 @@ def paged_attention(q: jax.Array, pool_k, pool_v,
     else:
         in_specs = [full_spec, page_spec, page_spec]
         operands = (q, pool_k, pool_v)
+    if tree:
+        in_specs = in_specs + [pl.BlockSpec(
+            (n_slots, s_q, s_q),
+            lambda i, wp, wr, wpos, ln: (0, 0, 0))]
+        operands = operands + (jnp.asarray(tree_vis, jnp.int32),)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=4,

@@ -23,6 +23,13 @@ package stops streaming dead bytes:
   (``speculative: true``): model-free prompt-lookup drafting plus ONE
   compiled multi-token verify step, so each pool read yields
   ``accepted + 1`` tokens instead of one (greedy-parity-exact);
+  ``spec_tree: true`` upgrades the chain to a TREE of candidate
+  branches verified in the same pass through ancestor-only
+  visibility masks, the best accepted root-to-leaf path winning;
+  copy-on-write parallel sampling (``parallel_sampling: true``, the
+  OpenAI ``n``/``best_of`` surface) forks a prefilled slot into n
+  branches sharing every full page through the refs lanes with
+  per-branch PRNG keys and logprob accounting;
 - :mod:`loadgen` — the workload capture & deterministic replay
   harness: a versioned JSONL workload format with content
   fingerprints, front-door capture (``frontend.capture_path``),
@@ -66,6 +73,7 @@ from torchbooster_tpu.serving.kv_pages import (
 from torchbooster_tpu.serving.speculative import (
     NO_DRAFT,
     PromptLookupDrafter,
+    TreeLookupDrafter,
 )
 
 
@@ -81,4 +89,5 @@ def __getattr__(name: str):
 __all__ = ["BlockTables", "ContinuousBatcher", "FCFSPolicy",
            "NO_DRAFT", "NULL_PAGE", "PagedEngine", "PriorityClass",
            "PromptLookupDrafter", "Request", "SLOPolicy",
-           "SchedulerPolicy", "ServingFrontend", "make_pool"]
+           "SchedulerPolicy", "ServingFrontend", "TreeLookupDrafter",
+           "make_pool"]

@@ -59,6 +59,7 @@ from __future__ import annotations
 
 import time
 import uuid
+import zlib
 from collections import deque
 from dataclasses import dataclass, field
 
@@ -75,6 +76,7 @@ from torchbooster_tpu.observability.flight import (
 from torchbooster_tpu.observability.recompile import POLICIES
 from torchbooster_tpu.observability.tracing import RequestTracer
 from torchbooster_tpu.serving.engine import PagedEngine
+from torchbooster_tpu.serving.kv_pages import PoolExhausted
 from torchbooster_tpu.serving.frontend.scheduler import (
     FCFSPolicy,
     SchedulerPolicy,
@@ -98,7 +100,20 @@ class Request:
     submit time, where the class table is known), ``deadline_ms``
     overrides the class TTFT deadline, and ``arrival_time`` is the
     submitter's wall-clock timestamp (informational — scheduling runs
-    on the batcher clock via ``arrival``)."""
+    on the batcher clock via ``arrival``).
+
+    Parallel sampling (OpenAI ``n``/``best_of``; needs a
+    ``parallel_sampling=True`` engine): ``n`` completions are
+    returned, ``best_of`` (default ``n``) branches are decoded and
+    ranked by cumulative logprob — ONE prefill forks into
+    ``best_of`` copy-on-write branches at the first token. ``seed``
+    pins the request's sampling key family (branch b samples with
+    ``fold_in(PRNGKey(seed), b)``); ``None`` derives one from the
+    request id, so replays with stable ids reproduce exactly. The
+    batcher materializes sibling branches as internal child Requests
+    (``parent``/``branch``/``branches`` fields) that ride every
+    scheduling path — preemption folds and re-admits a branch alone,
+    its key keeps its stream token-exact."""
     prompt: np.ndarray
     max_new_tokens: int = 32
     eos_id: int | None = None
@@ -106,6 +121,9 @@ class Request:
     priority: str = ""
     deadline_ms: float | None = None
     arrival_time: float | None = None
+    n: int = 1
+    best_of: int | None = None
+    seed: int | None = None
     # stable identity for tracing and the HTTP surface: auto-generated
     # when empty; the front door honors a client X-Request-Id header
     # by passing it through here
@@ -118,6 +136,17 @@ class Request:
     finish_reason: str | None = None
     shed: bool = False
     cancelled: bool = False
+    # fork bookkeeping (filled by the batcher at fork time): branch 0
+    # is the submitted request itself; siblings are internal child
+    # Requests pointing back via ``parent``; ``branches`` (on branch
+    # 0 only) lists the whole family in branch order once forked —
+    # also the "already forked" latch a preempted-and-reseated branch
+    # 0 relies on. ``cum_logprob`` accumulates the picked tokens'
+    # logprobs for best_of ranking.
+    parent: "Request | None" = None
+    branch: int = 0
+    branches: "list | None" = None
+    cum_logprob: float = 0.0
 
     def __post_init__(self):
         self.prompt = np.asarray(self.prompt, np.int32).reshape(-1)
@@ -143,13 +172,38 @@ class Request:
             raise TypeError(
                 f"request_id must be a str ('' = auto-generate), got "
                 f"{type(self.request_id).__name__}")
+        if not isinstance(self.n, int) or self.n < 1:
+            raise ValueError(f"n must be an int >= 1, got {self.n!r}")
+        if self.best_of is not None and (
+                not isinstance(self.best_of, int)
+                or self.best_of < self.n):
+            raise ValueError(
+                f"best_of must be an int >= n ({self.n}), got "
+                f"{self.best_of!r}")
+        if self.seed is not None and not isinstance(self.seed, int):
+            raise TypeError(
+                f"seed must be an int or None, got "
+                f"{type(self.seed).__name__}")
         if not self.request_id:
             self.request_id = "req-" + uuid.uuid4().hex[:16]
+        if self.seed is None:
+            # id-derived: deterministic whenever ids are (captured/
+            # synthetic replays), effectively random under the uuid
+            # auto-id — the branch-key family every sampling decision
+            # of this request folds from
+            self.seed = zlib.crc32(self.request_id.encode()) \
+                & 0x7fffffff
         # the ORIGINAL prompt length: preemption folds generated tokens
         # into ``prompt`` for the re-prefill, so the true context length
         # is base_len + len(tokens) — counting from the grown prompt
         # would double-count and truncate the request at the horizon
         self.base_len = int(self.prompt.size)
+
+    @property
+    def n_branches(self) -> int:
+        """Branches decoded for this request: ``best_of`` when set,
+        else ``n`` (1 = the ordinary single-stream request)."""
+        return self.best_of if self.best_of is not None else self.n
 
 
 class _Session:
@@ -193,6 +247,9 @@ class _Session:
         self.spec_steps0 = eng.spec_steps
         self.spec_prop0 = eng.spec_proposed
         self.spec_acc0 = eng.spec_accepted
+        self.forks0 = eng.forks
+        self.fork_pages0 = eng.fork_pages
+        self.cow0 = eng.cow_copies
         self.closed = False
 
     def sample(self, series: list[float], value: float) -> None:
@@ -283,6 +340,29 @@ class ContinuousBatcher:
                 f"prompt ({req.base_len}) + max_new_tokens "
                 f"({req.max_new_tokens}) exceeds cfg.seq_len "
                 f"({self.engine.cfg.seq_len})")
+        nb = req.n_branches
+        if nb > 1:
+            if not self.engine.parallel:
+                raise ValueError(
+                    f"n/best_of > 1 ({req.n}/{req.best_of}) needs a "
+                    "parallel-sampling engine: set "
+                    "serving.parallel_sampling: true")
+            if nb > self.engine.max_slots:
+                raise ValueError(
+                    f"best_of ({nb}) exceeds serving.max_slots "
+                    f"({self.engine.max_slots}): every branch "
+                    "decodes in its own slot")
+            # worst-case page footprint of the whole family ALONE:
+            # the full prompt pages once (shared) + every branch's
+            # private tail/output pages
+            shared = req.base_len // self.engine.page_size
+            per_branch = self.engine.tables.pages_for(worst) - shared
+            if shared + nb * per_branch > self.engine.n_pages - 1:
+                raise ValueError(
+                    f"request needs {shared} shared prompt pages + "
+                    f"{nb} x {per_branch} per-branch pages but the "
+                    f"pool holds {self.engine.n_pages - 1}; grow "
+                    "serving.n_pages or lower best_of")
         reserve = worst
         if self.engine.speculative:
             # grow_slots demands 1 + draft_len write positions ahead
@@ -326,6 +406,23 @@ class ContinuousBatcher:
             [req.prompt, np.asarray(req.tokens[folded:], np.int32)])
         matched = self.engine.tables.match_pages(ctx)
         return len(ctx) - len(matched) * self.engine.page_size
+
+    def _free_slot_count(self) -> int:
+        # the tables' own idle definition — never a re-implementation
+        # (kv_pages.n_free_slots), so the admission gate and the
+        # seating code cannot drift apart
+        return self.engine.tables.n_free_slots()
+
+    def _reserved_slots(self) -> int:
+        """Slots spoken for by mid-prefill n-way requests: their
+        ``best_of - 1`` siblings fork the moment prefill completes,
+        so plain admissions must not seat into them (a fork with no
+        free slot would have to preempt what was just admitted)."""
+        s = self._s
+        if s is None:
+            return 0
+        return sum(r.n_branches - 1 for r in s.filling.values()
+                   if r.branches is None and r.n_branches > 1)
 
     @property
     def occupancy(self) -> float:
@@ -458,6 +555,14 @@ class ContinuousBatcher:
             "spec_rate": reg.gauge(
                 "serving_spec_accept_rate",
                 "accepted/proposed draft tokens over this run"),
+            "fork_pages": reg.counter(
+                "serving_fork_pages_total",
+                "pages shared into sibling branches at fork "
+                "(copy-on-write parallel sampling)"),
+            "cow_copies": reg.counter(
+                "serving_cow_copies_total",
+                "private tail pages copied at fork (the only bytes "
+                "n-way sampling duplicates)"),
         }
         if self.engine.tp > 1:
             # tensor-parallel serving only (absent at tp=1 so the
@@ -656,25 +761,30 @@ class ContinuousBatcher:
     def _drain_cancels(self, events: list) -> None:
         s = self._s
         while self._inbox_cancel:
-            req = self._inbox_cancel.popleft()
-            if req.finished_at is not None:
-                continue                      # raced completion: done
-            if any(req is q for q in s.queue):
-                s.queue.remove(req)
-                self._cancel_request(req, events)
-                continue
-            for table in (s.filling, s.live):
-                slot = next((sl for sl, r in table.items()
-                             if r is req), None)
-                if slot is not None:
-                    # the engine abort paths: retire() cancels an
-                    # in-flight chunked prefill (PR 4 pending-slot
-                    # abort) and reclaims the slot's pages either way
-                    table.pop(slot)
-                    s.admit_order.remove(slot)
-                    self.engine.retire(slot)
+            root = self._inbox_cancel.popleft()
+            # cancelling an n-way request cancels its WHOLE family:
+            # the client asked for one completion set, the branches
+            # have no independent existence on the wire
+            for req in (root.branches or [root]):
+                if req.finished_at is not None:
+                    continue                  # raced completion: done
+                if any(req is q for q in s.queue):
+                    s.queue.remove(req)
                     self._cancel_request(req, events)
-                    break
+                    continue
+                for table in (s.filling, s.live):
+                    slot = next((sl for sl, r in table.items()
+                                 if r is req), None)
+                    if slot is not None:
+                        # the engine abort paths: retire() cancels an
+                        # in-flight chunked prefill (PR 4 pending-slot
+                        # abort) and reclaims the slot's pages either
+                        # way
+                        table.pop(slot)
+                        s.admit_order.remove(slot)
+                        self.engine.retire(slot)
+                        self._cancel_request(req, events)
+                        break
 
     def _shed_request(self, req: Request, events: list) -> None:
         s = self._s
@@ -692,6 +802,94 @@ class ContinuousBatcher:
             cs["shed"] += 1
             self._inst["slo_shed"].inc(
                 cls=self.policy.cls_of(req).name)
+
+    def _preempt_one(self, s: _Session,
+                     exclude: frozenset | set = frozenset()) -> bool:
+        """Evict ONE policy-chosen seated victim back to the front of
+        the queue with its generated tokens folded into its prompt
+        (mid-prefill victims fold nothing). ``exclude`` shields slots
+        the caller is mid-operation on (a forking parent must not
+        evict itself). Returns False when no eligible victim exists.
+        """
+        order = [sl for sl in s.admit_order if sl not in exclude]
+        if not order:
+            return False
+        seated = {sl: r for sl, r in {**s.filling, **s.live}.items()
+                  if sl not in exclude}
+        victim = self.policy.select_victim(order, seated, self)
+        req = (s.live.pop(victim) if victim in s.live
+               else s.filling.pop(victim))
+        s.admit_order.remove(victim)
+        self.engine.retire(victim)
+        # fold generated tokens into the prompt so it resumes
+        # from its full context on re-admission — only the
+        # NOT-yet-folded suffix: a second preemption would
+        # otherwise re-append tokens already in the prompt,
+        # duplicating context (prompt always holds base_len +
+        # folded tokens, so the folded count is its excess; a
+        # mid-prefill victim has no tokens and folds nothing)
+        folded = len(req.prompt) - req.base_len
+        if self.tracer.enabled:
+            self.tracer.emit(req.request_id, "preempted",
+                             slot=victim,
+                             fold_tokens=len(req.tokens) - folded)
+        req.prompt = np.concatenate(
+            [req.prompt,
+             np.asarray(req.tokens[folded:], np.int32)])
+        s.queue.insert(0, req)
+        s.n_preemptions += 1
+        self._inst["preemptions"].inc()
+        return True
+
+    def _fork_request(self, slot: int, req: Request,
+                      events: list) -> None:
+        """Split a just-prefilled n-way request into its ``best_of``
+        copy-on-write branches: the engine forks the pages and
+        samples every branch's own first token; sibling branches
+        materialize as internal child Requests riding every ordinary
+        scheduling path from here on (stop checks, preemption,
+        cancellation, metrics). Under pool pressure the fork preempts
+        policy victims — never its own family — and retries."""
+        s = self._s
+        while True:
+            try:
+                branches = self.engine.fork(slot, req.n_branches)
+                break
+            except PoolExhausted:
+                # no slots/pages for the siblings: evict a victim and
+                # retry (submit-time _check_fits guarantees the
+                # family fits an EMPTY pool, so this terminates).
+                # ONLY genuine capacity pressure retries — a fork
+                # contract violation (plain RuntimeError) must
+                # surface immediately, not mass-preempt the pool on
+                # its way out.
+                if not self._preempt_one(s, exclude={slot}):
+                    raise
+        req.branch = 0
+        family = [req]
+        for b, (sb, tok, lp) in enumerate(branches[1:], start=1):
+            child = Request(
+                prompt=req.prompt, max_new_tokens=req.max_new_tokens,
+                eos_id=req.eos_id, arrival=req.arrival,
+                priority=req.priority, deadline_ms=req.deadline_ms,
+                arrival_time=req.arrival_time,
+                request_id=f"{req.request_id}#{b}", seed=req.seed)
+            child.parent = req
+            child.branch = b
+            child.admitted_at = req.admitted_at
+            s.live[sb] = child
+            s.admit_order.append(sb)
+            family.append(child)
+        req.branches = family
+        if self.tracer.enabled:
+            self.tracer.emit(req.request_id, "forked",
+                             n_branches=req.n_branches,
+                             shared_pages=int(
+                                 req.base_len // self.engine.page_size))
+        for (sb, tok, lp), branch_req in zip(branches, family):
+            branch_req.cum_logprob += lp
+            self._maybe_stop(sb, int(tok))
+            events.append((branch_req, [int(tok)]))
 
     def step(self) -> list[tuple[Request, list[int]]]:
         """ONE scheduling iteration — the old run() loop body, now
@@ -752,7 +950,8 @@ class ContinuousBatcher:
                            for r in (*s.filling.values(),
                                      *s.live.values())]
                           if recompiled else ()),
-                tp=eng.tp)
+                tp=eng.tp,
+                branches=eng.branch_slot_count)
         return events
 
     def _step_body(self, s: _Session, st: dict,
@@ -790,7 +989,16 @@ class ContinuousBatcher:
             if req is None:
                 break
             hits0 = self.engine.prefix_hit_pages
-            slot = self.engine.admit_begin(req.prompt)
+            # slot budget: an n-way request needs its whole family's
+            # slots effectively free (it seats one now and RESERVES
+            # the rest for the fork at its prefill boundary); plain
+            # requests must not eat into standing reservations
+            need = req.n_branches if req.branches is None else 1
+            if self._free_slot_count() - self._reserved_slots() < need:
+                slot = None
+            else:
+                slot = self.engine.admit_begin(
+                    req.prompt, seed=req.seed, branch=req.branch)
             if slot is None:
                 if self.policy.stop_on_admit_failure:
                     break         # no slot/pages: keep FCFS order
@@ -839,8 +1047,24 @@ class ContinuousBatcher:
                 slot, first = done
                 req = s.filling.pop(slot)
                 s.live[slot] = req
-                self._maybe_stop(slot, first)  # prefill's token
-                events.append((req, [int(first)]))
+                if req.n_branches > 1 and req.branches is None:
+                    # one prefill, best_of decode branches: fork at
+                    # the boundary so every branch diverges from its
+                    # own first token (branch 0's pick == `first`)
+                    self._fork_request(slot, req, events)
+                else:
+                    if self.engine.parallel:
+                        # the first token's logprob belongs to the
+                        # sequence logprob too (n = 1 requests and
+                        # re-admitted fork branches alike — a
+                        # preempted branch skipping it would bias
+                        # best_of toward preempted siblings); this
+                        # also frees the stashed prompt logits a
+                        # never-forking request otherwise holds
+                        req.cum_logprob += \
+                            self.engine.take_first_logprob(slot)
+                    self._maybe_stop(slot, first)  # prefill's token
+                    events.append((req, [int(first)]))
         self._inst["slots"].set(len(s.live))
         self._inst["pages"].set(self.engine.tables.n_free_pages)
         if not s.live:
@@ -850,31 +1074,8 @@ class ContinuousBatcher:
         # POLICY's victim (FCFS: youngest seated) ---
         starved = self.engine.grow_slots()
         while starved:
-            seated = {**s.filling, **s.live}
-            victim = self.policy.select_victim(
-                s.admit_order, seated, self)
-            req = (s.live.pop(victim) if victim in s.live
-                   else s.filling.pop(victim))
-            s.admit_order.remove(victim)
-            self.engine.retire(victim)
-            # fold generated tokens into the prompt so it resumes
-            # from its full context on re-admission — only the
-            # NOT-yet-folded suffix: a second preemption would
-            # otherwise re-append tokens already in the prompt,
-            # duplicating context (prompt always holds base_len +
-            # folded tokens, so the folded count is its excess; a
-            # mid-prefill victim has no tokens and folds nothing)
-            folded = len(req.prompt) - req.base_len
-            if self.tracer.enabled:
-                self.tracer.emit(req.request_id, "preempted",
-                                 slot=victim,
-                                 fold_tokens=len(req.tokens) - folded)
-            req.prompt = np.concatenate(
-                [req.prompt,
-                 np.asarray(req.tokens[folded:], np.int32)])
-            s.queue.insert(0, req)
-            s.n_preemptions += 1
-            self._inst["preemptions"].inc()
+            if not self._preempt_one(s):
+                break
             starved = self.engine.grow_slots() if s.live else []
         if not s.live:
             return events
@@ -959,8 +1160,13 @@ class ContinuousBatcher:
             s.decoded += len(s.live)
             self._inst["tokens"].inc(len(s.live))
             self._drain_cancels(events)
+            lps = self.engine.step_logprobs
             for slot in list(s.live):
                 req = s.live[slot]
+                if lps is not None:
+                    # per-branch sequence logprob — what best_of
+                    # ranks by (parallel-sampling engines only)
+                    req.cum_logprob += float(lps[slot])
                 # token delta BEFORE the stop-check: retired must be
                 # the last event on the request's trace timeline
                 if self.tracer.enabled:
@@ -1047,6 +1253,8 @@ class ContinuousBatcher:
         inst["spec_prop"].inc(n_spec_prop)
         inst["spec_acc"].inc(n_spec_acc)
         inst["spec_rate"].set(n_spec_acc / max(n_spec_prop, 1))
+        inst["fork_pages"].inc(self.engine.fork_pages - s.fork_pages0)
+        inst["cow_copies"].inc(self.engine.cow_copies - s.cow0)
         if self.policy.slo:
             for name, cs in s.per_class.items():
                 inst["slo_ttft_rate"].set(
@@ -1121,6 +1329,13 @@ class ContinuousBatcher:
             "spec_mean_accepted": round(
                 (self.engine.spec_accepted - s.spec_acc0)
                 / max(self.engine.spec_steps - s.spec_steps0, 1), 4),
+            # copy-on-write parallel sampling (all zero on a
+            # non-parallel engine): forks performed, pages SHARED
+            # into branches (HBM reads amortized), and the private
+            # tail-page copies — the only bytes n-way duplicates
+            "n_forks": self.engine.forks - s.forks0,
+            "fork_pages": self.engine.fork_pages - s.fork_pages0,
+            "n_cow_copies": self.engine.cow_copies - s.cow0,
             # SLO scheduler stats — stable keys on EVERY return path
             # (the established contract): zero/empty under FCFS,
             # populated per configured class under an SLO policy
@@ -1147,6 +1362,7 @@ class ContinuousBatcher:
                     "n_spec_steps": 0, "n_spec_proposed": 0,
                     "n_spec_accepted": 0, "spec_accept_rate": 0.0,
                     "spec_mean_accepted": 0.0,
+                    "n_forks": 0, "fork_pages": 0, "n_cow_copies": 0,
                     "n_shed": 0, "n_cancelled": 0,
                     "deadline_hit_rate": 1.0, "classes": {
                         name: {"n_requests": 0, "n_completed": 0,

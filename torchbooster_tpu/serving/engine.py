@@ -66,8 +66,10 @@ from torchbooster_tpu.models.gpt import (
     GPTConfig,
     _block_core,
     _check_pos,
+    _filter_logits,
     _grouped_cache_attention,
     _lm_head,
+    _make_branch_pick,
     _make_pick,
     _quantize_kv,
     qkv_to_tp_major,
@@ -87,8 +89,11 @@ from torchbooster_tpu.serving.tp import (
 )
 from torchbooster_tpu.serving.speculative import (
     PromptLookupDrafter,
+    TreeLookupDrafter,
     accept_count,
     make_verify_fn,
+    tree_accept_path,
+    tree_masks,
 )
 
 
@@ -129,6 +134,31 @@ class PagedEngine:
     ``ngram_min`` tune the drafter. Off (the default), no verify
     executable exists and the engine is bit-for-bit the
     non-speculative one.
+
+    ``parallel_sampling=True`` turns on copy-on-write parallel
+    decoding (OpenAI ``n``/``best_of``): :meth:`fork` splits a
+    just-prefilled slot into n branches that SHARE every full page
+    through the refs lanes (one pool read serves all branches — the
+    same sharing contract the prefix cache rides, on both backends)
+    and copy only the partial tail page; every slot samples with its
+    own branch key (``fold_in(PRNGKey(seed), branch)`` folded again
+    with the context length per step) and the decode step returns
+    per-slot token logprobs for ``best_of`` ranking. Branch b's
+    stream is token-exact vs an independent single-slot run admitted
+    with the same ``(seed, branch=b)`` — greedy or seeded sampling —
+    and fork churn adds zero decode compiles. Off (the default) the
+    engine is bit-for-bit unchanged. Mutually exclusive with
+    ``speculative``.
+
+    ``spec_tree=True`` (requires ``speculative=True`` and greedy
+    decoding) upgrades the linear draft chain to a TREE of candidate
+    branches (serving/speculative.py ``TreeLookupDrafter``): up to
+    ``tree_width`` distinct continuations ride the SAME ``1 +
+    draft_len`` verify positions with ancestor-only visibility masks
+    (traced values — adaptive tree shapes never recompile), the best
+    accepted root-to-leaf path wins, and its K/V rows compact into
+    contiguous positions in one fixed-shape pass. On unambiguous
+    streams the tree degenerates to the linear chain bit-for-bit.
 
     ``decode_backend="pallas"`` swaps the decode AND verify steps'
     pool READ for the paged flash-decode kernel
@@ -178,7 +208,10 @@ class PagedEngine:
                  ngram_min: int = 2,
                  decode_backend: str = "xla",
                  tp: int = 1,
-                 mesh: Any = None):
+                 mesh: Any = None,
+                 parallel_sampling: bool = False,
+                 spec_tree: bool = False,
+                 tree_width: int = 2):
         if cfg.seq_len % page_size:
             # a last partial page per slot would shift page_pos math;
             # geometry is static, so fail loudly at construction
@@ -204,6 +237,23 @@ class PagedEngine:
                 f"speculative decoding needs 1 <= draft_len < "
                 f"page_size, got draft_len={draft_len} with "
                 f"page_size={page_size}")
+        if spec_tree and not speculative:
+            raise ValueError(
+                "spec_tree=True needs speculative=True: tree "
+                "drafting generalizes the draft+verify path, there "
+                "is no tree without a verify step")
+        if spec_tree and temperature != 0:
+            raise ValueError(
+                f"spec_tree needs greedy decoding (temperature=0, "
+                f"got {temperature}): sampling acceptance across "
+                "sibling branches needs without-replacement "
+                "residuals the verify rule does not carry")
+        if parallel_sampling and speculative:
+            raise ValueError(
+                "parallel_sampling and speculative are mutually "
+                "exclusive: the per-branch PRNG/logprob accounting "
+                "rides the plain decode step — serve n-way traffic "
+                "on a non-speculative engine")
         # same params/config positional-encoding guard the dense
         # generate() applies — a rope checkpoint served with
         # pos="learned" (or vice versa, or a tp-major-permuted tree)
@@ -230,8 +280,17 @@ class PagedEngine:
         if not self.quantized and cache_dtype is not None:
             raise ValueError(
                 f"cache_dtype must be None or 'int8', got {cache_dtype!r}")
+        # copy-on-write parallel sampling (OpenAI n/best_of): fork a
+        # prefilled slot into n branches sharing every full page
+        # through the refs lanes, per-branch PRNG keys folded by
+        # branch id, per-token logprobs for best_of ranking. Off (the
+        # default), no key table crosses the jit boundary and the
+        # decode step is bit-for-bit the non-parallel engine's — the
+        # same collapse contract as n_ref_lanes for the prefix cache.
+        self.parallel = bool(parallel_sampling)
         self.tables = BlockTables(cfg, page_size, n_pages, max_slots,
-                                  prefix_cache=prefix_cache)
+                                  prefix_cache=prefix_cache,
+                                  parallel=self.parallel)
         self.prefill_chunk_pages = min(prefill_chunk_pages,
                                        self.tables.max_pages_per_slot)
         self.chunk_tokens = self.prefill_chunk_pages * page_size
@@ -272,6 +331,22 @@ class PagedEngine:
         self.spec_proposed = 0
         self.spec_accepted = 0
         self.spec_steps = 0
+        self.forks = 0
+        self.fork_pages = 0      # pages SHARED into children at fork
+        self.cow_copies = 0      # private tail pages copied at fork
+        # per-slot branch PRNG state (parallel sampling only): the
+        # request's BASE key, the slot's folded branch key, and its
+        # branch index — host numpy, rebuilt at admit/fork, one
+        # fixed-shape operand per decode step
+        self._base_keys = np.zeros((max_slots, 2), np.uint32)
+        self._slot_keys = np.zeros((max_slots, 2), np.uint32)
+        self._branch_of = np.zeros(max_slots, np.int32)
+        # prefill-final logits + branch-0 logprob stashed per slot so
+        # fork() can sample every branch's own first token from the
+        # SAME prompt distribution (parallel mode only; popped at
+        # fork/retire)
+        self._fork_state: dict[int, dict] = {}
+        self.step_logprobs: np.ndarray | None = None
         # the pool crosses the jit boundary EVERY call — donate it so
         # XLA updates the pages in place; an undonated pool would copy
         # pool-sized bytes per step, re-taxing exactly the HBM traffic
@@ -281,17 +356,49 @@ class PagedEngine:
         # replicated post-psum; at tp == 1 the un-wrapped jits below
         # are byte-identical to the single-chip engine's.
         n_extra = 3 if decode_backend == "pallas" else 0
+        # the per-branch pick path threads one extra operand (the
+        # slot-key table) and returns one extra replicated output
+        # (per-slot logprobs); the chunk returns (token, logprob,
+        # final logits) instead of just the token
+        n_par = 1 if self.parallel else 0
+        self._branch_pick = _make_branch_pick(
+            temperature, top_k, top_p, jnp.int32)
         if self.tp > 1:
             pspecs = _tp_param_specs(self.params)
-            self._chunk_jit = _shard_engine_fn(self._chunk_fn, mesh,
-                                               pspecs, 5, 1)
-            self._decode_jit = _shard_engine_fn(self._decode_fn, mesh,
-                                                pspecs, 7 + n_extra, 1)
+            self._chunk_jit = _shard_engine_fn(
+                self._chunk_fn, mesh, pspecs, 5,
+                3 if self.parallel else 1)
+            self._decode_jit = _shard_engine_fn(
+                self._decode_fn, mesh, pspecs,
+                7 + n_extra + n_par, 1 + n_par)
         else:
             self._chunk_jit = jax.jit(self._chunk_fn,
                                       donate_argnums=(1, 2))
             self._decode_jit = jax.jit(self._decode_fn,
                                        donate_argnums=(1, 2))
+        # the fork-time copy-on-write page copy (parallel mode only):
+        # ONE fixed-shape executable — (max_slots,) src/dst page-id
+        # vectors padded with null->null self-copies — compiled once
+        # at the first fork; fork churn itself never touches the
+        # decode/verify executables (the zero-recompile contract)
+        self._cow_jit = None
+        if self.parallel:
+            if self.tp > 1:
+                from jax.experimental.shard_map import shard_map
+                from jax.sharding import NamedSharding
+                from torchbooster_tpu.serving.tp import POOL_SPEC, REP
+                pool_ns = NamedSharding(mesh, POOL_SPEC)
+                self._cow_jit = jax.jit(
+                    shard_map(self._cow_fn, mesh=mesh,
+                              in_specs=(POOL_SPEC, POOL_SPEC, REP,
+                                        REP),
+                              out_specs=(POOL_SPEC, POOL_SPEC),
+                              check_rep=False),
+                    donate_argnums=(0, 1),
+                    out_shardings=(pool_ns, pool_ns))
+            else:
+                self._cow_jit = jax.jit(self._cow_fn,
+                                        donate_argnums=(0, 1))
         # speculative mode (serving/speculative.py): the drafter and
         # the ONE multi-token verify executable exist only when it is
         # on — the cold engine's compiled artifacts and per-step work
@@ -299,18 +406,50 @@ class PagedEngine:
         # collapse contract as n_ref_lanes for the prefix cache)
         self.speculative = bool(speculative)
         self.draft_len = draft_len
+        # tree speculative decoding: the drafter proposes a TREE of
+        # candidate branches and the verify step scores every node in
+        # the same single pass through ancestor-only visibility masks
+        # (all traced VALUES — adaptive per-step tree shapes cannot
+        # recompile); the accepted root-to-leaf path is compacted
+        # into contiguous K/V rows by _compact_fn after each step
+        self.spec_tree = bool(spec_tree)
+        self.tree_width = tree_width
         self._drafter = None
         self._verify_jit = None
+        self._compact_jit = None
         if self.speculative:
-            self._drafter = PromptLookupDrafter(draft_len,
-                                                ngram_min=ngram_min)
+            if self.spec_tree:
+                self._drafter = TreeLookupDrafter(
+                    draft_len, ngram_min=ngram_min, width=tree_width)
+            else:
+                self._drafter = PromptLookupDrafter(
+                    draft_len, ngram_min=ngram_min)
             verify_fn = make_verify_fn(self)
+            n_tree = 3 if self.spec_tree else 0
             if self.tp > 1:
                 self._verify_jit = _shard_engine_fn(
-                    verify_fn, mesh, pspecs, 7 + n_extra, 2)
+                    verify_fn, mesh, pspecs, 7 + n_tree + n_extra, 2)
             else:
                 self._verify_jit = jax.jit(verify_fn,
                                            donate_argnums=(1, 2))
+            if self.spec_tree:
+                if self.tp > 1:
+                    from jax.experimental.shard_map import shard_map
+                    from jax.sharding import NamedSharding
+                    from torchbooster_tpu.serving.tp import (
+                        POOL_SPEC, REP)
+                    pool_ns = NamedSharding(mesh, POOL_SPEC)
+                    self._compact_jit = jax.jit(
+                        shard_map(self._compact_fn, mesh=mesh,
+                                  in_specs=(POOL_SPEC, POOL_SPEC,
+                                            REP, REP, REP, REP),
+                                  out_specs=(POOL_SPEC, POOL_SPEC),
+                                  check_rep=False),
+                        donate_argnums=(0, 1),
+                        out_shardings=(pool_ns, pool_ns))
+                else:
+                    self._compact_jit = jax.jit(
+                        self._compact_fn, donate_argnums=(0, 1))
 
     @classmethod
     def dense_control(cls, params: dict, cfg: GPTConfig, *,
@@ -341,7 +480,14 @@ class PagedEngine:
         reserved null page past the table) which every mask excludes.
         Returns ``(picked token, pool_k, pool_v)`` — the pick is only
         meaningful on the chunk containing position ``s0 - 1`` (the
-        host uses it there; earlier chunks discard it)."""
+        host uses it there; earlier chunks discard it). In PARALLEL
+        mode the ``rng`` operand is the slot's BRANCH KEY (not a
+        per-step split): the pick key is ``fold_in(key, s0)`` — a
+        pure function of (branch key, context length), so a
+        preempted-and-refolded branch resumes its sampling stream
+        exactly — and the return grows the pick's logprob plus the
+        final-position logits ``fork()`` samples sibling branches'
+        first tokens from."""
         cfg, ps = self.cfg, self.page_size
         C = ids.shape[1]
         n_cp = C // ps
@@ -430,16 +576,26 @@ class PagedEngine:
         last = jax.lax.dynamic_slice_in_dim(
             x, jnp.clip(s0 - 1 - start, 0, C - 1), 1, axis=1)
         logits = _lm_head(params, last)[:, 0]
+        if self.parallel:
+            key = jax.random.fold_in(rng, s0)
+            tok, lp = self._branch_pick(key[None], logits)
+            return tok, lp, logits, pool_k, pool_v
         return self._pick(rng, logits), pool_k, pool_v
 
     def _decode_fn(self, params, pool_k, pool_v, tables, lengths,
-                   refs, page_pos, active, last_ids, rng,
-                   work_pages=None, work_refs=None, work_pos=None):
+                   refs, page_pos, active, last_ids, rng, *extra):
         """One decode step over all slots. Signature shapes depend
         only on pool geometry — never on which slots are live or how
-        pages are shared. The trailing ``work_*`` operands exist only
-        on the pallas backend (the compacted live-page walk from
-        ``kernel_args()``); the XLA sweep never receives them."""
+        pages are shared. The trailing operands exist only on their
+        modes — ``work_*`` on the pallas backend (the compacted
+        live-page walk from ``kernel_args()``), the slot-key table in
+        parallel-sampling mode — so the default engine's jitted call
+        signature is byte-identical to the pre-feature one."""
+        work_pages = work_refs = work_pos = slot_keys = None
+        if self.decode_backend == "pallas":
+            work_pages, work_refs, work_pos = extra[:3]
+        if self.parallel:
+            slot_keys = extra[-1]
         cfg, ps = self.cfg, self.page_size
         n_slots = last_ids.shape[0]
         n_heads_l = cfg.n_heads // self.tp    # local heads (tp shard)
@@ -561,7 +717,72 @@ class PagedEngine:
         x, (pool_k, pool_v) = jax.lax.scan(
             layer, x, (params["blocks"], pool_k, pool_v))
         logits = _lm_head(params, x)[:, 0]
+        if self.parallel:
+            # per-branch keys: fold each slot's branch key with its
+            # context length (lengths + 1 — the pending token counts),
+            # so branch b's token at depth d is a pure function of
+            # (branch key, d, logits): token-exact vs an independent
+            # single-slot run with the same key, preemption-invariant
+            # (a refolded prompt re-samples with the same context
+            # count), and graftlint's prng rule stays green (fold_in
+            # is the sanctioned derivation)
+            keys = jax.vmap(jax.random.fold_in)(slot_keys, lengths + 1)
+            tokens, lps = self._branch_pick(keys, logits)
+            return tokens, lps, pool_k, pool_v
         return self._pick(rng, logits), pool_k, pool_v
+
+    def _cow_fn(self, pool_k, pool_v, src_pages, dst_pages):
+        """The fork-time copy-on-write tail copy: pool page
+        ``dst_pages[i]`` becomes a byte-copy of ``src_pages[i]``
+        across every layer (both pool halves; int8 pools copy values
+        AND scales). Fixed ``(max_slots,)`` id vectors padded with
+        null→null self-copies, so one executable serves any fork
+        fan-out — fork churn compiles nothing after the first."""
+
+        def copy(pool):
+            def one(a):
+                return a.at[:, dst_pages].set(a[:, src_pages])
+            return (tuple(one(x) for x in pool)
+                    if isinstance(pool, tuple) else one(pool))
+
+        return copy(pool_k), copy(pool_v)
+
+    def _compact_fn(self, pool_k, pool_v, tables, lengths, active,
+                    src_off):
+        """Post-acceptance K/V compaction for TREE speculative
+        decoding: the accepted root-to-leaf path's nodes sit at their
+        tree STORAGE offsets (``lengths + node_id``), which are not
+        contiguous when a side branch won — copy each accepted node's
+        rows down to the contiguous positions the advanced ``lengths``
+        will expose (``src_off[slot, j]`` = the storage offset whose
+        K/V belongs at offset ``j``; identity rows are no-op copies,
+        inactive slots divert to the null page). Functional gathers
+        read every source before any write lands, so overlapping
+        moves (always downward — node ids exceed their path index)
+        are safe."""
+        ps = self.page_size
+        n_slots, S = src_off.shape
+        mp = tables.shape[1]
+        rows = jnp.arange(n_slots)[:, None]
+
+        def locate(pos):
+            pidx = pos // ps
+            page = tables[rows, jnp.clip(pidx, 0, mp - 1)]
+            page = jnp.where((pidx < mp) & active[:, None], page,
+                             NULL_PAGE)
+            return page, pos % ps
+
+        dst_page, dst_off = locate(lengths[:, None] + jnp.arange(S))
+        src_page, src_sub = locate(lengths[:, None] + src_off)
+
+        def copy(pool):
+            def one(a):
+                moved = a[:, src_page, src_sub]
+                return a.at[:, dst_page, dst_off].set(moved)
+            return (tuple(one(x) for x in pool)
+                    if isinstance(pool, tuple) else one(pool))
+
+        return copy(pool_k), copy(pool_v)
 
     # ---- host lifecycle ------------------------------------------
     def can_admit(self, prompt_ids: np.ndarray) -> bool:
@@ -584,12 +805,21 @@ class PagedEngine:
                 - len(self.tables.match_pages(prompt))
                 <= self.tables.n_available_pages)
 
-    def admit_begin(self, prompt_ids: np.ndarray) -> int | None:
+    def admit_begin(self, prompt_ids: np.ndarray, seed: int | None = None,
+                    branch: int = 0) -> int | None:
         """Seat one request: map cached prefix pages into its block
         table, allocate private pages for the rest, and queue its
         chunked prefill. Returns the slot, or None when no slot or
         not enough pages (the batcher keeps it queued). The request
-        decodes only after :meth:`prefill_step` drains its chunks."""
+        decodes only after :meth:`prefill_step` drains its chunks.
+
+        ``seed``/``branch`` matter only in parallel-sampling mode:
+        the slot's sampling key becomes ``fold_in(PRNGKey(seed),
+        branch)`` — branch 0 for fresh requests, b for a preempted
+        fork branch re-seating on its own (its stream must resume
+        token-exact), and the contract the parity tests drive: branch
+        b of an n-way fork equals an independent run admitted with
+        the same seed and ``branch=b``."""
         prompt = np.ascontiguousarray(prompt_ids, np.int32).reshape(-1)
         s0 = len(prompt)
         slot = self.tables.free_slot()
@@ -629,6 +859,15 @@ class PagedEngine:
             return None
         self.prefix_lookup_pages += (s0 - 1) // self.page_size
         self.prefix_hit_pages += n_matched
+        if self.parallel:
+            # admission-cadence host jax (never per step): the base
+            # key identifies the REQUEST, the folded key its branch
+            base = np.asarray(jax.random.PRNGKey(
+                0 if seed is None else int(seed) & 0x7fffffff))
+            self._base_keys[slot] = base
+            self._slot_keys[slot] = np.asarray(
+                jax.random.fold_in(base, int(branch)))
+            self._branch_of[slot] = int(branch)
         if self._drafter is not None:
             # the prompt seeds the slot's lookup stream — prompt
             # tokens are exactly what prompt-lookup drafting mines
@@ -673,7 +912,13 @@ class PagedEngine:
         if not self._pending:
             return None
         p = self._pending[0]
-        self._rng, sub = jax.random.split(self._rng)
+        if self.parallel:
+            # the slot's BRANCH KEY rides the rng operand: the chunk
+            # folds it with s0, so the first token is a pure function
+            # of (branch key, prompt length) — never of traffic order
+            sub = jnp.asarray(self._slot_keys[p["slot"]])
+        else:
+            self._rng, sub = jax.random.split(self._rng)
         C = self.chunk_tokens
         ids = jnp.asarray(p["ids"][p["start"]:p["start"] + C])[None]
         table_row = jnp.asarray(self.tables.tables[p["slot"]])
@@ -681,16 +926,33 @@ class PagedEngine:
         # captured device trace (observability/spans.py); no-op when
         # telemetry is disabled
         with span("serving_prefill_chunk"):
-            tok, pool_k, pool_v = self._chunk_jit(
+            outs = self._chunk_jit(
                 self.params, self.pool["k"], self.pool["v"], ids,
                 jnp.asarray(p["start"], jnp.int32),
                 jnp.asarray(p["s0"], jnp.int32), table_row, sub)
+        if self.parallel:
+            tok, lp, logits, pool_k, pool_v = outs
+        else:
+            tok, pool_k, pool_v = outs
         self.pool = {"k": pool_k, "v": pool_v}
         self.prefill_chunks += 1
         p["start"] += C
         if p["start"] < p["s0"]:
             return None
         self._pending.pop(0)
+        if self.parallel:
+            # ONE batched device->host sync; the final-position
+            # logits are what fork() samples sibling branches' first
+            # tokens from. The stash is consumed at the fork (or by
+            # take_first_logprob for requests that never fork), so it
+            # lives one scheduling iteration — the one (vocab,)-row
+            # host copy per ADMISSION is the price of not threading a
+            # will-fork hint through the admission surface.
+            tok, lp, logits = jax.device_get((tok, lp, logits))
+            self._fork_state[p["slot"]] = {
+                "logits": np.asarray(logits[0]),
+                "logprob": float(np.asarray(lp)[0]),
+                "s0": int(p["s0"])}
         first = int(np.asarray(tok)[0])
         self.tables.activate(p["slot"], first)
         self.tables.register_prefix(p["slot"], p["ids"][:p["s0"]])
@@ -698,19 +960,113 @@ class PagedEngine:
             self._drafter.observe(p["slot"], [first])
         return p["slot"], first
 
-    def admit(self, prompt_ids: np.ndarray) -> tuple[int, int] | None:
+    def admit(self, prompt_ids: np.ndarray, seed: int | None = None,
+              branch: int = 0) -> tuple[int, int] | None:
         """One-shot admission (tests and simple drivers): seat the
         request and drain prefill chunks until ITS first token lands;
         returns ``(slot, first_token)`` or None. Drains any older
         pending prefills along the way (their slots activate with
         their first tokens recorded in the tables)."""
-        slot = self.admit_begin(prompt_ids)
+        slot = self.admit_begin(prompt_ids, seed=seed, branch=branch)
         if slot is None:
             return None
         while True:
             done = self.prefill_step()
             if done is not None and done[0] == slot:
                 return done
+
+    def fork(self, parent_slot: int, n_branches: int
+             ) -> list[tuple[int, int, float]]:
+        """Fork a just-prefilled slot into ``n_branches`` sampling
+        branches (the copy-on-write heart of OpenAI ``n``/
+        ``best_of``): every FULL page of the parent is SHARED into
+        each child's block table (one HBM read serves all branches
+        through the refs lanes), the partial tail page is copied once
+        per child by the fixed-shape ``_cow_fn`` executable, and each
+        branch gets its own PRNG key (``fold_in(base, b)``) plus its
+        own first token sampled from the SAME prompt-final logits the
+        parent's prefill produced — so the branches diverge from
+        token one exactly as n independent runs with those keys
+        would. Returns ``[(slot, first_token, first_logprob)]`` for
+        ALL branches, branch 0 (the parent, already activated by
+        ``prefill_step``) first.
+
+        Must be called at the prefill boundary (before the parent's
+        first decode step); raises RuntimeError when slots/pages run
+        out — the caller preempts and retries. Fork churn adds ZERO
+        decode/verify compiles (page sharing is table VALUES; the one
+        cow-copy executable compiles at the first fork only)."""
+        if not self.parallel:
+            raise RuntimeError(
+                "fork() needs PagedEngine(parallel_sampling=True)")
+        if n_branches < 2:
+            raise ValueError(
+                f"n_branches must be >= 2, got {n_branches}")
+        st = self._fork_state.get(parent_slot)
+        if st is None or int(self.tables.lengths[parent_slot]) \
+                != int(self.tables.prompt_len[parent_slot]):
+            raise RuntimeError(
+                f"slot {parent_slot} is not at its prefill boundary: "
+                "fork() must run before the parent's first decode "
+                "step (branches diverge from token one)")
+        if int(self._branch_of[parent_slot]) != 0:
+            raise RuntimeError(
+                f"slot {parent_slot} is itself branch "
+                f"{int(self._branch_of[parent_slot])}: only branch 0 "
+                "forks (re-forking a branch would alias keys)")
+        # PEEK above, pop only past the fallible part: a pool/slot
+        # exhaustion here must leave the stash intact so the batcher
+        # can preempt a victim and RETRY the fork
+        children = self.tables.fork(parent_slot, n_branches - 1)
+        self._fork_state.pop(parent_slot)
+        L = int(self.tables.lengths[parent_slot])
+        n_full = L // self.page_size
+        self.forks += 1
+        self.fork_pages += n_full * len(children)
+        # the CoW tail copy: one fixed-shape device call per fork,
+        # null->null self-copies padding the unused lanes
+        if L % self.page_size:
+            src = np.zeros(self.max_slots, np.int32)
+            dst = np.zeros(self.max_slots, np.int32)
+            parent_tail = int(self.tables.tables[parent_slot, n_full])
+            for i, child in enumerate(children):
+                src[i] = parent_tail
+                dst[i] = int(self.tables.tables[child, n_full])
+            with span("serving_fork_cow"):
+                pool_k, pool_v = self._cow_jit(
+                    self.pool["k"], self.pool["v"],
+                    jnp.asarray(src), jnp.asarray(dst))
+            self.pool = {"k": pool_k, "v": pool_v}
+            self.cow_copies += len(children)
+        # per-branch keys + first tokens off the stashed prompt-final
+        # logits (fork cadence, never per step): branch b's pick key
+        # is fold_in(fold_in(base, b), s0) — exactly what an
+        # independent run admitted with (seed, branch=b) would use
+        base = self._base_keys[parent_slot]
+        s0 = st["s0"]
+        logits = jnp.asarray(st["logits"])[None]
+        out = [(parent_slot, int(self.tables.last_ids[parent_slot]),
+                st["logprob"])]
+        for b, child in enumerate(children, start=1):
+            self._base_keys[child] = base
+            key = jax.random.fold_in(jnp.asarray(base), b)
+            self._slot_keys[child] = np.asarray(key)
+            self._branch_of[child] = b
+            pick_key = jax.random.fold_in(key, s0)
+            tok, lp = self._branch_pick(pick_key[None], logits)
+            tok = int(np.asarray(tok)[0])
+            self.tables.activate(child, tok)
+            out.append((child, tok, float(np.asarray(lp)[0])))
+        return out
+
+    def take_first_logprob(self, slot: int) -> float:
+        """Consume a just-prefilled slot's first-token logprob
+        (parallel mode): pops the whole fork stash, so a request that
+        will NOT fork (n = 1, or a re-admitted branch) frees its
+        stashed prompt logits the moment its first token is
+        accounted. Returns 0.0 when nothing is stashed."""
+        st = self._fork_state.pop(slot, None)
+        return 0.0 if st is None else st["logprob"]
 
     def grow_slots(self) -> list[int]:
         """Pre-allocate each active slot's upcoming write pages
@@ -752,14 +1108,25 @@ class PagedEngine:
         self._rng, sub = jax.random.split(self._rng)
         args = self.tables.device_args()
         extra = self._kernel_operands()
+        if self.parallel:
+            extra = extra + (jnp.asarray(self._slot_keys),)
         with span("decode_step"):
-            tokens, pool_k, pool_v = self._decode_jit(
+            outs = self._decode_jit(
                 self.params, self.pool["k"], self.pool["v"],
                 args["tables"], args["lengths"], args["refs"],
                 args["page_pos"], args["active"], args["last_ids"],
                 sub, *extra)
-            self.pool = {"k": pool_k, "v": pool_v}
-            tokens = np.asarray(tokens)
+            if self.parallel:
+                tokens, lps, pool_k, pool_v = outs
+                self.pool = {"k": pool_k, "v": pool_v}
+                # ONE batched device->host sync for both results
+                tokens, lps = jax.device_get((tokens, lps))
+                tokens = np.asarray(tokens)
+                self.step_logprobs = np.asarray(lps)
+            else:
+                tokens, pool_k, pool_v = outs
+                self.pool = {"k": pool_k, "v": pool_v}
+                tokens = np.asarray(tokens)
         for slot in np.flatnonzero(active):
             self.tables.advance(int(slot), int(tokens[slot]))
             if self._drafter is not None:
@@ -794,9 +1161,17 @@ class PagedEngine:
                     "retire sequences at the cache horizon")
         k = self.draft_len
         drafts = np.full((self.max_slots, k), -1, np.int32)
+        # chain parents by default (node j+1 off node j): slots with
+        # no tree draft — and the whole linear mode — verify exactly
+        # the PR-5 chain through the same operands
+        parents = np.tile(np.arange(k, dtype=np.int32),
+                          (self.max_slots, 1))
         for slot in np.flatnonzero(active):
             slot = int(slot)
-            d = self._drafter.draft(slot)
+            if self.spec_tree:
+                d, parents[slot] = self._drafter.draft_tree(slot)
+            else:
+                d = self._drafter.draft(slot)
             # horizon cap: drafted position j writes at lengths+1+j,
             # which must stay inside the slot's table — positions
             # past it are sentinelled out (the verify step ALSO
@@ -810,6 +1185,10 @@ class PagedEngine:
         self._rng, sub = jax.random.split(self._rng)
         args = self.tables.device_args()
         extra = self._kernel_operands()
+        if self.spec_tree:
+            depth, tvis = tree_masks(parents)
+            extra = (jnp.asarray(parents), jnp.asarray(depth),
+                     jnp.asarray(tvis)) + extra
         in_ids = jnp.concatenate(
             [args["last_ids"][:, None], jnp.asarray(drafts)], axis=1)
         with span("spec_verify_step"):
@@ -824,20 +1203,47 @@ class PagedEngine:
             accept, token = jax.device_get((accept, token))
         self.spec_steps += 1
         out: dict[int, list[int]] = {}
+        paths: dict[int, list[int]] = {}
         for slot in np.flatnonzero(active):
             slot = int(slot)
-            a = accept_count(accept[slot])
-            emitted = [int(t) for t in drafts[slot, :a]] \
-                + [int(token[slot, a])]
+            if self.spec_tree:
+                path = tree_accept_path(accept[slot], parents[slot])
+                a = len(path)
+                bonus_at = path[-1] if path else 0
+                emitted = [int(drafts[slot, p - 1]) for p in path] \
+                    + [int(token[slot, bonus_at])]
+                paths[slot] = path
+            else:
+                a = accept_count(accept[slot])
+                emitted = [int(t) for t in drafts[slot, :a]] \
+                    + [int(token[slot, a])]
             # a request retiring AT the horizon may accept its way
             # right up to seq_len — never past it
             room = int(self.cfg.seq_len - self.tables.lengths[slot])
             emitted = emitted[:room]
             self.spec_accepted += min(a, len(emitted))
+            out[slot] = emitted
+        if self.spec_tree:
+            # accepted-path K/V compaction BEFORE lengths advance: a
+            # side branch's accepted rows move down to the contiguous
+            # positions the new lengths will expose (identity rows —
+            # chain accepts, idle slots — are no-op copies through
+            # the same single executable)
+            src_off = np.tile(np.arange(k + 1, dtype=np.int32),
+                              (self.max_slots, 1))
+            for slot, path in paths.items():
+                for i, node in enumerate(path, start=1):
+                    src_off[slot, i] = node
+            with span("spec_tree_compact"):
+                pool_k, pool_v = self._compact_jit(
+                    self.pool["k"], self.pool["v"], args["tables"],
+                    args["lengths"], args["active"],
+                    jnp.asarray(src_off))
+            self.pool = {"k": pool_k, "v": pool_v}
+        for slot, emitted in out.items():
             for t in emitted:
                 self.tables.advance(slot, t)
             self._drafter.observe(slot, emitted)
-            out[slot] = emitted
         return out
 
     def retire(self, slot: int) -> None:
@@ -848,6 +1254,11 @@ class PagedEngine:
                          if p["slot"] != slot]
         if self._drafter is not None:
             self._drafter.reset(slot)
+        self._fork_state.pop(slot, None)
+        if self.parallel:
+            self._base_keys[slot] = 0
+            self._slot_keys[slot] = 0
+            self._branch_of[slot] = 0
         self.tables.retire(slot)
 
     def debug_stats(self) -> dict:
@@ -861,6 +1272,8 @@ class PagedEngine:
             "backend": self.decode_backend,
             "tp": self.tp,
             "speculative": self.speculative,
+            "spec_tree": self.spec_tree,
+            "parallel_sampling": self.parallel,
             "quantized": self.quantized,
             "page_size": self.page_size,
             "n_pages": self.n_pages,
@@ -877,10 +1290,24 @@ class PagedEngine:
             "spec_steps": self.spec_steps,
             "spec_proposed": self.spec_proposed,
             "spec_accepted": self.spec_accepted,
+            "forks": self.forks,
+            "fork_pages": self.fork_pages,
+            "cow_copies": self.cow_copies,
+            "branch_slots": self.branch_slot_count,
             "compiles": {"decode": self.decode_compiles,
                          "prefill": self.prefill_compiles,
                          "verify": self.verify_compiles},
         }
+
+    @property
+    def branch_slot_count(self) -> int:
+        """Active slots currently decoding as a fork branch b > 0 —
+        host integers only (the ``/debug/engine`` and flight-recorder
+        branch-count observable)."""
+        if not self.parallel:
+            return 0
+        return int(np.count_nonzero(
+            self.tables.active & (self._branch_of > 0)))
 
     def tp_step_traffic(self, s_q: int = 1) -> dict:
         """Modeled per-chip wire bytes of one decode (``s_q=1``) or
@@ -900,6 +1327,8 @@ class PagedEngine:
         never on the decode hot path."""
         args = self.tables.device_args()
         extra = self._kernel_operands()
+        if self.parallel:
+            extra = extra + (jnp.asarray(self._slot_keys),)
         lowered = self._decode_jit.lower(
             self.params, self.pool["k"], self.pool["v"],
             args["tables"], args["lengths"], args["refs"],

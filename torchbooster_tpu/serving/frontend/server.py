@@ -12,7 +12,12 @@ asyncio HTTP server exposing
   token, or per accepted speculative burst), request ids (a client
   ``X-Request-Id`` header is honored, echoed on the response, and
   becomes the id tracing files carry), usage accounting,
-  ``finish_reason`` stop/length;
+  ``finish_reason`` stop/length; ``n``/``best_of`` parallel sampling
+  on a ``parallel_sampling: true`` engine — one prefill forks into
+  copy-on-write branches, streamed chunks carry their branch's
+  ``index``, ``best_of > n`` returns the n best by sequence logprob
+  (unary only — the OpenAI rule), and ``usage`` aggregates every
+  decoded branch over the ONE prompt prefill;
 - ``GET /metrics`` — the telemetry registry's Prometheus exposition
   (the ``serving_*``/``serving_slo_*`` series, scrape-ready);
 - ``GET /healthz`` — liveness + pool occupancy;
@@ -263,14 +268,36 @@ class ServingFrontend:
                 # prefill token, then the same iteration's decode
                 # token): the finished flag rides only the LAST one,
                 # or a handler would close its stream with tokens
-                # still queued behind
-                last = {id(req): i for i, (req, _) in enumerate(events)}
+                # still queued behind. Fork-branch events route to
+                # the PARENT's stream (one HTTP exchange serves the
+                # whole n-way family), carrying their branch index;
+                # the stream closes only when EVERY branch is
+                # terminal.
+                def stream_of(req):
+                    s = self._streams.get(id(req))
+                    if s is None and req.parent is not None:
+                        s = self._streams.get(id(req.parent))
+                    return s
+
+                last = {}
+                for i, (req, _) in enumerate(events):
+                    s = stream_of(req)
+                    if s is not None:
+                        last[id(s)] = i
                 for i, (req, tokens) in enumerate(events):
-                    stream = self._streams.get(id(req))
-                    if stream is not None:
-                        done = (req.finished_at is not None
-                                and last[id(req)] == i)
-                        stream.queue.put_nowait((tokens, done))
+                    stream = stream_of(req)
+                    if stream is None:
+                        continue
+                    family = (req.parent.branches if req.parent
+                              else req.branches) or [req]
+                    done = (all(r.finished_at is not None
+                                for r in family)
+                            and last[id(stream)] == i)
+                    stream.queue.put_nowait(
+                        (req.branch, tokens,
+                         req.finish_reason
+                         if req.finished_at is not None else None,
+                         done))
         except Exception:
             self._stopping = True
             # the post-mortem FIRST: persist what the engine was doing
@@ -279,7 +306,7 @@ class ServingFrontend:
             for stream in list(self._streams.values()):
                 if stream.req.finished_at is None:
                     stream.req.finish_reason = "error"
-                stream.queue.put_nowait(([], True))
+                stream.queue.put_nowait((0, [], "error", True))
             raise
 
     def _crash_dump(self) -> None:
@@ -481,6 +508,8 @@ class ServingFrontend:
         ids = self._prompt_ids(payload, chat)
         max_tokens = payload.get("max_tokens", 16)
         deadline = payload.get("deadline_ms")
+        seed = payload.get("seed")
+        best_of = payload.get("best_of")
         try:
             req = Request(
                 prompt=ids,
@@ -491,6 +520,9 @@ class ServingFrontend:
                              else None),
                 arrival_time=time.time(),
                 request_id=request_id,
+                n=payload.get("n", 1),
+                best_of=best_of,
+                seed=seed,
             )
         except (TypeError, ValueError) as exc:
             raise HttpError(400, str(exc)) from None
@@ -531,6 +563,13 @@ class ServingFrontend:
                 "flight; wait for it to finish or pick a fresh id")
         req = self._build_request(payload, chat, rid_header)
         stream_mode = bool(payload.get("stream"))
+        if stream_mode and req.n_branches != req.n:
+            # the OpenAI rule: best_of > n cannot stream — ranking
+            # needs every branch's full logprob before choosing
+            # which n to return
+            raise HttpError(
+                400, f"best_of ({req.best_of}) > n ({req.n}) cannot "
+                "stream: ranking happens after all branches finish")
         # the OpenAI envelope id carries the REQUEST id (client-chosen
         # via X-Request-Id or auto-generated), so the response, the
         # /debug/trace query key, and the Perfetto track name all
@@ -575,15 +614,16 @@ class ServingFrontend:
                 self.batcher.policy.retry_after_s(self.batcher))})
 
     def _chunk(self, rid: str, created: int, tokens: list[int],
-               finish: str | None, chat: bool) -> dict:
+               finish: str | None, chat: bool,
+               index: int = 0) -> dict:
         text = self.codec.decode(tokens) if tokens else ""
         if chat:
             delta = {"content": text} if text else {}
-            choice = {"index": 0, "delta": delta,
+            choice = {"index": index, "delta": delta,
                       "finish_reason": finish}
             obj = "chat.completion.chunk"
         else:
-            choice = {"index": 0, "text": text,
+            choice = {"index": index, "text": text,
                       "token_ids": tokens, "finish_reason": finish}
             obj = "text_completion"
         return {"id": rid, "object": obj, "created": created,
@@ -593,7 +633,7 @@ class ServingFrontend:
                                created, chat) -> None:
         head_sent = False
         while True:
-            tokens, done = await stream.queue.get()
+            branch, tokens, finish, done = await stream.queue.get()
             if req.shed:
                 if head_sent:   # defensive: shed only ever targets
                     # never-started requests, but a malformed custom
@@ -605,7 +645,7 @@ class ServingFrontend:
                 raise self._shed_error()
             if req.cancelled:
                 return          # client is gone; nothing to write
-            if req.finish_reason == "error" and not head_sent:
+            if finish == "error" and not head_sent:
                 raise HttpError(500, "engine failure mid-request; "
                                 "see server logs")
             if not head_sent:
@@ -613,52 +653,79 @@ class ServingFrontend:
                     {"X-Request-Id": req.request_id}))
                 head_sent = True
             if tokens:
-                # one SSE event per decode step's delivery: a single
-                # token normally, the whole accepted burst in
-                # speculative mode
-                finish = req.finish_reason if done else None
+                # one SSE event per decode step's delivery per
+                # branch: a single token normally, the whole accepted
+                # burst in speculative mode; `index` is the branch —
+                # an n-way stream interleaves its choices' chunks
+                # exactly as OpenAI's dialect does
                 writer.write(sse_event(self._chunk(
-                    rid, created, tokens, finish, chat)))
+                    rid, created, tokens, finish, chat,
+                    index=branch)))
+                await writer.drain()
+            elif finish is not None:
+                # a branch finished without tokens on this event: the
+                # finishing chunk carries its finish_reason — "error"
+                # included (head already sent: the raise path above
+                # only covers pre-head failures, and a crash-truncated
+                # stream must not read as a clean completion)
+                writer.write(sse_event(self._chunk(
+                    rid, created, [], finish, chat, index=branch)))
                 await writer.drain()
             if done:
-                if not tokens:  # finished on an empty event
-                    writer.write(sse_event(self._chunk(
-                        rid, created, [], req.finish_reason, chat)))
                 writer.write(SSE_DONE)
                 await writer.drain()
                 return
 
     async def _unary_response(self, req, stream, writer, rid,
                               created, chat) -> None:
-        tokens: list[int] = []
         while True:
-            chunk, done = await stream.queue.get()
+            branch, chunk, finish, done = await stream.queue.get()
             if req.shed:
                 raise self._shed_error()
             if req.cancelled:
                 return
-            if req.finish_reason == "error":
+            if finish == "error" or req.finish_reason == "error":
                 raise HttpError(500, "engine failure mid-request; "
                                 "see server logs")
-            tokens.extend(chunk)
             if done:
                 break
-        text = self.codec.decode(tokens)
-        if chat:
-            choice = {"index": 0, "message":
-                      {"role": "assistant", "content": text},
-                      "finish_reason": req.finish_reason}
-            obj = "chat.completion"
-        else:
-            choice = {"index": 0, "text": text, "token_ids": tokens,
-                      "finish_reason": req.finish_reason}
-            obj = "text_completion"
+        # every branch is terminal: rank and build the choice list.
+        # best_of > n returns the n best branches by cumulative
+        # logprob (sequence log-probability under the distribution
+        # each token was sampled from), re-indexed 0..n-1; n == 1
+        # single-stream requests collapse to the old single choice.
+        family = req.branches or [req]
+        if req.n_branches > req.n:
+            family = sorted(family, key=lambda r: -r.cum_logprob)
+            family = family[:req.n]
+        choices = []
+        completion_tokens = 0
+        for r in (req.branches or [req]):
+            completion_tokens += len(r.tokens)
+        for i, r in enumerate(family):
+            text = self.codec.decode(r.tokens)
+            if chat:
+                choices.append(
+                    {"index": i, "message":
+                     {"role": "assistant", "content": text},
+                     "finish_reason": r.finish_reason})
+            else:
+                choices.append(
+                    {"index": i, "text": text,
+                     "token_ids": list(r.tokens),
+                     "finish_reason": r.finish_reason})
+        obj = "chat.completion" if chat else "text_completion"
+        # aggregated usage: the prompt was prefilled ONCE (that is
+        # the fork's whole point) but every decoded branch's tokens
+        # are real work and bill as completion tokens — the OpenAI
+        # best_of convention
         writer.write(json_response(200, {
             "id": rid, "object": obj, "created": created,
-            "model": self.model_name, "choices": [choice],
+            "model": self.model_name, "choices": choices,
             "usage": {"prompt_tokens": req.base_len,
-                      "completion_tokens": len(tokens),
-                      "total_tokens": req.base_len + len(tokens)}},
+                      "completion_tokens": completion_tokens,
+                      "total_tokens": req.base_len
+                      + completion_tokens}},
             {"X-Request-Id": req.request_id}))
 
 

@@ -65,6 +65,16 @@ from torchbooster_tpu.models.gpt import GPTConfig
 NULL_PAGE = 0
 
 
+class PoolExhausted(RuntimeError):
+    """Raised when an allocation cannot be satisfied even after
+    evicting cached prefixes (or when no free slot exists to fork
+    into). A ``RuntimeError`` subclass so every existing
+    ``except RuntimeError`` capacity handler keeps working — but
+    callers that must distinguish genuine capacity pressure from a
+    contract violation (the batcher's fork preempt-and-retry loop)
+    catch THIS type and let anything else surface immediately."""
+
+
 def make_pool(cfg: GPTConfig, page_size: int, n_pages: int,
               cache_dtype: Any = None,
               compute_dtype: Any = jnp.bfloat16) -> dict:
@@ -127,10 +137,18 @@ class BlockTables:
     alloc/free: nothing is matched or registered, every refcount is 0
     or 1, and retire frees every page — the cold control the parity
     suite measures the cache against.
+
+    ``parallel=True`` keeps the multi-lane ``refs`` table even without
+    the prefix cache: :meth:`fork` maps one slot's FULL pages into n
+    sibling slots' tables (copy-on-write parallel sampling — OpenAI
+    ``n``/``best_of``), so a page needs a lane per potential sharer
+    exactly as prefix sharing does. Off (the default), fork raises and
+    the lane axis collapses to 1 as before.
     """
 
     def __init__(self, cfg: GPTConfig, page_size: int, n_pages: int,
-                 max_slots: int, prefix_cache: bool = False):
+                 max_slots: int, prefix_cache: bool = False,
+                 parallel: bool = False):
         if page_size < 1 or n_pages < 2 or max_slots < 1:
             raise ValueError(
                 f"need page_size >= 1, n_pages >= 2 (page 0 is the "
@@ -143,6 +161,7 @@ class BlockTables:
         self.max_pages_per_slot = -(-cfg.seq_len // page_size)
         self.seq_len = cfg.seq_len
         self.prefix_cache = bool(prefix_cache)
+        self.parallel = bool(parallel)
         self.tables = np.full((max_slots, self.max_pages_per_slot),
                               NULL_PAGE, np.int32)
         self.lengths = np.zeros(max_slots, np.int32)
@@ -156,12 +175,14 @@ class BlockTables:
         self.cow_len = np.zeros(max_slots, np.int32)
         self.prompt_len = np.zeros(max_slots, np.int32)
         self.refcount = np.zeros(n_pages, np.int32)
-        # reference lanes: with the prefix cache every slot may share
-        # one page, so a page needs max_slots lanes; without it no
-        # page ever has more than one holder and the lane axis
-        # collapses to 1 — the cold engine's decode sweep then pays
-        # ZERO extra query-side compute for the sharing machinery
-        self.n_ref_lanes = max_slots if self.prefix_cache else 1
+        # reference lanes: with the prefix cache (or CoW fork-sharing)
+        # every slot may share one page, so a page needs max_slots
+        # lanes; without either no page ever has more than one holder
+        # and the lane axis collapses to 1 — the cold engine's decode
+        # sweep then pays ZERO extra query-side compute for the
+        # sharing machinery
+        share = self.prefix_cache or self.parallel
+        self.n_ref_lanes = max_slots if share else 1
         self.refs = np.full((n_pages, self.n_ref_lanes), -1, np.int32)
         self.page_pos = np.zeros(n_pages, np.int32)
         self.active = np.zeros(max_slots, bool)
@@ -198,6 +219,13 @@ class BlockTables:
         """Lowest unseated slot id, or None when all are occupied."""
         idle = np.flatnonzero(~self.active & (self.lengths == 0))
         return int(idle[0]) if idle.size else None
+
+    def n_free_slots(self) -> int:
+        """How many slots :meth:`free_slot` could hand out — the ONE
+        definition of 'unseated' (inactive AND empty), so the
+        batcher's reservation-aware admission gate and the seating
+        code can never disagree on what counts as free."""
+        return int(np.count_nonzero(~self.active & (self.lengths == 0)))
 
     def pages_for(self, n_tokens: int) -> int:
         return -(-n_tokens // self.page_size)
@@ -291,6 +319,87 @@ class BlockTables:
         self.active[slot] = True
         self.last_ids[slot] = first_id
 
+    def fork(self, parent_slot: int, n_children: int) -> list[int]:
+        """Fork ``parent_slot`` into ``n_children`` sibling slots for
+        copy-on-write parallel sampling (OpenAI ``n``/``best_of``):
+        every FULL page of the parent maps shared into each child's
+        table (refcount++, a refs lane per sharer — one pool read
+        serves all branches, the same contract prefix sharing rides),
+        and only the partial TAIL page allocates a private per-child
+        page, because the tail is where both the parent's and every
+        child's next writes land. The DEVICE copy of the tail page's
+        K/V is the engine's job (``PagedEngine.fork`` issues one
+        fixed-shape copy) — this method is pure host bookkeeping.
+
+        Both the parent's and the children's copy-on-write floors rise
+        to the shared-page boundary: pages the parent held privately
+        become shared at fork, so no branch — the parent included —
+        may ever rewind a write cursor back into them (``rewind``
+        enforces it, ``check()`` asserts it).
+
+        Children come back INACTIVE with the parent's length: the
+        caller samples each branch's own first token and
+        :meth:`activate`\\ s them (the fork happens at the prefill
+        boundary, where the branches diverge from token one). On pool
+        exhaustion every partially-forked child is rolled back and the
+        ``RuntimeError`` propagates — the caller preempts or retries.
+        """
+        if not self.parallel:
+            raise RuntimeError(
+                "fork() needs BlockTables(parallel=True): without the "
+                "multi-lane refs table a page cannot carry a second "
+                "holder")
+        if n_children < 1:
+            raise ValueError(
+                f"n_children must be >= 1, got {n_children}")
+        if not self.active[parent_slot] or not self.lengths[parent_slot]:
+            raise ValueError(
+                f"slot {parent_slot} is not active — fork at the "
+                "prefill boundary, after activate()")
+        L = int(self.lengths[parent_slot])
+        n_full = L // self.page_size
+        n_live = self.pages_for(L)
+        parent_row = self.tables[parent_slot]
+        children: list[int] = []
+        try:
+            for _ in range(n_children):
+                slot = self.free_slot()
+                if slot is None:
+                    raise PoolExhausted(
+                        f"no free slot to fork into ({self.max_slots} "
+                        "slots all seated)")
+                mapped = 0
+                try:
+                    for i in range(n_full):
+                        self._ref(slot, i, int(parent_row[i]))
+                        mapped += 1
+                    if n_live > n_full:
+                        # the partial tail: a PRIVATE page per child —
+                        # the write cursor of every branch sits in it
+                        self._alloc(slot, np.asarray([n_full]))
+                except PoolExhausted:
+                    # this child's partial share map must unwind by
+                    # hand: its lengths was never set, so retire()
+                    # would see an empty slot and leak the refs
+                    for i in reversed(range(mapped)):
+                        self._unref(slot, int(self.tables[slot, i]))
+                    self.tables[slot, :mapped] = NULL_PAGE
+                    raise
+                self.lengths[slot] = L
+                self.prompt_len[slot] = self.prompt_len[parent_slot]
+                self.cow_len[slot] = n_full * self.page_size
+                self.last_ids[slot] = 0
+                children.append(slot)
+        except PoolExhausted:
+            for slot in children:
+                self.retire(slot)
+            raise
+        # the parent's previously-private full pages are shared now:
+        # its own CoW floor rises with them (never falls)
+        self.cow_len[parent_slot] = max(
+            int(self.cow_len[parent_slot]), n_full * self.page_size)
+        return children
+
     def register_prefix(self, slot: int, prompt: np.ndarray) -> int:
         """Publish the slot's FULL prompt pages into the prefix index
         (call once prefill has written them — their content is final:
@@ -377,7 +486,12 @@ class BlockTables:
         stream and cannot restore it themselves)."""
         if not self.lengths[slot]:
             raise ValueError(f"slot {slot} is not seated")
-        floor = int(self.prompt_len[slot])
+        # at seat time cow_len < prompt_len by the match cap, but a
+        # FORK raises cow_len to the shared-page boundary — which for
+        # a branch that has decoded past a page boundary sits ABOVE
+        # its prompt, so both floors must hold
+        floor = max(int(self.prompt_len[slot]),
+                    int(self.cow_len[slot]))
         if not floor <= new_length <= int(self.lengths[slot]):
             raise ValueError(
                 f"rewind target {new_length} outside "
@@ -465,7 +579,7 @@ class BlockTables:
             # raise BEFORE evicting: a doomed allocation must not
             # drain unrelated cached prefixes (dropping their index
             # entries for nothing) on its way to failing anyway
-            raise RuntimeError(
+            raise PoolExhausted(
                 f"KV page pool exhausted: need {len(table_idx)} pages, "
                 f"{len(self._free)} free + {len(self._lru)} evictable "
                 f"(n_pages={self.n_pages}, page_size={self.page_size})"
@@ -565,6 +679,24 @@ class BlockTables:
                 want[p] += 1
                 assert self.page_pos[p] == idx, (slot, idx, p)
                 assert slot in set(self.refs[p].tolist()), (slot, p)
+                if self.refcount[p] > 1:
+                    # shared pages (prefix hits and fork sharing) must
+                    # sit entirely BELOW every holder's write floor —
+                    # max(cow_len, prompt_len), the same floor rewind
+                    # enforces — so the write cursor (== lengths,
+                    # never below that floor) can never touch one: a
+                    # CoW tail page is never shared. Prefix-shared
+                    # full PROMPT pages are covered by prompt_len (a
+                    # registering slot's cow_len stays at its matched
+                    # boundary); fork-shared pages past the prompt by
+                    # the raised cow_len.
+                    assert (idx + 1) * self.page_size <= max(
+                        int(self.cow_len[slot]),
+                        int(self.prompt_len[slot])), (
+                        f"page {p} shared at slot {slot} index {idx} "
+                        f"above the write floor (cow_len="
+                        f"{int(self.cow_len[slot])}, prompt_len="
+                        f"{int(self.prompt_len[slot])})")
                 if idx >= n_live:
                     # draft-ahead pages past a rewound length: PRIVATE
                     # (a shared page past the live range would serve
@@ -610,4 +742,4 @@ class BlockTables:
             assert p in self._page_key and self.refcount[p] == 0
 
 
-__all__ = ["BlockTables", "NULL_PAGE", "make_pool"]
+__all__ = ["BlockTables", "NULL_PAGE", "PoolExhausted", "make_pool"]

@@ -136,7 +136,8 @@ def replay_inprocess(batcher: ContinuousBatcher, workload: Workload,
                     max_new_tokens=rec.max_new_tokens,
                     eos_id=rec.eos_id, priority=rec.priority,
                     deadline_ms=rec.deadline_ms,
-                    request_id=rec.request_id)
+                    request_id=rec.request_id,
+                    n=rec.n, best_of=rec.best_of)
             for rec in workload.requests]
     arrivals = [rec.arrival_s / speed for rec in workload.requests]
     cancels = [(req, rec.cancel_after_tokens)
@@ -265,6 +266,11 @@ async def replay_http(port: int, workload: Workload,
                 payload["deadline_ms"] = rec.deadline_ms
             if rec.eos_id is not None:
                 payload["eos_id"] = rec.eos_id
+            if rec.n > 1:
+                # streaming replays n = best_of fan-out (the dialect
+                # forbids streaming a best_of > n ranking)
+                payload["n"] = payload["best_of"] = (
+                    rec.best_of if rec.best_of is not None else rec.n)
             body = json.dumps(payload).encode()
             writer.write(
                 b"POST /v1/completions HTTP/1.1\r\nHost: loadgen\r\n"

@@ -12,15 +12,16 @@ stress — and let synthetic generators emit the SAME format so one
 driver (loadgen/replay.py) serves both.
 
 One JSONL file per workload: a header line
-(``{"event": "workload_header", "version": 1, ...}``) then one
+(``{"event": "workload_header", "version": 2, ...}``) then one
 ``workload_request`` line per request — arrival offset (seconds from
 trace start), prompt token ids OR a ``seed``+``length`` recipe
 (privacy-scrubbed captures never persist prompt content), priority
-class, ``deadline_ms``, ``max_new_tokens``, ``eos_id``, and the
-client-behavior events: ``cancel_after_tokens`` (the client
-disconnected after consuming N tokens — replay re-issues the
-disconnect at the same token offset) and ``disconnect_s`` (the
-recorded wall offset, informational).
+class, ``deadline_ms``, ``max_new_tokens``, ``eos_id``, optional
+parallel-sampling ``n``/``best_of`` (v2; absent fields mean ``n=1``
+and v1 files still load), and the client-behavior events:
+``cancel_after_tokens`` (the client disconnected after consuming N
+tokens — replay re-issues the disconnect at the same token offset)
+and ``disconnect_s`` (the recorded wall offset, informational).
 
 The **fingerprint** is a content hash over the canonical request
 tuples (arrivals, prompts/recipes, priorities, deadlines, output
@@ -69,7 +70,11 @@ import numpy as np
 __all__ = ["Workload", "WorkloadCapture", "WorkloadRequest",
            "SYNTHETIC_KINDS", "synthesize"]
 
-FORMAT_VERSION = 1
+# v2 (PR 13): optional per-request ``n``/``best_of`` parallel-sampling
+# fields — v1 files still load (absent fields mean n = 1), new saves
+# stamp v2 and the content fingerprint covers the new fields
+FORMAT_VERSION = 2
+SUPPORTED_VERSIONS = (1, 2)
 
 SYNTHETIC_KINDS = ("poisson", "bursty", "diurnal", "sharegpt")
 
@@ -94,6 +99,11 @@ class WorkloadRequest:
     request_id: str = ""
     cancel_after_tokens: int | None = None
     disconnect_s: float | None = None
+    # parallel sampling (OpenAI n/best_of; needs a
+    # serving.parallel_sampling engine on replay): n completions
+    # returned, best_of (None = n) branches decoded and ranked
+    n: int = 1
+    best_of: int | None = None
 
     def __post_init__(self):
         if self.prompt is not None:
@@ -122,6 +132,15 @@ class WorkloadRequest:
                 f"cancel_after_tokens must be >= 1 (a never-served "
                 f"client is a queue cancel, not a token offset), got "
                 f"{self.cancel_after_tokens}")
+        if not isinstance(self.n, int) or self.n < 1:
+            raise ValueError(
+                f"n must be an int >= 1, got {self.n!r}")
+        if self.best_of is not None and (
+                not isinstance(self.best_of, int)
+                or self.best_of < self.n):
+            raise ValueError(
+                f"best_of must be an int >= n ({self.n}), got "
+                f"{self.best_of!r}")
 
     def prompt_ids(self, vocab: int) -> np.ndarray:
         """The prompt to serve: recorded ids, or the scrub recipe's
@@ -139,9 +158,16 @@ class WorkloadRequest:
                   if self.prompt is not None
                   else ["seed", int(self.prompt_seed),
                         int(self.prompt_len)])
-        return [round(float(self.arrival_s), 6), prompt, self.priority,
-                self.deadline_ms, int(self.max_new_tokens), self.eos_id,
-                self.cancel_after_tokens]
+        key = [round(float(self.arrival_s), 6), prompt, self.priority,
+               self.deadline_ms, int(self.max_new_tokens), self.eos_id,
+               self.cancel_after_tokens]
+        if self.n > 1 or self.best_of is not None:
+            # appended only when set so plain-traffic fingerprints
+            # stay v1-identical (a v1 capture's recorded fingerprint
+            # must keep verifying) while any n/best_of fan-out is
+            # provably covered by the hash
+            key.append([int(self.n), self.best_of])
+        return key
 
     def to_json(self) -> dict:
         return {
@@ -159,6 +185,8 @@ class WorkloadRequest:
             "cancel_after_tokens": self.cancel_after_tokens,
             "disconnect_s": (round(float(self.disconnect_s), 6)
                              if self.disconnect_s is not None else None),
+            "n": int(self.n),
+            "best_of": self.best_of,
         }
 
     @classmethod
@@ -175,7 +203,11 @@ class WorkloadRequest:
             eos_id=d.get("eos_id"),
             request_id=d.get("request_id", ""),
             cancel_after_tokens=d.get("cancel_after_tokens"),
-            disconnect_s=d.get("disconnect_s"))
+            disconnect_s=d.get("disconnect_s"),
+            # v1 files carry neither field: n = 1 (the loader's
+            # __post_init__ rejects malformed values loudly)
+            n=d.get("n", 1),
+            best_of=d.get("best_of"))
 
 
 @dataclass
@@ -244,11 +276,11 @@ class Workload:
                 continue
             d = json.loads(raw)
             if d.get("event") == "workload_header":
-                if d.get("version") != FORMAT_VERSION:
+                if d.get("version") not in SUPPORTED_VERSIONS:
                     raise ValueError(
                         f"{path}: workload format version "
-                        f"{d.get('version')!r} != supported "
-                        f"{FORMAT_VERSION}")
+                        f"{d.get('version')!r} not in supported "
+                        f"{SUPPORTED_VERSIONS}")
                 header = d
             elif d.get("event") == "workload_request":
                 requests.append(WorkloadRequest.from_json(d))
@@ -383,7 +415,8 @@ class WorkloadCapture:
                 cancel_after_tokens=cancel,
                 disconnect_s=(max(r.finished_at - t0, 0.0)
                               if r.cancelled
-                              and r.finished_at is not None else None)))
+                              and r.finished_at is not None else None),
+                n=r.n, best_of=r.best_of))
         return Workload(
             requests=out, kind="capture", vocab=vocab or max_id,
             meta={"captured_at": round(self._captured_at, 3),
@@ -427,7 +460,8 @@ def synthesize(kind: str = "poisson", *, n_requests: int = 32,
                max_new_tokens: tuple = (8, 32), classes: str = "",
                cancel_frac: float = 0.0, burst_on_s: float = 1.0,
                burst_off_s: float = 2.0, burst_mult: float = 4.0,
-               period_s: float = 60.0) -> Workload:
+               period_s: float = 60.0, n_frac: float = 0.0,
+               n_max: int = 4) -> Workload:
     """Synthetic workloads in the capture format, deterministic from
     ``seed`` — so a synthetic A/B carries a fingerprint exactly like a
     captured one and flows through the same replay driver.
@@ -440,7 +474,9 @@ def synthesize(kind: str = "poisson", *, n_requests: int = 32,
     prompt/output lengths clipped to the given ranges). ``classes``
     is a ``"name:weight,..."`` priority mix; ``cancel_frac`` of
     requests get a recorded client disconnect at a random delivered-
-    token offset."""
+    token offset; ``n_frac`` of requests carry parallel-sampling
+    fan-out (``n = best_of`` drawn uniformly in ``[2, n_max]`` —
+    replay them against a ``parallel_sampling: true`` engine)."""
     if kind not in SYNTHETIC_KINDS:
         raise ValueError(
             f"unknown synthetic workload kind {kind!r}: expected one "
@@ -453,6 +489,12 @@ def synthesize(kind: str = "poisson", *, n_requests: int = 32,
     if not 0.0 <= cancel_frac <= 1.0:
         raise ValueError(
             f"cancel_frac must be in [0, 1], got {cancel_frac}")
+    if not 0.0 <= n_frac <= 1.0:
+        raise ValueError(f"n_frac must be in [0, 1], got {n_frac}")
+    if n_max < 2:
+        raise ValueError(
+            f"n_max must be >= 2 (n_frac requests fan out), got "
+            f"{n_max}")
     p_lo, p_hi = int(prompt_len[0]), int(prompt_len[1])
     o_lo, o_hi = int(max_new_tokens[0]), int(max_new_tokens[1])
     if not 1 <= p_lo <= p_hi or not 1 <= o_lo <= o_hi:
@@ -498,19 +540,28 @@ def synthesize(kind: str = "poisson", *, n_requests: int = 32,
 
     cls_idx = rs.choice(len(names), n_requests, p=weights)
     cancels = rs.random_sample(n_requests) < cancel_frac
+    # fan-out draws come from their OWN seed-derived stream: drawing
+    # them from `rs` would shift every later prompt/cancel draw, so a
+    # given seed's pre-v2 traffic (and an n_frac=0 arm vs an n_frac>0
+    # arm's BASE traffic) would silently stop reproducing
+    rs_fan = np.random.RandomState((seed ^ 0x5EED5EED) & 0xFFFFFFFF)
+    fanout = rs_fan.random_sample(n_requests) < n_frac
+    fan_n = rs_fan.randint(2, n_max + 1, n_requests)
     requests = []
     for i in range(n_requests):
         out_budget = int(olens[i])
         cancel = None
         if cancels[i]:
             cancel = int(rs.randint(1, out_budget + 1))
+        n_i = int(fan_n[i]) if fanout[i] else 1
         requests.append(WorkloadRequest(
             arrival_s=float(arrivals[i]),
             max_new_tokens=out_budget,
             prompt=rs.randint(0, vocab, int(plens[i]), dtype=np.int32),
             priority=names[int(cls_idx[i])],
             request_id=f"w{seed}-{i:05d}",
-            cancel_after_tokens=cancel))
+            cancel_after_tokens=cancel,
+            n=n_i))
     return Workload(requests=requests, kind=f"synthetic:{kind}",
                     vocab=vocab, meta={"seed": int(seed),
                                        "rate": float(rate)})

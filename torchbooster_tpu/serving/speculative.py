@@ -160,6 +160,142 @@ def accept_count(accept_row: np.ndarray) -> int:
     return int(rej[0]) if rej.size else len(accept_row)
 
 
+class TreeLookupDrafter(PromptLookupDrafter):
+    """Prompt-lookup drafting over a TREE of candidate branches
+    (SpecInfer/Sequoia-shaped): where the linear drafter commits the
+    whole ``draft_len`` budget to the single most-recent match's
+    continuation, this one groups the history's matches by their
+    FIRST continuation token — when the stream is genuinely ambiguous
+    (the same suffix n-gram has been followed by different tokens),
+    up to ``width`` distinct continuations each get a branch off the
+    root, and ONE fused verify pass scores them all (the accepted
+    root-to-leaf path replaces the accepted prefix). When history
+    shows exactly one continuation the tree degenerates to the linear
+    drafter's chain BIT-FOR-BIT (same n-gram, same match, same
+    continuation), so tree drafting never proposes worse than linear
+    on unambiguous streams and strictly more on ambiguous ones.
+
+    ``draft_tree(slot)`` returns ``(tokens, parents)``: ``tokens``
+    the ``(draft_len,)`` NO_DRAFT-padded node tokens and ``parents``
+    the ``(draft_len,)`` parent NODE indices — draft node ``j``
+    (0-based over the draft row; verify input ``j + 1``) hangs off
+    node ``parents[j] ∈ [0, j]``, node 0 being the root/pending
+    token. Branches split only at the root and siblings carry
+    DISTINCT first tokens (group keys), so at most one child of any
+    node can ever be accepted — the accepted path is unique. The
+    budget splits primary-heavy: side branches get
+    ``max(1, draft_len // (2 * width))`` nodes each, the primary
+    (most recent) branch the rest, so the common single-continuation
+    regime keeps nearly the full linear depth."""
+
+    def __init__(self, draft_len: int, ngram_min: int = 2,
+                 ngram_max: int = 8, lookback: int = 4096,
+                 width: int = 2):
+        super().__init__(draft_len, ngram_min=ngram_min,
+                         ngram_max=ngram_max, lookback=lookback)
+        if not 2 <= width <= draft_len:
+            raise ValueError(
+                f"tree width must satisfy 2 <= width <= draft_len "
+                f"({draft_len}), got {width}: one branch is the "
+                "linear drafter, and every branch needs a node")
+        self.width = width
+
+    def draft_tree(self, slot: int) -> tuple[np.ndarray, np.ndarray]:
+        k = self.draft_len
+        tokens = np.full(k, NO_DRAFT, np.int32)
+        # chain parents by default: node j + 1 hangs off node j — a
+        # sentinel-only row still carries a valid topology
+        parents = np.arange(k, dtype=np.int32)
+        stream = self._streams.get(slot)
+        if not stream or len(stream) < self.ngram_min + 1:
+            return tokens, parents
+        h = np.asarray(stream[-self.lookback:], np.int32)
+        hi = min(self.ngram_max, len(h) - 1)
+        for n in range(hi, self.ngram_min - 1, -1):
+            m = len(h) - n
+            if m <= 0:
+                continue
+            win = np.lib.stride_tricks.sliding_window_view(h, n)[:m]
+            hits = np.flatnonzero((win == h[-n:]).all(axis=1))
+            if not hits.size:
+                continue
+            # group matches by first continuation token, most recent
+            # occurrence first — the group ORDER is the branch order
+            # (primary = the linear drafter's own choice)
+            groups: dict[int, int] = {}
+            for s_i in hits[::-1]:
+                c0 = int(h[int(s_i) + n])
+                if c0 not in groups:
+                    groups[c0] = int(s_i)
+                if len(groups) == self.width:
+                    break
+            w = len(groups)
+            side = max(1, k // (2 * w)) if w > 1 else 0
+            node = 1
+            for b, (_, s_i) in enumerate(groups.items()):
+                depth = (k - side * (w - 1)) if b == 0 else side
+                cont = h[s_i + n:s_i + n + depth]
+                parent = 0
+                for t in cont:
+                    tokens[node - 1] = int(t)
+                    parents[node - 1] = parent
+                    parent = node
+                    node += 1
+            return tokens, parents
+        return tokens, parents
+
+
+def tree_masks(parents: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """Host-side tree bookkeeping for the verify step: from per-slot
+    parent vectors ``(n_slots, k)`` (draft node ``j`` hangs off node
+    ``parents[:, j] ∈ [0, j]``), build ``depth (n_slots, S)`` — each
+    node's distance from the root, its ROPE/embedding position offset
+    — and ``vis (n_slots, S, S)`` — the ancestor-or-self matrix the
+    visibility masks gather (``vis[s, j, i]``: node i's K/V is
+    visible to node j's query). ``S = k + 1``, node 0 the root. The
+    chain ``parents[:, j] = j`` yields ``depth = arange`` and
+    ``vis[j, i] = i <= j`` — the linear masks bit-for-bit."""
+    parents = np.asarray(parents, np.int32)
+    n_slots, k = parents.shape
+    S = k + 1
+    depth = np.zeros((n_slots, S), np.int32)
+    vis = np.zeros((n_slots, S, S), bool)
+    vis[:, 0, 0] = True
+    rows = np.arange(n_slots)
+    for j in range(1, S):
+        p = parents[:, j - 1]
+        depth[:, j] = depth[rows, p] + 1
+        vis[:, j] = vis[rows, p]
+        vis[rows, j, j] = True
+    return depth, vis
+
+
+def tree_accept_path(accept_row: np.ndarray,
+                     parents_row: np.ndarray) -> list[int]:
+    """The best (unique) accepted root-to-leaf path of one slot's
+    tree verify result, as node indices in root-to-leaf order
+    (empty = nothing accepted; the bonus pick then comes from the
+    root). ``accept_row[j]`` says draft node ``j + 1``'s token
+    matched the model's pick at its parent; siblings carry distinct
+    tokens by drafter construction, so at most one child of any node
+    accepts and the walk is deterministic — on the chain topology
+    this reduces to :func:`accept_count` exactly."""
+    accept_row = np.asarray(accept_row, bool)
+    parents_row = np.asarray(parents_row, np.int64)
+    path: list[int] = []
+    cur = 0
+    while True:
+        nxt = None
+        for j in range(len(parents_row)):
+            if parents_row[j] == cur and accept_row[j]:
+                nxt = j + 1
+                break
+        if nxt is None:
+            return path
+        path.append(nxt)
+        cur = nxt
+
+
 def make_verify_fn(engine):
     """Build the engine's ONE compiled multi-token verify step.
 
@@ -186,11 +322,26 @@ def make_verify_fn(engine):
     part included, which is exactly what a sequence of non-speculative
     steps would have read (greedy parity is therefore exact, int8
     pages included). The per-position pick/accept rule is
-    ``_make_spec_pick`` (models/gpt.py) over the final logits."""
+    ``_make_spec_pick`` (models/gpt.py) over the final logits.
+
+    With ``engine.spec_tree`` the SAME executable verifies a TREE of
+    candidate branches: three extra traced operands — per-slot parent
+    vectors ``(B, k)``, node depths ``(B, S)``, and the
+    ancestor-or-self matrix ``(B, S, S)`` (``tree_masks``) — replace
+    the chain's implicit ``arange`` structure. Node j still WRITES at
+    storage position ``lengths + j`` (its private row), but ropes/
+    embeds at its tree DEPTH and attends prior context plus its
+    ancestors only; acceptance tests each node's token against the
+    model's pick at its PARENT. All three are VALUES (the chain is
+    ``parents = arange``), so adaptive per-step tree shapes recompile
+    nothing. The accepted root-to-leaf path is compacted into
+    contiguous positions by ``PagedEngine._compact_fn`` afterwards.
+    """
     cfg, ps = engine.cfg, engine.page_size
     k = engine.draft_len
     S = k + 1
     head_dim = cfg.d_model // cfg.n_heads
+    tree = bool(getattr(engine, "spec_tree", False))
     # per-shard head count under tensor-parallel serving
     # (serving/tp.py): == cfg.n_heads at tp=1, so the single-chip
     # trace is unchanged
@@ -199,16 +350,30 @@ def make_verify_fn(engine):
                                 engine.top_p, jnp.int32)
 
     def verify_fn(params, pool_k, pool_v, tables, lengths, refs,
-                  page_pos, active, in_ids, rng,
-                  work_pages=None, work_refs=None, work_pos=None):
+                  page_pos, active, in_ids, rng, *extra):
+        # None-init every mode operand (the _decode_fn convention):
+        # the closures below reference them by name, and a use that
+        # ever escaped its mode guard must fail as a loud None error,
+        # not a NameError-at-trace trap for the next refactor
+        t_parent = t_depth = t_vis = None
+        work_pages = work_refs = work_pos = None
+        if tree:
+            t_parent, t_depth, t_vis = extra[:3]
+            extra = extra[3:]
+        if engine.decode_backend == "pallas":
+            work_pages, work_refs, work_pos = extra
         n_slots = in_ids.shape[0]
         mp = tables.shape[1]
+        # STORAGE positions (write targets): node j owns row
+        # ``lengths + j`` whatever the topology; SEMANTIC positions
+        # (rope/embedding): its tree depth — equal on the chain
         positions = lengths[:, None] + jnp.arange(S)     # (B, S)
+        sem_pos = (lengths[:, None] + t_depth) if tree else positions
         # clipped twins for table lookups: sentinel ids embed as 0 and
         # horizon-overflow positions rope/embed at the last row — both
         # produce garbage that acceptance (host) and the null-page
         # write diversion below keep out of every live value
-        pos_c = jnp.minimum(positions, cfg.seq_len - 1)
+        pos_c = jnp.minimum(sem_pos, cfg.seq_len - 1)
         ids_c = jnp.clip(in_ids, 0, cfg.vocab - 1)
 
         x = L.embedding(params["wte"], ids_c,
@@ -246,15 +411,34 @@ def make_verify_fn(engine):
                             n_slots * S).reshape(-1)
             tok_pos = page_pos[1:, None] * ps + jnp.arange(ps)[None, :]
             ref_len = jnp.where(refs_t >= 0, lengths[ref_c], -1)
-            # position j's query sees absolute positions <= lengths +
-            # j: j = 0 is exactly the decode step's mask (the pending
-            # token sees itself), each later draft position one more —
-            # the intra-draft causal structure falls out of the same
-            # rule
-            visible = (tok_pos[:, None, None, :]
-                       <= ref_len[:, :, None, None]
-                       + jnp.arange(S)[None, None, :, None]
-                       ).reshape(-1, n_lanes * S, ps)
+            if not tree:
+                # position j's query sees absolute positions <=
+                # lengths + j: j = 0 is exactly the decode step's mask
+                # (the pending token sees itself), each later draft
+                # position one more — the intra-draft causal structure
+                # falls out of the same rule
+                visible = (tok_pos[:, None, None, :]
+                           <= ref_len[:, :, None, None]
+                           + jnp.arange(S)[None, None, :, None]
+                           ).reshape(-1, n_lanes * S, ps)
+            else:
+                # tree masks: prior context (offset <= 0 — the root's
+                # own write row included) is visible to every node;
+                # a draft row at offset i in (0, S) only to nodes it
+                # is an ancestor-or-self of (sibling branches never
+                # attend each other)
+                off = (tok_pos[:, None, :]
+                       - ref_len[:, :, None])             # (P, R, ps)
+                tvg = t_vis[ref_c]                        # (P,R,S,S)
+                offc = jnp.clip(off, 0, S - 1)
+                sel = jnp.take_along_axis(
+                    tvg, jnp.broadcast_to(
+                        offc[:, :, None, :],
+                        offc.shape[:2] + (S, offc.shape[-1])),
+                    axis=-1)                              # (P,R,S,ps)
+                visible = ((off <= 0)[:, :, None, :]
+                           | (((off > 0) & (off < S))[:, :, None, :]
+                              & sel)).reshape(-1, n_lanes * S, ps)
 
         def layer(x, inputs):
             bp, pk, pv = inputs
@@ -285,12 +469,14 @@ def make_verify_fn(engine):
                     # the fused kernel pass: all S verify positions
                     # ride the kernel's query-block axis, so ONE
                     # in-kernel table walk scores the whole burst —
-                    # the mask tok_pos <= lengths + j and the
-                    # (slot, position) state keying are the kernel's
-                    # own (ops/paged_attention.py)
+                    # the mask tok_pos <= lengths + j (or the tree's
+                    # ancestor-or-self matrix) and the (slot,
+                    # position) state keying are the kernel's own
+                    # (ops/paged_attention.py)
                     o = paged_attention(
                         q, new_k, new_v, work_pages, work_refs,
-                        work_pos, lengths, page_size=ps)
+                        work_pos, lengths, page_size=ps,
+                        tree_vis=t_vis if tree else None)
                     return o.astype(q.dtype), (new_k, new_v)
                 # ONE pool read serves all S positions of every lane:
                 # queries gather to (P, R·S, H, Dh) — the small side —
@@ -331,11 +517,13 @@ def make_verify_fn(engine):
         x, (pool_k, pool_v) = jax.lax.scan(
             layer, x, (params["blocks"], pool_k, pool_v))
         logits = _lm_head(params, x)            # (n_slots, S, vocab)
-        accept, token = spec_pick(rng, logits, in_ids[:, 1:])
+        accept, token = spec_pick(rng, logits, in_ids[:, 1:],
+                                  parent=t_parent if tree else None)
         return accept, token, pool_k, pool_v
 
     return verify_fn
 
 
-__all__ = ["NO_DRAFT", "PromptLookupDrafter", "accept_count",
-           "make_verify_fn"]
+__all__ = ["NO_DRAFT", "PromptLookupDrafter", "TreeLookupDrafter",
+           "accept_count", "make_verify_fn", "tree_accept_path",
+           "tree_masks"]
