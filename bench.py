@@ -2009,6 +2009,244 @@ def bench_replay_http() -> dict:
     return out
 
 
+def _fleet_env() -> dict:
+    """The serve_fleet knob set (one read point, the _replay_env
+    discipline): fleet size, the shared-system-prompt workload shape,
+    per-replica engine geometry, and the SLO/search knobs."""
+    return {
+        "replicas": int(os.environ.get("BENCH_FLEET_REPLICAS", 4)),
+        "n_req": int(os.environ.get("BENCH_FLEET_REQUESTS", 48)),
+        "tenants": int(os.environ.get("BENCH_FLEET_TENANTS", 16)),
+        "prefix_pages": int(os.environ.get("BENCH_FLEET_PREFIX_PAGES", 4)),
+        "rate": float(os.environ.get("BENCH_FLEET_RATE", 24.0)),
+        "slots": int(os.environ.get("BENCH_FLEET_SLOTS", 4)),
+        "page": int(os.environ.get("BENCH_FLEET_PAGE", 16)),
+        # pool sized so ONE replica can keep only a couple of tenants'
+        # prefixes resident: an affinity home keeps its tenants warm,
+        # a round-robin replica cycling all tenants LRU-thrashes —
+        # the cache-locality regime the router exists for
+        "n_pages": int(os.environ.get("BENCH_FLEET_PAGES", 36)),
+        "seq": int(os.environ.get("BENCH_FLEET_SEQ", 256)),
+        "n_layers": int(os.environ.get("BENCH_FLEET_LAYERS", 2)),
+        # a SMALL model on purpose: the fleet rows measure routing/
+        # scheduling in virtual time (scaling, hit pages, TTFT steps),
+        # not model FLOPs — a wide model would just slow the replays
+        # without changing any routing decision
+        "d_model": int(os.environ.get("BENCH_FLEET_DMODEL", 128)),
+        "heads": int(os.environ.get("BENCH_FLEET_HEADS", 4)),
+        "kv": int(os.environ.get("BENCH_FLEET_KV_HEADS", 4)),
+        "ttft_ms": float(os.environ.get("BENCH_FLEET_TTFT_MS", 120)),
+        "ab_speed": float(os.environ.get("BENCH_FLEET_AB_SPEED", 8.0)),
+        "maxx_hi": float(os.environ.get("BENCH_FLEET_MAXX_HI", 32.0)),
+        "maxx_iters": int(os.environ.get("BENCH_FLEET_MAXX_ITERS", 4)),
+        "spill": int(os.environ.get("BENCH_FLEET_SPILL", 4)),
+        # the affinity-emphasis row (run_ab serve_fleet_affinity):
+        # skip the scaling search, run the affinity A/B alone
+        "affinity_only": env_flag("BENCH_FLEET_AFFINITY"),
+    }
+
+
+def _fleet_workload(k: dict):
+    """The shared-system-prompt trace the fleet rows replay: each
+    request's prompt is its tenant's fixed multi-page system prefix +
+    a private tail (equal per-tenant traffic in a shuffled arrival
+    order, so neither arm gets accidental load luck), half
+    interactive half batch, Poisson arrivals — the traffic shape
+    prefix-affinity routing exists for, fingerprinted like any
+    capture."""
+    from torchbooster_tpu.serving.loadgen import (Workload,
+                                                  WorkloadRequest)
+
+    rs = np.random.RandomState(7)
+    arrivals = np.cumsum(rs.exponential(1.0 / k["rate"], k["n_req"]))
+    prefixes = [rs.randint(0, 50257,
+                           k["prefix_pages"] * k["page"],
+                           dtype=np.int32)
+                for _ in range(k["tenants"])]
+    reqs = []
+    # EQUAL per-tenant traffic in a shuffled arrival order: tenant
+    # skew would measure luck-of-the-draw load imbalance, not
+    # routing; parity with round-robin must come from the policy
+    tenant_seq = rs.permutation(
+        np.arange(k["n_req"]) % k["tenants"])
+    for i in range(k["n_req"]):
+        t = int(tenant_seq[i])
+        tail = rs.randint(0, 50257,
+                          int(rs.randint(k["page"] // 2,
+                                         3 * k["page"] // 2 + 1)),
+                          dtype=np.int32)
+        reqs.append(WorkloadRequest(
+            arrival_s=float(arrivals[i]),
+            max_new_tokens=int(rs.randint(6, 12)),
+            prompt=np.concatenate([prefixes[t], tail]),
+            priority=("interactive" if rs.random_sample() < 0.5
+                      else "batch"),
+            request_id=f"t{t:02d}-{i:04d}"))
+    return Workload(requests=reqs, vocab=50257)
+
+
+def bench_serve_fleet() -> dict:
+    """The engine-fleet router A/B (the PR-14 tentpole), all replayed
+    from ONE fingerprinted shared-system-prompt workload through the
+    deterministic in-process driver (one fleet step = one virtual
+    ``step_dt`` for ALL replicas — N in-process replicas model N
+    chips stepping concurrently, so 1→N comparisons are honest):
+
+    1. **Token parity**: the same trace through 1 replica and N
+       replicas (affinity routing) at x1 must produce identical
+       per-request token streams — routing is placement, never
+       content.
+    2. **Scaling headline**: ``max_sustainable_speed`` (largest
+       x-compression with nothing shed and >= 95% of interactive TTFT
+       deadlines hit) for N=1 vs N replicas — acceptance is
+       N=4 >= 3x the single replica.
+    3. **Affinity vs round-robin**: the same trace at a contended
+       fixed speed through affinity and round-robin fleets —
+       acceptance is >= 1.5x fleet-wide prefix-cache hit pages AND a
+       better interactive-class p99 TTFT (chunked prefill is sized at
+       one page per chunk here, so every cached prefix page is a
+       whole scheduling step the interactive request never waits
+       for).
+    4. **Zero-recompile, fleet-wide**: after every replay, each
+       replica holds EXACTLY one decode + one prefill compile.
+
+    ``BENCH_FLEET_AFFINITY=1`` (the serve_fleet_affinity run_ab row)
+    skips the scaling search and runs the affinity A/B alone."""
+    from torchbooster_tpu.models.gpt import GPT, GPTConfig
+    from torchbooster_tpu.serving import (ContinuousBatcher,
+                                          EngineFleet, PagedEngine)
+    from torchbooster_tpu.serving.frontend import (SLOPolicy,
+                                                   parse_classes)
+    from torchbooster_tpu.serving.loadgen import (
+        max_sustainable_speed, replay_inprocess)
+    from torchbooster_tpu.serving.router import AffinityRouting
+
+    k = _fleet_env()
+    workload = _fleet_workload(k)
+    cfg = GPTConfig(n_layers=k["n_layers"], seq_len=k["seq"],
+                    d_model=k["d_model"], n_heads=k["heads"],
+                    n_kv_heads=k["kv"])
+    params = GPT.init(jax.random.PRNGKey(0), cfg)
+    # decisive head: greedy parity must not ride bf16 near-ties
+    params = {**params, "wte": {"table": params["wte"]["table"] * 4.0}}
+
+    def build_fleet(n, routing, ttft_ms):
+        classes = parse_classes(f"interactive:{ttft_ms:g}:0,batch:0:0")
+        policy = SLOPolicy(classes, default="batch")
+        batchers = []
+        for _ in range(n):
+            engine = PagedEngine(
+                params, cfg, page_size=k["page"],
+                n_pages=k["n_pages"], max_slots=k["slots"],
+                prefix_cache=True,
+                # ONE page per prefill chunk: every cached prefix
+                # page is a whole scheduling step the request skips,
+                # so the affinity win is visible in virtual TTFT, not
+                # just byte counters
+                prefill_chunk_pages=1)
+            batchers.append(ContinuousBatcher(engine, policy=policy))
+        return EngineFleet(batchers, routing=routing)
+
+    fleets: list = []
+
+    def engines_of(fleet):
+        return [r.batcher.engine for r in fleet.replicas]
+
+    out: dict = {"workload_fingerprint": workload.fingerprint(),
+                 "serve_fleet_replicas": k["replicas"],
+                 "serve_fleet_tenants": k["tenants"],
+                 "serve_fleet_n_requests": k["n_req"]}
+    parity = True
+    scaling_ok = True
+
+    if not k["affinity_only"]:
+        # ---- parity + the 1 -> N scaling headline ----------------
+        fleet_1 = build_fleet(1, AffinityRouting(
+            spill_queue=k["spill"]), k["ttft_ms"])
+        fleet_n = build_fleet(k["replicas"], AffinityRouting(
+            spill_queue=k["spill"]), k["ttft_ms"])
+        fleets += [fleet_1, fleet_n]
+        res_1 = replay_inprocess(fleet_1, workload, speed=1.0)
+        res_n = replay_inprocess(fleet_n, workload, speed=1.0)
+        tok_1 = {r.request_id: list(r.tokens) for r in res_1.requests}
+        tok_n = {r.request_id: list(r.tokens) for r in res_n.requests}
+        parity = tok_1 == tok_n
+        maxx = {}
+        for label, fleet in (("1", fleet_1), ("n", fleet_n)):
+            maxx[label] = max_sustainable_speed(
+                lambda spd, f=fleet: replay_inprocess(
+                    f, workload, speed=spd).report,
+                lo=1.0, hi=k["maxx_hi"], iters=k["maxx_iters"])
+        scaling = maxx["n"] / max(maxx["1"], 1e-9)
+        scaling_ok = maxx["1"] > 0 and scaling >= 3.0
+        out.update({
+            "serve_fleet_max_x_1": maxx["1"],
+            "serve_fleet_max_x_n": maxx["n"],
+            "serve_fleet_scaling_x": round(scaling, 2),
+            "serve_fleet_token_parity": parity,
+            "serve_fleet_x1_goodput_tok_s":
+                res_n.report["goodput_tok_s"],
+            "serve_fleet_x1_preemptions":
+                res_n.report["n_preemptions"],
+        })
+
+    # ---- affinity vs round-robin at a contended fixed speed ------
+    # HUGE deadlines here: shedding would censor the worst TTFTs out
+    # of exactly the percentile being compared
+    arms = {}
+    for arm, routing in (
+            ("affinity", AffinityRouting(spill_queue=k["spill"])),
+            ("round_robin", "round_robin")):
+        fleet = build_fleet(k["replicas"], routing, 600000.0)
+        fleets.append(fleet)
+        res = replay_inprocess(fleet, workload, speed=k["ab_speed"])
+        cls = res.report["classes"].get("interactive", {})
+        arms[arm] = {
+            "hit_pages": sum(e.prefix_hit_pages
+                             for e in engines_of(fleet)),
+            "ttft_p99_s": cls.get("ttft_p99_s"),
+            "ttft_p50_s": cls.get("ttft_p50_s"),
+            "goodput_tok_s": res.report["goodput_tok_s"],
+            "total_tok_s": res.report["total_tok_s"],
+            "n_preemptions": res.report["n_preemptions"],
+            "affinity_hits": fleet.n_affinity_hits,
+            "spills": fleet.n_spills,
+        }
+    hit_ratio = arms["affinity"]["hit_pages"] \
+        / max(arms["round_robin"]["hit_pages"], 1)
+    p99_aff = arms["affinity"]["ttft_p99_s"] or 0.0
+    p99_rr = arms["round_robin"]["ttft_p99_s"] or 0.0
+    ttft_win = p99_rr / max(p99_aff, 1e-9)
+    # BOTH arms must have measured an interactive p99 — a missing
+    # class block (None -> 0) would otherwise make ttft_win
+    # astronomically large and pass the gate on no data
+    affinity_ok = (hit_ratio >= 1.5 and p99_aff > 0 and p99_rr > 0
+                   and ttft_win > 1.0)
+
+    # ---- the fleet-wide zero-recompile contract ------------------
+    compiles_ok = all(
+        e.decode_compiles == 1 and e.prefill_compiles == 1
+        for fleet in fleets for e in engines_of(fleet))
+
+    ok = parity and scaling_ok and affinity_ok and compiles_ok
+    if not ok:
+        print(f"SERVE_FLEET FAIL: parity={parity}, "
+              f"scaling_ok={scaling_ok}, hit_ratio={hit_ratio:.2f} "
+              f"(need >=1.5), ttft_win={ttft_win:.2f} (need >1), "
+              f"compiles_ok={compiles_ok}", file=sys.stderr)
+    for arm in ("affinity", "round_robin"):
+        for key, val in arms[arm].items():
+            out[f"serve_fleet_{arm}_{key}"] = val
+    out.update({
+        "serve_fleet_ab_speed": k["ab_speed"],
+        "serve_fleet_hit_page_ratio": round(hit_ratio, 2),
+        "serve_fleet_ttft_p99_win": round(ttft_win, 2),
+        "serve_fleet_one_compile_per_replica": compiles_ok,
+        "serve_fleet_ok": ok,
+    })
+    return out
+
+
 def bench_obs(steps: int) -> dict:
     """Telemetry overhead A/B: the SAME GPT bench step (bench_gpt
     geometry + knobs) timed with observability disabled, then enabled
@@ -2650,6 +2888,8 @@ def _sub_main(name: str) -> None:
         print(json.dumps(bench_replay()))
     elif name == "replay_http":
         print(json.dumps(bench_replay_http()))
+    elif name == "serve_fleet":
+        print(json.dumps(bench_serve_fleet()))
     elif name == "obs":
         print(json.dumps(bench_obs(max(4, steps // 4))))
     elif name == "comms":
@@ -2866,6 +3106,10 @@ _SECONDARY_BENCHES = (("gpt", 900), ("gpt_long", 1500), ("loader", 900),
                       # two-drivers-must-agree reason
                       ("replay", 1500),
                       ("replay_http", 1500),
+                      # the engine-fleet router row (PR 14): 1->N
+                      # scaling + affinity-vs-round-robin, replayed
+                      # in-process from one fingerprinted workload
+                      ("serve_fleet", 1800),
                       ("obs", 900), ("comms", 900))
 
 

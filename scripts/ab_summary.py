@@ -128,7 +128,8 @@ def main() -> None:
                  "serve_http_prio", "serve_kernel", "serve_kernel_spec",
                  "serve_tp", "serve_tp_pallas",
                  "serve_parallel", "serve_tree",
-                 "obs_trace", "replay", "replay_http")
+                 "obs_trace", "replay", "replay_http",
+                 "serve_fleet", "serve_fleet_affinity")
     for name in sorted(attempts):
         if name in METRICS or (name in multi_key and name in latest):
             continue  # multi-key ok rows print below; failures fall through
@@ -411,6 +412,46 @@ def main() -> None:
                 f"/{r.get(f'replay_http_ttft_p99_s_{cls}', '—')} "
                 f"| {r.get(f'replay_http_tpot_p50_s_{cls}', '—')}"
                 f"/{r.get(f'replay_http_tpot_p99_s_{cls}', '—')} |")
+
+    # serve_fleet rows: the engine-fleet router — the 1->N scaling
+    # headline (max sustainable x per fleet size) and the
+    # affinity-vs-round-robin sub-table (fleet-wide prefix-hit pages,
+    # interactive p99 TTFT, goodput, spills) with the parity/compile
+    # proofs in the header
+    for name in ("serve_fleet", "serve_fleet_affinity"):
+        e = latest.get(name)
+        if e is None:
+            continue
+        r = e.get("result") or {}
+        scaling = r.get("serve_fleet_scaling_x")
+        print(f"\n{name} ({r.get('serve_fleet_replicas', '?')} "
+              f"replicas x {r.get('serve_fleet_tenants', '?')} "
+              "tenants, fingerprint "
+              f"{r.get('workload_fingerprint', '?')}"
+              + (f", 1->N scaling {scaling}x (max x"
+                 f"{r.get('serve_fleet_max_x_1', '?')} -> x"
+                 f"{r.get('serve_fleet_max_x_n', '?')}, gate >= 3)"
+                 if scaling is not None else "")
+              + f", hit-page ratio "
+              f"{r.get('serve_fleet_hit_page_ratio', '?')}x "
+              "(gate >= 1.5), interactive p99 TTFT win "
+              f"{r.get('serve_fleet_ttft_p99_win', '?')}x, token "
+              f"parity {r.get('serve_fleet_token_parity', '?')}, one "
+              "compile/replica "
+              f"{r.get('serve_fleet_one_compile_per_replica', '?')}, "
+              f"verdict ok={r.get('serve_fleet_ok', '?')}):")
+        print("| routing | hit pages | ttft p50/p99 s interactive "
+              "| goodput tok/s | spills |")
+        print("|---|---|---|---|---|")
+        for arm in ("affinity", "round_robin"):
+            pre = f"serve_fleet_{arm}"
+            print(
+                f"| {arm} "
+                f"| {r.get(f'{pre}_hit_pages', '—')} "
+                f"| {r.get(f'{pre}_ttft_p50_s', '—')}"
+                f"/{r.get(f'{pre}_ttft_p99_s', '—')} "
+                f"| {r.get(f'{pre}_goodput_tok_s', '—')} "
+                f"| {r.get(f'{pre}_spills', '—')} |")
 
     # comms rows: bytes-moved + step-time deltas across the gradient
     # sync arms, rendered as a compact sub-table (one row per arm)

@@ -19,7 +19,7 @@ nothing is imported):
   unrelated sentences and would void the guarantee;
 - **reverse**: inside every ``\\`\\`\\`yaml`` fence of docs/config.md,
   the sub-keys of a documented block (``serving:``, ``frontend:``,
-  ``loadgen:``, ``comms:``, ``observability:``, ``env:``, ``loader:``, ``optim:``,
+  ``router:``, ``loadgen:``, ``comms:``, ``observability:``, ``env:``, ``loader:``, ``optim:``,
   ``scheduler:``, ``dataset:``) must each be a real field of the
   corresponding config class; and every row of a markdown field table
   introduced by the ``\\`block:\\` (\\`Class\\`):`` convention must
@@ -53,6 +53,7 @@ BLOCKS = {
     "dataset": "DatasetConfig",
     "serving": "ServingConfig",
     "frontend": "FrontendConfig",
+    "router": "RouterConfig",
     "loadgen": "LoadgenConfig",
     "comms": "CommsConfig",
     "observability": "ObservabilityConfig",
@@ -149,7 +150,7 @@ Flags:
   code (backticked, or a yaml-fence key — prose mentions don't count)
   — finding anchored at the field's definition line;
 - reverse: a sub-key of a documented block (`serving:`, `frontend:`,
-  `loadgen:`, `comms:`, `observability:`, `env:`, `loader:`, `optim:`,
+  `router:`, `loadgen:`, `comms:`, `observability:`, `env:`, `loader:`, `optim:`,
   `scheduler:`, `dataset:`) inside a yaml fence of docs/config.md that is not a
   field of the corresponding config class, and any field-table row
   (the `block:` (`Class`): convention) naming a dropped field —

@@ -13,8 +13,16 @@ two arms that served different traces are not an A/B, and silently
 diffing them is how bogus regressions (and bogus all-clears) ship.
 Replay the same capture through both arms first.
 
+``--per-class`` names which SLO class regressed: instead of the
+aggregate summary alone, every class present in both reports gets its
+own comparison block (p99 TTFT/TPOT, deadline hit, goodput,
+base → candidate) and the verdict line lists the regressed classes by
+name — the aggregate gate says *that* conformance slipped, this mode
+says *who* it slipped for. Exit codes are unchanged either way.
+
 Usage:
-    python scripts/replay_diff.py baseline.json candidate.json [--tol 0.1]
+    python scripts/replay_diff.py baseline.json candidate.json \
+        [--tol 0.1] [--per-class]
 
 Exit codes: 0 = no regression, 1 = regression(s) flagged,
 2 = not comparable (fingerprint mismatch) or unreadable input.
@@ -33,9 +41,34 @@ from torchbooster_tpu.serving.loadgen.report import (  # noqa: E402
 )
 
 
+def _print_per_class(base: dict, cand: dict,
+                     regressions: list[str]) -> None:
+    """The --per-class view: one comparison block per SLO class and
+    the regressed classes called out BY NAME (the aggregate gate only
+    says that conformance slipped; this says who it slipped for)."""
+    base_cls = base.get("classes", {})
+    cand_cls = cand.get("classes", {})
+    regressed = sorted({line.split(".")[1] for line in regressions
+                        if line.startswith("classes.")})
+    for cls in sorted(set(base_cls) | set(cand_cls)):
+        b, c = base_cls.get(cls, {}), cand_cls.get(cls, {})
+        mark = " [REGRESSED]" if cls in regressed else ""
+        print(f"\nclass {cls}{mark}:")
+        for key in ("ttft_p99_s", "tpot_p99_s", "deadline_hit_rate",
+                    "goodput_tok_s", "n_shed"):
+            print(f"  {key}: {b.get(key)} -> {c.get(key)}")
+    if regressed:
+        print(f"\nregressed classes: {', '.join(regressed)}")
+    else:
+        print("\nregressed classes: none")
+
+
 def main(argv: list[str] | None = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     tol = 0.10
+    per_class = "--per-class" in argv
+    if per_class:
+        argv.remove("--per-class")
     if "--tol" in argv:
         i = argv.index("--tol")
         try:
@@ -47,7 +80,8 @@ def main(argv: list[str] | None = None) -> int:
         del argv[i:i + 2]
     if len(argv) != 2:
         print("usage: python scripts/replay_diff.py <baseline.json> "
-              "<candidate.json> [--tol 0.1]", file=sys.stderr)
+              "<candidate.json> [--tol 0.1] [--per-class]",
+              file=sys.stderr)
         return 2
     reports = []
     for path in argv:
@@ -72,6 +106,8 @@ def main(argv: list[str] | None = None) -> int:
     for key in ("goodput_tok_s", "total_tok_s", "deadline_hit_rate",
                 "shed_rate"):
         print(f"  {key}: {base.get(key)} -> {cand.get(key)}")
+    if per_class:
+        _print_per_class(base, cand, regressions)
     if regressions:
         print(f"\n{len(regressions)} SLO regression(s) beyond "
               f"tol={tol}:")

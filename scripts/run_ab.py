@@ -173,6 +173,19 @@ QUEUE: list[tuple[str, str, dict, int]] = [
     # ab_summary, replay_diff) refuse arms whose fingerprints differ
     ("replay", "replay", {}, 1500),
     ("replay_http", "replay_http", {}, 1500),
+    # engine-fleet router (the PR-14 tentpole): ONE fingerprinted
+    # shared-system-prompt workload replayed in-process against the
+    # fleet — token parity 1-vs-N, the 1->N max-sustainable-x scaling
+    # headline (acceptance N=4 >= 3x N=1), the affinity-vs-round-robin
+    # A/B (>= 1.5x fleet-wide prefix-hit pages AND a better
+    # interactive p99 TTFT at the contended AB speed), and exactly
+    # one decode compile per replica (bench.bench_serve_fleet;
+    # serve_fleet_ok is the verdict bit). The affinity row re-runs
+    # the affinity-vs-round-robin A/B alone (no scaling search) — a
+    # cheap re-measure of the routing headline for gate stability
+    ("serve_fleet", "serve_fleet", {}, 1800),
+    ("serve_fleet_affinity", "serve_fleet",
+     {"BENCH_FLEET_AFFINITY": "1"}, 1800),
     # recipe accuracy on chip (VERDICT r4 #3): the shipped ResNet
     # CIFAR recipe end to end, ref hyperparams, 20 epochs — real
     # CIFAR-10 if a binary release is under the dataset root (none in

@@ -552,6 +552,33 @@ def test_replay_diff_cli_exit_codes(tmp_path, capsys):
     assert "NOT COMPARABLE" in out.err
 
 
+def test_replay_diff_per_class_names_the_regressed_class(tmp_path,
+                                                         capsys):
+    """--per-class (the ISSUE satellite): the gate names WHICH SLO
+    class regressed — per-class comparison blocks, a [REGRESSED]
+    marker, and a 'regressed classes:' verdict line — with exit codes
+    unchanged vs the aggregate mode."""
+    import scripts.replay_diff as rd
+
+    base, bad, good = (tmp_path / n
+                       for n in ("base.json", "bad.json", "good.json"))
+    base.write_text(json.dumps(_fake_report()))
+    # only the rt class regresses (its p99 TTFT blows up); aggregates
+    # stay inside tolerance
+    bad.write_text(json.dumps(_fake_report(ttft99=0.9)))
+    good.write_text(json.dumps(_fake_report(goodput=98.0)))
+    assert rd.main([str(base), str(bad), "--per-class"]) == 1
+    out = capsys.readouterr().out
+    assert "class rt [REGRESSED]" in out
+    assert "regressed classes: rt" in out
+    assert rd.main([str(base), str(good), "--per-class"]) == 0
+    out = capsys.readouterr().out
+    assert "regressed classes: none" in out
+    # same inputs, aggregate mode: identical exit codes
+    assert rd.main([str(base), str(bad)]) == 1
+    assert rd.main([str(base), str(good)]) == 0
+
+
 def test_fingerprint_gates_agree_and_ab_best_refuses(tmp_path):
     """The three comparability gates — the canonical predicate
     (loadgen.report), bench's _ab_best winner pick, and ab_summary's

@@ -91,6 +91,13 @@ def test_hot_paths_cover_step_cadence_serving_files():
                 # its wrappers run on the step cadence around every
                 # compiled decode/verify dispatch
                 "torchbooster_tpu/serving/tp.py",
+                # the fleet router (PR 14): routing decisions, the
+                # fleet step loop, and readmission all run between
+                # every replica's decode dispatches — as step-cadence
+                # as the batcher loop they pump
+                "torchbooster_tpu/serving/router/fleet.py",
+                "torchbooster_tpu/serving/router/routing.py",
+                "torchbooster_tpu/serving/router/replica.py",
                 # the paged flash-decode kernel wrapper runs inside
                 # the compiled decode/verify steps (PR 8)
                 "torchbooster_tpu/ops/paged_attention.py"):

@@ -701,6 +701,71 @@ class FrontendConfig(BaseConfig):
 
 
 @dataclass
+class RouterConfig(BaseConfig):
+    """The engine-fleet router (torchbooster_tpu/serving/router):
+    N data-parallel engine replicas behind one front door. Nested
+    under ``serving:`` as its ``router:`` sub-block. No reference
+    analogue — this is ROADMAP item 2's replica scale-out.
+
+    ``n_replicas: 1`` (the default) changes nothing: ``ServingConfig.
+    make`` returns the plain single batcher, bit-for-bit. With
+    ``n_replicas > 1`` it builds N identical engines + batchers
+    (sharing the model params and ONE scheduler-policy table) and
+    returns an :class:`~torchbooster_tpu.serving.router.EngineFleet`
+    — which quacks like a batcher, so ``frontend.make(fleet)`` serves
+    it over HTTP and ``replay_inprocess(fleet, ...)`` replays
+    captures against it unchanged.
+
+    ``policy`` picks the routing decision: ``round_robin`` (the
+    control — live replicas in a fixed cycle) or ``affinity`` (the
+    default — hash the request's page-aligned prompt prefix, at most
+    ``affinity_pages`` full pages of it, into a replica-affinity map
+    so tenants sharing a system prompt land where their prefix-cache
+    pages are warm; keyless requests and spills route by least
+    expected slack over per-replica queue depth × EWMA step
+    estimates). ``spill_queue`` is the hot-prefix protection: when
+    the mapped replica's queue sits that much deeper than the
+    shallowest live one, the request spills to the least-loaded
+    replica instead (the map is untouched — traffic returns home
+    once the queue drains).
+
+    ``rebalance_queue > 0`` turns on sustained-hot-spot readmission:
+    after ``rebalance_after`` consecutive steps with the deepest
+    live queue more than ``rebalance_queue`` over the shallowest,
+    QUEUED requests migrate off the hot replica (the cheap end of
+    the readmission-cost scale — no engine state moves). Replica
+    DEATH readmission is always on: a replica whose step raises is
+    buried and its queued + in-flight requests re-admit elsewhere
+    with their generated tokens folded into their prompts (nothing
+    lost, nothing duplicated). See docs/serving.md "The engine
+    fleet" for the full contract.
+    """
+
+    n_replicas: int = 1                # 1 = plain single batcher
+    policy: str = "affinity"           # round_robin | affinity
+    affinity_pages: int = 2            # full pages hashed into the key
+    spill_queue: int = 4               # hot-prefix spill threshold
+    rebalance_queue: int = 0           # 0 = hot-spot rebalance off
+    rebalance_after: int = 8           # sustained-imbalance steps
+
+    def make_routing(self) -> Any:
+        from torchbooster_tpu.serving.router import make_routing
+
+        return make_routing(self.policy,
+                            affinity_pages=self.affinity_pages,
+                            spill_queue=self.spill_queue)
+
+    def make(self, batchers: Any) -> Any:
+        """Build the :class:`EngineFleet` over already-built replica
+        batchers (normally ``ServingConfig.make``'s job)."""
+        from torchbooster_tpu.serving.router import EngineFleet
+
+        return EngineFleet(batchers, routing=self.make_routing(),
+                           rebalance_queue=self.rebalance_queue,
+                           rebalance_after=self.rebalance_after)
+
+
+@dataclass
 class ServingConfig(BaseConfig):
     """Serving-engine settings (torchbooster_tpu/serving): the paged
     KV cache's geometry and the sampling knobs of the continuous-
@@ -791,11 +856,13 @@ class ServingConfig(BaseConfig):
     tp: int = 1                        # tensor-parallel head shards (mesh "tp" axis)
     frontend: FrontendConfig = dataclasses.field(
         default_factory=FrontendConfig)  # HTTP front door + scheduler
+    router: RouterConfig = dataclasses.field(
+        default_factory=RouterConfig)  # engine-fleet replica scale-out
 
     def make(self, params: Any, model_cfg: Any,
              compute_dtype: Any = None,
              on_recompile: str = "warn",
-             mesh: Any = None) -> Any:
+             mesh: Any = None, tracer: Any = None) -> Any:
         """Build the engine + batcher for ``params``/``model_cfg`` (a
         :class:`~torchbooster_tpu.models.gpt.GPTConfig`). Returns the
         :class:`~torchbooster_tpu.serving.ContinuousBatcher` — with
@@ -809,7 +876,12 @@ class ServingConfig(BaseConfig):
         ``mesh`` is the committed device mesh a ``tp > 1`` build
         shards over (must carry a ``tp`` axis of exactly that size —
         validated here with the offending numbers BEFORE any engine
-        state is built, and again by the engine ctor)."""
+        state is built, and again by the engine ctor). ``tracer`` is
+        the request tracer to install (normally
+        ``conf.observability.tracing.make()`` — the ONLY way the
+        ``tracing:`` YAML block reaches a YAML-built batcher/fleet);
+        a fleet shares it across every replica so ``/debug/trace``
+        follows a request fleet-wide."""
         import jax.numpy as jnp
 
         from torchbooster_tpu.serving import ContinuousBatcher, PagedEngine
@@ -820,26 +892,59 @@ class ServingConfig(BaseConfig):
         # arrives without a committed mesh must fail HERE, with the
         # numbers, not as a shard_map shape error mid-build
         check_tp(self.tp, model_cfg, mesh)
-        engine = PagedEngine(
-            params, model_cfg,
-            page_size=self.page_size, n_pages=self.n_pages,
-            max_slots=self.max_slots,
-            cache_dtype=self.cache_dtype or None,
-            compute_dtype=(jnp.bfloat16 if compute_dtype is None
-                           else compute_dtype),
-            temperature=self.temperature,
-            top_k=self.top_k or None, top_p=self.top_p or None,
-            prefix_cache=self.prefix_cache,
-            prefill_chunk_pages=self.prefill_chunk_pages,
-            speculative=self.speculative,
-            draft_len=self.draft_len, ngram_min=self.ngram_min,
-            spec_tree=self.spec_tree,
-            tree_width=self.spec_tree_width,
-            parallel_sampling=self.parallel_sampling,
-            decode_backend=self.decode_backend,
-            tp=self.tp, mesh=mesh)
-        return ContinuousBatcher(engine, on_recompile=on_recompile,
-                                 policy=self.frontend.make_policy())
+        n_replicas = self.router.n_replicas
+        if n_replicas < 1:
+            raise ValueError(
+                f"serving.router.n_replicas must be >= 1, got "
+                f"{n_replicas}")
+        if n_replicas > 1 and self.tp > 1:
+            raise ValueError(
+                f"serving.router.n_replicas={n_replicas} with "
+                f"tp={self.tp} is not buildable from YAML: every "
+                "replica would shard over the SAME tp mesh axis — "
+                "build EngineFleet directly with per-replica meshes")
+
+        def build_engine():
+            return PagedEngine(
+                params, model_cfg,
+                page_size=self.page_size, n_pages=self.n_pages,
+                max_slots=self.max_slots,
+                cache_dtype=self.cache_dtype or None,
+                compute_dtype=(jnp.bfloat16 if compute_dtype is None
+                               else compute_dtype),
+                temperature=self.temperature,
+                top_k=self.top_k or None, top_p=self.top_p or None,
+                prefix_cache=self.prefix_cache,
+                prefill_chunk_pages=self.prefill_chunk_pages,
+                speculative=self.speculative,
+                draft_len=self.draft_len, ngram_min=self.ngram_min,
+                spec_tree=self.spec_tree,
+                tree_width=self.spec_tree_width,
+                parallel_sampling=self.parallel_sampling,
+                decode_backend=self.decode_backend,
+                tp=self.tp, mesh=mesh)
+
+        # ONE policy object serves every replica AND the fleet-level
+        # validate/backpressure surface (policies are stateless over
+        # their class tables, so sharing is safe by construction)
+        policy = self.frontend.make_policy()
+        if n_replicas == 1:
+            return ContinuousBatcher(build_engine(),
+                                     on_recompile=on_recompile,
+                                     policy=policy, tracer=tracer)
+        # the fleet: N identical replicas sharing params, the policy
+        # table, and ONE tracer ring (so /debug/trace follows a
+        # request across replicas by its id)
+        if tracer is None:
+            from torchbooster_tpu.observability.tracing import (
+                RequestTracer)
+
+            tracer = RequestTracer()
+        batchers = [ContinuousBatcher(build_engine(),
+                                      on_recompile=on_recompile,
+                                      policy=policy, tracer=tracer)
+                    for _ in range(n_replicas)]
+        return self.router.make(batchers)
 
 
 @dataclass
@@ -1112,6 +1217,7 @@ __all__ = [
     "LoaderConfig",
     "ObservabilityConfig",
     "OptimizerConfig",
+    "RouterConfig",
     "SchedulerConfig",
     "ServingConfig",
     "TracingConfig",

@@ -49,7 +49,14 @@ package stops streaming dead bytes:
   deadline-driven admission, cost-aware preemption, load shedding)
   and the stdlib asyncio OpenAI-compatible HTTP/SSE server
   (:class:`ServingFrontend`) that pumps the batcher from an event
-  loop (docs/serving.md).
+  loop (docs/serving.md);
+- :mod:`router` — the engine FLEET: N data-parallel replicas behind
+  one batcher-shaped front door (:class:`EngineFleet`), with
+  prefix-affinity + SLO-aware routing, a load-spill threshold,
+  cross-replica readmission on replica death or sustained hot-spot,
+  and fleet-wide ``router_*`` telemetry — ``ServingFrontend(fleet)``
+  and ``replay_inprocess(fleet, ...)`` both drive it unchanged
+  (docs/serving.md "The engine fleet").
 
 Entry points: build a :class:`~torchbooster_tpu.serving.engine.
 PagedEngine` (or via ``ServingConfig.make`` from YAML), wrap it in a
@@ -77,17 +84,26 @@ from torchbooster_tpu.serving.speculative import (
 )
 
 
+_ROUTER_NAMES = ("EngineFleet", "InProcessReplica", "AffinityRouting",
+                 "RoundRobinRouting")
+
+
 def __getattr__(name: str):
     if name == "ServingFrontend":     # lazy: pulls in the http layer
         from torchbooster_tpu.serving.frontend import ServingFrontend
 
         return ServingFrontend
+    if name in _ROUTER_NAMES:         # lazy: the fleet layer
+        from torchbooster_tpu.serving import router
+
+        return getattr(router, name)
     raise AttributeError(
         f"module {__name__!r} has no attribute {name!r}")
 
 
-__all__ = ["BlockTables", "ContinuousBatcher", "FCFSPolicy",
+__all__ = ["AffinityRouting", "BlockTables", "ContinuousBatcher",
+           "EngineFleet", "FCFSPolicy", "InProcessReplica",
            "NO_DRAFT", "NULL_PAGE", "PagedEngine", "PriorityClass",
-           "PromptLookupDrafter", "Request", "SLOPolicy",
-           "SchedulerPolicy", "ServingFrontend", "TreeLookupDrafter",
-           "make_pool"]
+           "PromptLookupDrafter", "Request", "RoundRobinRouting",
+           "SLOPolicy", "SchedulerPolicy", "ServingFrontend",
+           "TreeLookupDrafter", "make_pool"]

@@ -444,6 +444,91 @@ class ContinuousBatcher:
             s.queue or s.live or s.filling
             or self._inbox_submit or self._inbox_cancel)
 
+    @property
+    def session_active(self) -> bool:
+        """Whether a pumpable session is open (the fleet router and
+        the replay driver's cleanup path share this — neither should
+        reach into ``_s``)."""
+        return self._s is not None
+
+    @property
+    def inflight(self) -> int:
+        """Seated requests (prefilling + decoding) — ONE definition
+        of in-flight for the readiness payload and the fleet router's
+        load scorer alike."""
+        s = self._s
+        return 0 if s is None else len(s.live) + len(s.filling)
+
+    def readiness(self) -> dict:
+        """The readiness payload: queue depth, free/cached pages,
+        in-flight count, occupancy, and the EWMA step estimate — ONE
+        dict serving both the front door's ``GET /healthz?full=1``
+        probe and the fleet router's load scorer (the contract that
+        keeps an external health check and the routing decision
+        reading the same numbers). Host counters only."""
+        eng = self.engine
+        return {
+            "status": "ok",
+            "queue_depth": self.queue_depth,
+            "pages_free": int(eng.tables.n_free_pages),
+            "pages_cached": int(eng.tables.n_cached_pages),
+            "inflight": self.inflight,
+            "occupancy": round(self.occupancy, 4),
+            "est_step_s": round(self.est_step_s, 6),
+        }
+
+    def drain_unfinished(self, retire_seated: bool = True) -> list:
+        """Remove and return EVERY unfinished request of the active
+        session — the fleet router's cross-replica readmission path.
+        Seated requests leave with their generated tokens folded into
+        their prompts (exactly the preemption fold), so a drained
+        request re-prefills its full context on whatever replica
+        re-admits it and keeps its delivered tokens: nothing lost,
+        nothing duplicated. ``retire_seated=False`` skips the engine
+        retire calls — a DEAD replica's engine is not to be trusted,
+        and in-process its pages die with the object."""
+        if self._s is None:
+            return []
+        s = self._s
+        out: list[Request] = []
+        while self._inbox_submit:
+            out.append(self._inbox_submit.popleft())
+        out.extend(s.queue)
+        s.queue.clear()
+        seated = sorted([*s.filling.items(), *s.live.items()])
+        s.filling.clear()
+        s.live.clear()
+        s.admit_order.clear()
+        for slot, req in seated:
+            if retire_seated:
+                self.engine.retire(slot)
+            folded = len(req.prompt) - req.base_len
+            if self.tracer.enabled:
+                self.tracer.emit(req.request_id, "drained", slot=slot,
+                                 fold_tokens=len(req.tokens) - folded)
+            req.prompt = np.concatenate(
+                [req.prompt,
+                 np.asarray(req.tokens[folded:], np.int32)])
+            out.append(req)
+        return out
+
+    def drain_queued(self, n: int) -> list:
+        """Remove and return up to ``n`` QUEUED (never seated this
+        visit) requests from the BACK of the queue — the cheap end of
+        the readmission-cost scale (no engine state, no fold), which
+        is why the fleet's hot-spot rebalance migrates exactly these.
+        Arrival order among the returned requests is preserved."""
+        if self._s is None or n < 1:
+            return []
+        s = self._s
+        while self._inbox_submit:
+            s.queue.append(self._inbox_submit.popleft())
+        out: list[Request] = []
+        while s.queue and len(out) < n:
+            out.append(s.queue.pop())
+        out.reverse()
+        return out
+
     # ---- external driver surface ---------------------------------
     def submit(self, req: Request, arrival: float | None = None) -> None:
         """Thread-safe enqueue for an externally-driven session: the

@@ -20,7 +20,10 @@ asyncio HTTP server exposing
   decoded branch over the ONE prompt prefill;
 - ``GET /metrics`` — the telemetry registry's Prometheus exposition
   (the ``serving_*``/``serving_slo_*`` series, scrape-ready);
-- ``GET /healthz`` — liveness + pool occupancy;
+- ``GET /healthz`` — liveness + pool occupancy; ``?full=1`` upgrades
+  it to the readiness payload (free/cached pages, in-flight count,
+  EWMA step estimate — the same dict the fleet router's load scorer
+  reads, per-replica rows included when serving an ``EngineFleet``);
 - ``GET /debug/requests`` — live per-request scheduler state (+ each
   request's trace-timeline tail when tracing is on);
 - ``GET /debug/engine`` — pool occupancy, prefix-cache stats, compile
@@ -373,13 +376,25 @@ class ServingFrontend:
 
                 writer.write(text_response(200, prometheus_text()))
             elif route == ("GET", "/healthz"):
-                eng = self.batcher.engine
-                writer.write(json_response(200, {
-                    "status": "ok",
-                    "queue_depth": self.batcher.queue_depth,
-                    "pages_free": int(eng.tables.n_free_pages),
-                    "occupancy": round(self.batcher.occupancy, 4),
-                }))
+                # ?full=1 upgrades the liveness ping to the READINESS
+                # payload (queue depth, free/cached pages, in-flight
+                # count, EWMA step estimate) — the same dict the
+                # fleet router's load scorer consumes
+                # (batcher/fleet.readiness()), so an external health
+                # probe and the routing decision can never read
+                # different numbers. The bare form keeps its historic
+                # key set for existing checks.
+                ready = self.batcher.readiness()
+                if (parse_qs(query).get("full") or ["0"])[0] \
+                        not in ("", "0", "false"):
+                    writer.write(json_response(200, ready))
+                else:
+                    writer.write(json_response(200, {
+                        "status": ready["status"],
+                        "queue_depth": ready["queue_depth"],
+                        "pages_free": ready["pages_free"],
+                        "occupancy": ready["occupancy"],
+                    }))
             elif route == ("GET", "/debug/requests"):
                 # serialized with step() on the pump executor: the
                 # snapshot walks the scheduler's session dicts
@@ -409,7 +424,12 @@ class ServingFrontend:
     def _engine_debug(self) -> dict:
         """The ``/debug/engine`` payload (runs on the pump executor):
         engine stats + the flight-recorder tail and its watchdog
-        anomalies."""
+        anomalies. A fleet-fronted server returns the fleet form
+        instead: router stats + one row per replica (alive flag,
+        engine stats, its own flight tail) — the per-replica rows
+        keyed by the same ids ``/debug/requests`` tags."""
+        if hasattr(self.batcher, "debug_fleet"):
+            return self.batcher.debug_fleet()
         flight = self.batcher.flight
         return {
             "engine": self.batcher.engine.debug_stats(),

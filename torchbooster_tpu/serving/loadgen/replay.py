@@ -102,13 +102,25 @@ def _outcome(*, request_id: str, cls: str, arrival_s: float,
             "deadline_s": deadline_s, "deadline_hit": hit}
 
 
-def replay_inprocess(batcher: ContinuousBatcher, workload: Workload,
+def replay_inprocess(batcher, workload: Workload,
                      speed: float | None = None,
                      step_dt: float = 0.005,
                      max_steps: int = 200_000) -> ReplayResult:
     """Replay ``workload`` through the batcher ``step()`` core under a
     deterministic :class:`ReplayClock` at ``speed``× compression
     (arrivals divide by it; relative order is preserved exactly).
+
+    ``batcher`` is a :class:`ContinuousBatcher` OR an
+    :class:`~torchbooster_tpu.serving.router.EngineFleet` — the fleet
+    quacks like a batcher, its ``clock`` setter swaps every replica's
+    clock at once, and one fleet ``step()`` advances the virtual
+    clock ONE ``step_dt`` while stepping every live replica (N
+    in-process replicas model N chips stepping concurrently, which is
+    what makes the 1→N ``max_sustainable_speed`` comparison honest).
+    Same capture + same routing policy ⇒ identical per-replica
+    assignment sequence (``fleet.assignment_log``) and identical
+    token streams — the multi-replica determinism the regression test
+    pins.
 
     All requests are submitted up-front with their compressed
     arrivals (the policy gates on arrival vs the virtual now — the
@@ -178,7 +190,7 @@ def replay_inprocess(batcher: ContinuousBatcher, workload: Workload,
     except Exception:
         # close a half-open session so the batcher stays usable (and
         # the sentinel watch lands) even when the replay dies mid-run
-        if batcher._s is not None:
+        if batcher.session_active:
             try:
                 batcher.finish_session()
             except Exception:  # noqa: BLE001 — the original error wins

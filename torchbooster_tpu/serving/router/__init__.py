@@ -1,0 +1,47 @@
+"""Engine fleet router: N data-parallel replicas behind one front door.
+
+ROADMAP item 2's scale-out subsystem, in three layers:
+
+- :mod:`replica` — the replica boundary (:class:`Replica` — what the
+  router may know about one engine: offer, pump, probe, drain) and
+  its in-process implementation (:class:`InProcessReplica`, a
+  ``ContinuousBatcher`` stepped by the fleet loop; a socket-backed
+  replica slots in here later without the router changing);
+- :mod:`routing` — the routing decision (:class:`RoundRobinRouting`
+  control; :class:`AffinityRouting` — page-aligned prompt-prefix
+  affinity with a load-spill threshold over a least-expected-slack
+  scorer), a pure function of host-side counters so multi-replica
+  replay is deterministic;
+- :mod:`fleet` — :class:`EngineFleet`, the batcher-shaped front-door
+  core: arrival-time routing, one step per live replica per fleet
+  step, cross-replica readmission on replica death or sustained
+  hot-spot, and the fleet ``router_*`` telemetry + ``/debug`` merge.
+
+``ServingFrontend(fleet)`` serves a fleet over HTTP unchanged;
+``replay_inprocess(fleet, workload)`` replays captures against it
+under the deterministic clock; the ``serving.router:`` YAML block
+(``config.RouterConfig``) builds one from config.
+"""
+from torchbooster_tpu.serving.router.fleet import EngineFleet
+from torchbooster_tpu.serving.router.replica import (
+    InProcessReplica,
+    Replica,
+)
+from torchbooster_tpu.serving.router.routing import (
+    AffinityRouting,
+    RoundRobinRouting,
+    RoutingPolicy,
+    make_routing,
+    prefix_affinity_key,
+)
+
+__all__ = [
+    "AffinityRouting",
+    "EngineFleet",
+    "InProcessReplica",
+    "Replica",
+    "RoundRobinRouting",
+    "RoutingPolicy",
+    "make_routing",
+    "prefix_affinity_key",
+]

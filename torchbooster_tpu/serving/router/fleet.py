@@ -1,0 +1,644 @@
+"""EngineFleet: N data-parallel engine replicas behind one front door.
+
+ROADMAP item 2's scale-out: PR 7 ended at one ``ContinuousBatcher``
+pumping one engine; for "millions of users" the fleet puts a ROUTER in
+front of N of them. The fleet deliberately quacks like a batcher —
+``start_session`` / ``submit`` / ``cancel`` / ``step`` /
+``finish_session`` plus the probe surface (``queue_depth``,
+``has_work``, ``readiness``, ``debug_snapshot``) — so every existing
+driver works unchanged: ``ServingFrontend(fleet)`` serves it over
+HTTP, and ``replay_inprocess(fleet, workload)`` replays a captured
+trace against it under the deterministic clock (swap the fleet's
+``clock`` and every replica follows).
+
+One fleet ``step()`` = route newly-arrived requests, then step every
+LIVE replica once. In-process replicas therefore model N chips
+stepping in parallel: under the replay harness's virtual clock a
+fleet iteration costs one ``step_dt`` regardless of N — exactly the
+wall-time shape of concurrent hardware — which is what makes the
+1→N ``max_sustainable_speed`` comparison honest.
+
+Routing is deferred to ARRIVAL, not submission: ``submit`` parks the
+request in a fleet-level admission buffer and the next ``step()``
+routes everything whose arrival has come, in (arrival, request_id)
+order, through the :mod:`~torchbooster_tpu.serving.router.routing`
+policy — so the router scores the load that actually exists when the
+request shows up, and the whole decision sequence is a pure function
+of the workload (the multi-replica replay-determinism test pins it).
+
+Cross-replica READMISSION generalizes the batcher's preemption fold:
+
+- **replica death** — a replica whose ``step()`` raises (or that
+  ``kill()`` forces down) is marked dead and never stepped again;
+  its queued + in-flight requests drain with generated tokens folded
+  into their prompts and re-enter the admission buffer, so they
+  re-prefill elsewhere and finish exactly once (delivered tokens are
+  kept — nothing is lost, nothing duplicated). The fleet only raises
+  when NO replica remains.
+- **sustained hot-spot** — when the deepest live queue exceeds the
+  shallowest by more than ``rebalance_queue`` for
+  ``rebalance_after`` consecutive steps, queued (cheap — no engine
+  state) requests migrate off the hot replica until the gap closes.
+  ``rebalance_queue=0`` disables it.
+
+Fleet observability: the replicas share ONE telemetry registry (the
+``serving_*`` families aggregate across the fleet exactly as a
+Prometheus scrape of N processes would after a sum) and ONE
+``RequestTracer`` ring, so ``/debug/trace?id=`` follows a request
+across replicas by its PR 10 id; the router adds its own ``router_*``
+series (requests routed, affinity hits, spills, readmissions,
+rebalances, live-replica and per-replica queue-depth gauges).
+Host-side bookkeeping only — no device reads, no wall clocks.
+"""
+from __future__ import annotations
+
+from collections import deque
+
+from torchbooster_tpu.observability import get_registry
+from torchbooster_tpu.serving.batcher import ContinuousBatcher, Request
+from torchbooster_tpu.serving.router.replica import (
+    InProcessReplica,
+    Replica,
+)
+from torchbooster_tpu.serving.router.routing import (
+    RoutingPolicy,
+    make_routing,
+)
+
+__all__ = ["EngineFleet"]
+
+
+class EngineFleet:
+    """The fleet front door's core (see module docstring).
+
+    ``replicas`` is a non-empty list of :class:`Replica` (or bare
+    ``ContinuousBatcher``s, wrapped in :class:`InProcessReplica`
+    automatically); all replicas must share one scheduler-policy
+    table (the fleet-level validate/deadline surface is
+    ``replicas[0]``'s policy). ``routing`` is a
+    :class:`RoutingPolicy` or its YAML name."""
+
+    def __init__(self, replicas: list, routing=None, *,
+                 rebalance_queue: int = 0, rebalance_after: int = 8):
+        if not replicas:
+            raise ValueError("EngineFleet needs at least one replica")
+        wrapped: list[Replica] = []
+        for i, rep in enumerate(replicas):
+            if isinstance(rep, ContinuousBatcher):
+                rep = InProcessReplica(i, rep)
+            if not isinstance(rep, Replica):
+                raise TypeError(
+                    f"replica {i} must be a Replica or a "
+                    f"ContinuousBatcher, got {type(rep).__name__}")
+            rep.replica_id = i
+            wrapped.append(rep)
+        if rebalance_queue < 0:
+            raise ValueError(
+                f"rebalance_queue must be >= 0 (0 = off), got "
+                f"{rebalance_queue}")
+        if rebalance_after < 1:
+            raise ValueError(
+                f"rebalance_after must be >= 1, got {rebalance_after}")
+        self.replicas = wrapped
+        if routing is None:
+            routing = "affinity"
+        if isinstance(routing, str):
+            routing = make_routing(routing)
+        if not isinstance(routing, RoutingPolicy):
+            raise TypeError(
+                f"routing must be a RoutingPolicy or its name, got "
+                f"{type(routing).__name__}")
+        self.routing = routing
+        self.rebalance_queue = int(rebalance_queue)
+        self.rebalance_after = int(rebalance_after)
+        # the fleet-level scheduler-policy surface (validate, retry
+        # pricing, deadline lookup): the replicas share one class
+        # table by construction (ServingConfig.make passes one policy
+        # object to every batcher)
+        self.policy = self.replicas[0].batcher.policy \
+            if isinstance(self.replicas[0], InProcessReplica) \
+            else None
+        self.page_size = (
+            self.replicas[0].batcher.engine.page_size
+            if isinstance(self.replicas[0], InProcessReplica) else 1)
+        # thread-safe inboxes, the batcher discipline: the event loop
+        # submits/cancels while the pump thread steps
+        self._inbox_submit: deque[Request] = deque()
+        self._inbox_cancel: deque[Request] = deque()
+        # arrival-ordered admission buffer (routed at step time) and
+        # request -> replica ownership for cancel routing
+        self._pending: list[Request] = []
+        self._owner: dict[int, Replica] = {}
+        self._session = False
+        self._t0 = 0.0
+        self._hot_streak = 0
+        # router session stats (the metrics-dict "router" block)
+        self.n_routed = 0
+        self.n_affinity_hits = 0
+        self.n_spills = 0
+        self.n_readmitted = 0
+        self.n_rebalanced = 0
+        self.n_fleet_cancelled = 0
+        # the determinism pin's observable: (request_id, replica_id)
+        # in routing order — identical across replays of one workload
+        self.assignment_log: list[tuple[str, int]] = []
+        self.last_error: BaseException | None = None
+        self._inst: dict | None = None
+
+    # ---- clock plumbing (replay swaps it, every replica follows) --
+    @property
+    def clock(self):
+        return self.replicas[0].batcher.clock
+
+    @clock.setter
+    def clock(self, fn) -> None:
+        for rep in self.replicas:
+            rep.batcher.clock = fn
+
+    # ---- probe surface -------------------------------------------
+    @property
+    def live_replicas(self) -> list:
+        return [r for r in self.replicas if r.alive]
+
+    @property
+    def n_live(self) -> int:
+        return len(self.live_replicas)
+
+    @property
+    def queue_depth(self) -> int:
+        return (len(self._inbox_submit) + len(self._pending)
+                + sum(r.queue_depth for r in self.live_replicas))
+
+    @property
+    def has_work(self) -> bool:
+        return bool(self._inbox_submit or self._inbox_cancel
+                    or self._pending
+                    or any(r.has_work for r in self.live_replicas))
+
+    @property
+    def session_active(self) -> bool:
+        return self._session
+
+    @property
+    def occupancy(self) -> float:
+        live = self.live_replicas
+        if not live:
+            return 1.0
+        return max(r.batcher.occupancy for r in live)
+
+    @property
+    def est_step_s(self) -> float:
+        live = self.live_replicas
+        if not live:
+            return 0.0
+        return sum(r.est_step_s for r in live) / len(live)
+
+    @property
+    def engine(self):
+        """A REPRESENTATIVE engine (geometry/backpressure pricing —
+        all replicas are built identical); never a place to mutate
+        fleet state through."""
+        live = self.live_replicas
+        return (live[0] if live else self.replicas[0]).batcher.engine
+
+    @property
+    def tracer(self):
+        """The shared request tracer (ServingConfig.make hands one
+        tracer to every replica so /debug/trace follows a request
+        across the fleet)."""
+        return self.replicas[0].batcher.tracer
+
+    @property
+    def flight(self):
+        """Replica 0's flight ring (the front door's crash-dump hook;
+        per-replica rings are in :meth:`debug_fleet`)."""
+        return self.replicas[0].batcher.flight
+
+    def session_now(self) -> float:
+        if not self._session:
+            raise RuntimeError("no active fleet session")
+        return self.clock() - self._t0
+
+    def readiness(self) -> dict:
+        """Fleet readiness: the aggregate of every live replica's
+        :meth:`ContinuousBatcher.readiness` payload plus per-replica
+        rows — the ``GET /healthz?full=1`` body for a fleet-fronted
+        server, and exactly what the router's load scorer reads."""
+        rows = [r.readiness() for r in self.replicas]
+        live = [row for row, rep in zip(rows, self.replicas)
+                if rep.alive]
+        return {
+            "status": "ok" if live else "dead",
+            "replicas_live": len(live),
+            "replicas_total": len(self.replicas),
+            "queue_depth": self.queue_depth,
+            "pages_free": sum(row["pages_free"] for row in live),
+            "pages_cached": sum(row["pages_cached"] for row in live),
+            "inflight": sum(row["inflight"] for row in live),
+            "occupancy": round(self.occupancy, 4),
+            "est_step_s": round(self.est_step_s, 6),
+            "replicas": rows,
+        }
+
+    # ---- session lifecycle ---------------------------------------
+    def start_session(self) -> None:
+        if self._session:
+            raise RuntimeError(
+                "a session is already active on this fleet")
+        for rep in self.replicas:
+            if not rep.alive:
+                raise RuntimeError(
+                    f"replica {rep.replica_id} is dead; build a fresh "
+                    "fleet (dead replicas never resurrect mid-object)")
+            rep.batcher.start_session()
+        self._inbox_submit.clear()
+        self._inbox_cancel.clear()
+        self._pending.clear()
+        self._owner.clear()
+        self.routing.reset()
+        self._hot_streak = 0
+        self.n_routed = self.n_affinity_hits = self.n_spills = 0
+        self.n_readmitted = self.n_rebalanced = 0
+        self.n_fleet_cancelled = 0
+        self.assignment_log = []
+        self.last_error = None
+        self._t0 = self.clock()
+        reg = get_registry()
+        self._inst = {
+            "routed": reg.counter(
+                "router_requests_total",
+                "requests routed to a replica (labels replica, "
+                "policy)"),
+            "aff_hits": reg.counter(
+                "router_affinity_hits_total",
+                "requests routed to their prefix-affinity replica"),
+            "spills": reg.counter(
+                "router_spills_total",
+                "hot-prefix requests spilled off their affinity "
+                "replica by the load threshold"),
+            "readmit": reg.counter(
+                "router_readmissions_total",
+                "requests re-admitted on another replica (labels "
+                "reason=death|rebalance)"),
+            "rebalanced": reg.counter(
+                "router_rebalanced_total",
+                "queued requests migrated off a sustained hot-spot"),
+            "live": reg.gauge(
+                "router_replicas_live",
+                "replicas currently alive in the fleet"),
+            "depth": reg.gauge(
+                "router_queue_depth",
+                "per-replica queue depth (label replica)"),
+        }
+        self._inst["live"].set(self.n_live)
+        self._session = True
+
+    def finish_session(self) -> dict:
+        if not self._session:
+            raise RuntimeError("no active fleet session")
+        self._session = False
+        per_replica: list[dict] = []
+        for rep in self.replicas:
+            try:
+                per_replica.append(rep.batcher.finish_session())
+            except Exception:  # noqa: BLE001 — a dead replica's
+                # session is best-effort post-mortem; the survivors'
+                # numbers (and the fleet merge) must still land
+                per_replica.append({})
+        self._inst["live"].set(self.n_live)
+        return self._merge_metrics(per_replica)
+
+    # ---- external driver surface ---------------------------------
+    def submit(self, req: Request, arrival: float | None = None) -> None:
+        """Thread-safe enqueue into the fleet admission buffer; the
+        request routes to a replica at its arrival, on the next
+        :meth:`step`. Raises (in the caller) when the request can
+        never fit a replica's pool or its priority class is unknown —
+        the front door maps that to HTTP 400, same as the
+        single-batcher path."""
+        if not self._session:
+            raise RuntimeError(
+                "no active session: start_session() first")
+        live = self.live_replicas
+        if not live:
+            raise RuntimeError("no live replicas")
+        live[0].batcher._check_fits(req)
+        if self.policy is not None:
+            self.policy.validate(req)
+        req.arrival = (self.clock() - self._t0) if arrival is None \
+            else arrival
+        self._inbox_submit.append(req)
+
+    def cancel(self, req: Request) -> None:
+        """Thread-safe cancellation: drained at the next :meth:`step`
+        — a still-pending request cancels at the fleet level, a
+        routed one through its owning replica's abort paths."""
+        self._inbox_cancel.append(req)
+
+    def kill(self, replica_id: int) -> int:
+        """Force one replica down (the failure-injection surface the
+        replica-death tests and the ops runbook use): marks it dead,
+        drains its queued + in-flight requests WITHOUT touching its
+        engine, and re-admits them through the router. Returns how
+        many requests were re-admitted."""
+        rep = self.replicas[replica_id]
+        if not rep.alive:
+            return 0
+        return self._bury(rep, reason="death")
+
+    # ---- internals -----------------------------------------------
+    def _bury(self, rep: Replica, reason: str) -> int:
+        rep.alive = False
+        orphans = rep.drain_unfinished(retire_seated=False)
+        for req in orphans:
+            self._owner.pop(id(req), None)
+            self._pending.append(req)
+        self.n_readmitted += len(orphans)
+        if self._inst is not None:
+            self._inst["live"].set(self.n_live)
+            if orphans:
+                self._inst["readmit"].inc(len(orphans), reason=reason)
+        return len(orphans)
+
+    def _route_arrivals(self, now: float) -> None:
+        if not self._pending:
+            return
+        live = self.live_replicas
+        if not live:
+            return
+        # ONE partition pass (removing due items one-by-one would be
+        # quadratic in the buffer depth on this step-cadence path)
+        due = [r for r in self._pending if r.arrival <= now]
+        if not due:
+            return
+        self._pending = [r for r in self._pending if r.arrival > now]
+        # (arrival, request_id) order: the admission buffer's walk is
+        # part of the pinned deterministic decision sequence
+        due.sort(key=lambda r: (r.arrival, r.request_id))
+        for req in due:
+            rid = self.routing.choose(req, live, self)
+            rep = self.replicas[rid]
+            rep.submit(req, arrival=req.arrival)
+            self._owner[id(req)] = rep
+            self.n_routed += 1
+            self.assignment_log.append((req.request_id, rid))
+            self._inst["routed"].inc(replica=str(rid),
+                                     policy=self.routing.name)
+            if getattr(self.routing, "last_affinity_hit", False):
+                self.n_affinity_hits += 1
+                self._inst["aff_hits"].inc()
+            if getattr(self.routing, "last_spill", False):
+                self.n_spills += 1
+                self._inst["spills"].inc()
+
+    def _drain_cancels(self, events: list) -> None:
+        while self._inbox_cancel:
+            req = self._inbox_cancel.popleft()
+            rep = self._owner.get(id(req))
+            if rep is not None:
+                rep.cancel(req)
+                continue
+            pending = next((r for r in self._pending if r is req), None)
+            if pending is None or req.finished_at is not None:
+                continue            # unknown/finished: benign race
+            self._pending.remove(req)
+            req.cancelled = True
+            req.finished_at = self.clock() - self._t0
+            req.finish_reason = "cancelled"
+            self.n_fleet_cancelled += 1
+            # the single-batcher cancel path's observability, one
+            # level up: the tracer lifecycle event and (under an SLO
+            # policy) the per-class cancel counter must not depend on
+            # WHERE in the routing pipeline the cancel caught up
+            if self.tracer.enabled:
+                self.tracer.emit(req.request_id, "cancelled",
+                                 n_tokens=0)
+            if self.policy is not None and self.policy.slo:
+                get_registry().counter(
+                    "serving_slo_cancelled_total",
+                    "requests cancelled by the client (per class)"
+                ).inc(cls=self.policy.cls_of(req).name)
+            events.append((req, []))
+
+    def _rebalance(self) -> None:
+        """Sustained hot-spot relief: after ``rebalance_after``
+        consecutive steps with the deepest live queue more than
+        ``rebalance_queue`` over the shallowest, migrate QUEUED
+        requests (no engine state — the cheap end of the
+        readmission-cost scale) off the hot replica until the gap
+        closes."""
+        if self.rebalance_queue <= 0 or self.n_live < 2:
+            return
+        live = self.live_replicas
+        depths = {r.replica_id: r.queue_depth for r in live}
+        hot = max(live, key=lambda r: (depths[r.replica_id],
+                                       r.replica_id))
+        gap = depths[hot.replica_id] - min(depths.values())
+        if gap <= self.rebalance_queue:
+            self._hot_streak = 0
+            return
+        self._hot_streak += 1
+        if self._hot_streak < self.rebalance_after:
+            return
+        self._hot_streak = 0
+        moved = hot.batcher.drain_queued(max(gap // 2, 1))
+        others = [r for r in live if r is not hot]
+        for req in moved:
+            self._owner.pop(id(req), None)
+            best = min(others, key=lambda r: (r.queue_depth,
+                                              r.replica_id))
+            best.submit(req, arrival=req.arrival)
+            self._owner[id(req)] = best
+            self.n_rebalanced += 1
+            self.n_readmitted += 1
+            self._inst["rebalanced"].inc()
+            self._inst["readmit"].inc(reason="rebalance")
+
+    def step(self) -> list:
+        """ONE fleet iteration: drain inboxes, route due arrivals,
+        step every live replica once (collecting their token events
+        in replica order), bury any replica whose step raises
+        (re-admitting its requests), then the hot-spot check. Raises
+        only when the LAST replica dies."""
+        if not self._session:
+            raise RuntimeError(
+                "no active session: start_session() first")
+        events: list = []
+        # submits land in the admission buffer BEFORE cancels drain
+        # (the batcher's own inbox ordering): a request submitted and
+        # then cancelled between two fleet steps must be findable in
+        # _pending, or its cancel would silently drop
+        while self._inbox_submit:
+            self._pending.append(self._inbox_submit.popleft())
+        self._drain_cancels(events)
+        now = self.clock() - self._t0
+        self._route_arrivals(now)
+        for rep in self.replicas:
+            if not rep.alive:
+                continue
+            try:
+                events.extend(rep.step())
+            except Exception as exc:  # noqa: BLE001 — replica death
+                # is a fleet-survivable event; only a fleet with no
+                # survivors propagates it
+                self.last_error = exc
+                self._bury(rep, reason="death")
+                if not self.live_replicas:
+                    raise
+        # ownership ends with the request: popping terminal entries
+        # bounds _owner by in-flight work AND closes the stale-id
+        # window (id() of a collected Request can be reused — a live
+        # entry under that address would misroute a later cancel)
+        for req, _ in events:
+            if req.finished_at is not None:
+                root = req.parent if req.parent is not None else req
+                family = root.branches or [root]
+                if all(r.finished_at is not None for r in family):
+                    # the WHOLE family: readmitted branch children
+                    # get their own _owner entries when re-routed,
+                    # and a leaked entry under a reused id() would
+                    # misroute a later request's cancel
+                    for r in family:
+                        self._owner.pop(id(r), None)
+        self._rebalance()
+        for rep in self.replicas:
+            self._inst["depth"].set(
+                rep.queue_depth if rep.alive else 0,
+                replica=str(rep.replica_id))
+        return events
+
+    # ---- introspection -------------------------------------------
+    def debug_snapshot(self, timeline_tail: int = 20) -> dict:
+        """The ``/debug/requests`` payload for a fleet: every
+        replica's snapshot merged, requests tagged with their replica
+        (fleet-pending requests appear as ``replica: null``). Runs on
+        the pump thread, like the single-batcher version."""
+        out = {"active_session": self._session,
+               "tracing_enabled": self.tracer.enabled,
+               "queue_depth": self.queue_depth,
+               "replicas_live": self.n_live,
+               "requests": []}
+        for req in self._pending:
+            out["requests"].append({
+                "request_id": req.request_id, "state": "routing",
+                "replica": None, "priority": req.priority,
+                "prompt_len": int(req.base_len),
+                "arrival_s": round(req.arrival, 6)})
+        for rep in self.replicas:
+            if not rep.alive:
+                continue
+            snap = rep.batcher.debug_snapshot(
+                timeline_tail=timeline_tail)
+            for row in snap["requests"]:
+                row["replica"] = rep.replica_id
+                out["requests"].append(row)
+        return out
+
+    def debug_fleet(self) -> dict:
+        """The ``/debug/engine`` payload for a fleet: router stats +
+        one row per replica (alive flag, engine/pool stats, its
+        flight-recorder tail) — the per-replica rows the flight dump
+        grows in fleet mode."""
+        rows = []
+        for rep in self.replicas:
+            flight = rep.batcher.flight
+            row = {
+                "replica": rep.replica_id,
+                "alive": rep.alive,
+                "queue_depth": rep.queue_depth if rep.alive else 0,
+                "flight": {
+                    "n_recorded": flight.n_recorded,
+                    "capacity": flight.capacity,
+                    "records": flight.tail(32),
+                    "anomalies": flight.anomaly_log(),
+                },
+            }
+            if rep.alive:
+                row["engine"] = rep.batcher.engine.debug_stats()
+                row["occupancy"] = round(rep.batcher.occupancy, 4)
+            rows.append(row)
+        return {"router": self.router_stats(), "replicas": rows}
+
+    def router_stats(self) -> dict:
+        return {
+            "policy": self.routing.name,
+            "n_replicas": len(self.replicas),
+            "replicas_live": self.n_live,
+            "n_routed": self.n_routed,
+            "n_affinity_hits": self.n_affinity_hits,
+            "n_spills": self.n_spills,
+            "n_readmitted": self.n_readmitted,
+            "n_rebalanced": self.n_rebalanced,
+            "n_pending": len(self._pending),
+        }
+
+    # ---- metrics merge -------------------------------------------
+    @staticmethod
+    def _wmean(pairs: list) -> float:
+        """Weight-averaged mean over (value, weight) pairs (0.0 when
+        nothing weighed in)."""
+        total = sum(w for _, w in pairs)
+        if total <= 0:
+            return 0.0
+        return sum(v * w for v, w in pairs) / total
+
+    def _merge_metrics(self, per_replica: list) -> dict:
+        """One fleet metrics dict from the replicas' session dicts:
+        counters sum, throughputs sum (parallel replicas), the
+        elapsed window is the longest replica's, latency means are
+        completion-weighted and percentiles conservative (max) —
+        plus the per-replica dicts and the router block verbatim."""
+        live = [m for m in per_replica if m]
+        get = lambda m, k: m.get(k, 0) or 0
+        weights = [(m, max(get(m, "n_requests"), 0)) for m in live]
+        elapsed = max((get(m, "elapsed_s") for m in live), default=0.0)
+        new_tokens = sum(get(m, "new_tokens") for m in live)
+        # UNIQUE requests offered: a death/rebalance readmission
+        # routes the same request twice, but it is still one request
+        n_unique = len({rid for rid, _ in self.assignment_log})
+        merged = {
+            "n_requests": n_unique + self.n_fleet_cancelled,
+            "new_tokens": new_tokens,
+            "elapsed_s": round(elapsed, 4),
+            "decode_tok_s": round(
+                sum(get(m, "decode_tok_s") for m in live), 1),
+            "total_tok_s": round(
+                new_tokens / max(elapsed, 1e-9), 1),
+            "latency_mean_s": round(self._wmean(
+                [(get(m, "latency_mean_s"), w)
+                 for m, w in weights]), 4),
+            "latency_p95_s": round(max(
+                (get(m, "latency_p95_s") for m in live),
+                default=0.0), 4),
+            "ttft_mean_s": round(self._wmean(
+                [(get(m, "ttft_mean_s"), w) for m, w in weights]), 4),
+            "n_admissions": sum(get(m, "n_admissions") for m in live),
+            "n_preemptions": sum(get(m, "n_preemptions")
+                                 for m in live),
+            "n_prefill_chunks": sum(get(m, "n_prefill_chunks")
+                                    for m in live),
+            "prefix_hit_pages": sum(get(m, "prefix_hit_pages")
+                                    for m in live),
+            "n_shed": sum(get(m, "n_shed") for m in live),
+            "n_cancelled": (sum(get(m, "n_cancelled") for m in live)
+                            + self.n_fleet_cancelled),
+            "deadline_hit_rate": round(self._wmean(
+                [(get(m, "deadline_hit_rate"), w)
+                 for m, w in weights]), 4),
+            "router": self.router_stats(),
+            "replicas": per_replica,
+        }
+        classes: dict = {}
+        for m in live:
+            for name, blk in (m.get("classes") or {}).items():
+                agg = classes.setdefault(name, {
+                    "n_requests": 0, "n_completed": 0, "n_shed": 0,
+                    "ttft_p50_s": 0.0, "ttft_p99_s": 0.0,
+                    "tpot_p50_s": 0.0, "tpot_p99_s": 0.0})
+                for key in ("n_requests", "n_completed", "n_shed"):
+                    agg[key] += blk.get(key, 0)
+                for key in ("ttft_p50_s", "ttft_p99_s",
+                            "tpot_p50_s", "tpot_p99_s"):
+                    agg[key] = max(agg[key], blk.get(key) or 0.0)
+        merged["classes"] = classes
+        return merged

@@ -1,0 +1,150 @@
+"""The replica boundary: what the fleet router knows about one engine.
+
+A replica is ONE pumpable serving stack — today an in-process
+:class:`~torchbooster_tpu.serving.batcher.ContinuousBatcher` stepped
+by the fleet's own loop, later (the ROADMAP item-2 stretch) a socket
+to a batcher pumping in another process or on another host. The
+router must not care which, so everything it consumes is declared
+here as the :class:`Replica` surface:
+
+- **offer/withdraw** — ``submit(req, arrival)`` / ``cancel(req)``;
+- **pump** — ``step()`` returning the iteration's token events (the
+  in-process replica IS the batcher ``step()``; a socket replica
+  would drain a stream of remote events here);
+- **probe** — ``readiness()``, the SAME JSON payload the front
+  door's ``GET /healthz?full=1`` serves (queue depth, free/cached
+  pages, in-flight count, the EWMA step estimate), so the router's
+  load scorer and an external health checker read one contract;
+- **score inputs** — ``queue_depth`` / ``inflight`` /
+  ``est_step_s`` / ``est_chunk_s``: the least-expected-slack load
+  balancer's whole input set, every one a host-side counter (a
+  remote replica ships them in its readiness payload — nothing here
+  may ever require reaching into an engine);
+- **drain** — ``drain_unfinished()``, the readmission path: every
+  queued/seated request leaves with its generated tokens folded into
+  its prompt (the batcher's preemption fold), ready to be re-offered
+  to a sibling replica.
+
+Death is a STATE, not an exception: the fleet marks a replica dead
+when its ``step()`` raises (or ``EngineFleet.kill`` forces it) and
+never steps it again; ``alive`` gates routing. Host-side bookkeeping
+only — nothing in this module touches the device or a wall clock.
+
+Scope honesty: the surface above is the ROUTING core — every
+decision input and the readmission path. The fleet's LIFECYCLE
+plumbing (session open/close, replay clock injection, the
+debug/trace/flight merges, hot-spot queue drains) still reaches
+through ``InProcessReplica.batcher`` today; promoting those onto
+this surface is the remaining work when the first socket-backed
+replica lands, and the routing layer itself will not change.
+"""
+from __future__ import annotations
+
+from torchbooster_tpu.serving.batcher import ContinuousBatcher, Request
+
+__all__ = ["InProcessReplica", "Replica"]
+
+
+class Replica:
+    """Abstract replica surface (see module docstring). Subclasses
+    implement every method; the base exists so a socket-backed
+    replica can slot in without the router changing."""
+
+    replica_id: int = -1
+    alive: bool = True
+
+    # ---- offer/withdraw ------------------------------------------
+    def submit(self, req: Request, arrival: float) -> None:
+        raise NotImplementedError
+
+    def cancel(self, req: Request) -> None:
+        raise NotImplementedError
+
+    # ---- pump ----------------------------------------------------
+    def step(self) -> list:
+        raise NotImplementedError
+
+    # ---- probe / score inputs ------------------------------------
+    @property
+    def queue_depth(self) -> int:
+        raise NotImplementedError
+
+    @property
+    def inflight(self) -> int:
+        raise NotImplementedError
+
+    @property
+    def est_step_s(self) -> float:
+        raise NotImplementedError
+
+    @property
+    def est_chunk_s(self) -> float:
+        raise NotImplementedError
+
+    @property
+    def has_work(self) -> bool:
+        raise NotImplementedError
+
+    def readiness(self) -> dict:
+        raise NotImplementedError
+
+    # ---- readmission ---------------------------------------------
+    def drain_unfinished(self, retire_seated: bool) -> list:
+        raise NotImplementedError
+
+
+class InProcessReplica(Replica):
+    """A :class:`ContinuousBatcher` behind the replica boundary — the
+    fleet's own loop pumps it (one ``step()`` per fleet step, so N
+    in-process replicas model N chips stepping in parallel: under the
+    replay harness's virtual clock one fleet iteration costs one
+    ``step_dt`` regardless of N, exactly as concurrent hardware
+    would)."""
+
+    def __init__(self, replica_id: int, batcher: ContinuousBatcher):
+        if not isinstance(batcher, ContinuousBatcher):
+            raise TypeError(
+                f"InProcessReplica wraps a ContinuousBatcher, got "
+                f"{type(batcher).__name__}")
+        self.replica_id = int(replica_id)
+        self.batcher = batcher
+        self.alive = True
+
+    def submit(self, req: Request, arrival: float) -> None:
+        self.batcher.submit(req, arrival=arrival)
+
+    def cancel(self, req: Request) -> None:
+        self.batcher.cancel(req)
+
+    def step(self) -> list:
+        return self.batcher.step()
+
+    @property
+    def queue_depth(self) -> int:
+        return self.batcher.queue_depth
+
+    @property
+    def inflight(self) -> int:
+        return self.batcher.inflight
+
+    @property
+    def est_step_s(self) -> float:
+        return self.batcher.est_step_s
+
+    @property
+    def est_chunk_s(self) -> float:
+        return self.batcher.est_chunk_s
+
+    @property
+    def has_work(self) -> bool:
+        return self.batcher.has_work
+
+    def readiness(self) -> dict:
+        out = self.batcher.readiness()
+        out["replica"] = self.replica_id
+        out["alive"] = self.alive
+        return out
+
+    def drain_unfinished(self, retire_seated: bool) -> list:
+        return self.batcher.drain_unfinished(
+            retire_seated=retire_seated)

@@ -1,0 +1,183 @@
+"""Routing policies: which replica serves a request.
+
+Mirrors the scheduler-policy split one level up: the fleet
+(router/fleet.py) owns the mechanism — admission buffering, stepping,
+readmission — and delegates ONE decision here: given an arrived
+request and the live replicas, pick the replica index. Two policies:
+
+- :class:`RoundRobinRouting` — the zero-knowledge control: live
+  replicas in a fixed cycle. What every comparison is measured
+  against.
+- :class:`AffinityRouting` — prefix-affinity + SLO-aware spill. The
+  affinity key is the PAGE-ALIGNED prompt prefix (the same full-page
+  token runs the PR 4 prefix index keys by, capped at
+  ``affinity_pages``): requests sharing a system prompt hash to the
+  same key, the key maps (first come, least-loaded) to a replica,
+  and every later holder of the key lands where those pages are
+  already warm — a routing-level cache hint that turns the
+  per-replica prefix cache into a fleet-wide one without moving a
+  byte of KV. A hot prefix must not melt its home replica: when the
+  mapped replica's queue sits ``spill_queue`` deeper than the
+  shallowest live one, the request SPILLS to the least-loaded
+  replica instead (the map is untouched — the spill is load
+  protection, not a migration). Keyless requests (prompts under one
+  full page) and spills route by **least expected slack**: the
+  replica minimizing estimated time-to-first-token (queued work ×
+  the replica's measured EWMA chunk/step estimates — the same
+  quantities the PR 7 SLO policy's slack math uses), so an
+  interactive request lands where its deadline has the most air.
+
+Every input is a host-side integer/float the replica surface exposes
+(queue depth, in-flight count, EWMA estimates) and ties break on the
+replica index — a routing decision is a pure function of
+(request, replica states), which is what makes multi-replica replay
+deterministic. No clocks, no device reads, no randomness.
+"""
+from __future__ import annotations
+
+import zlib
+
+import numpy as np
+
+__all__ = ["AffinityRouting", "RoundRobinRouting", "RoutingPolicy",
+           "make_routing", "prefix_affinity_key"]
+
+
+def prefix_affinity_key(prompt: np.ndarray, page_size: int,
+                        affinity_pages: int) -> int | None:
+    """The request's affinity key: crc32 over its leading full pages
+    (at most ``affinity_pages`` of them — enough to separate tenants'
+    system prompts without hashing whole contexts), or ``None`` when
+    the prompt has no full page to key by. Page alignment matches the
+    prefix index exactly: two prompts sharing a key share at least
+    that many cached pages on whatever replica served either first."""
+    n_full = len(prompt) // page_size
+    if n_full < 1:
+        return None
+    take = min(n_full, max(affinity_pages, 1)) * page_size
+    head = np.ascontiguousarray(prompt[:take], np.int32)
+    return zlib.crc32(head.tobytes()) & 0xFFFFFFFF
+
+
+class RoutingPolicy:
+    """Routing hook surface: ``choose`` returns a replica index from
+    ``live`` (non-empty, ascending). ``reset()`` clears per-session
+    state at fleet session start so replays are reproducible."""
+
+    name = "round_robin"
+
+    def reset(self) -> None:
+        pass
+
+    def choose(self, req, live: list, fleet) -> int:
+        raise NotImplementedError
+
+
+class RoundRobinRouting(RoutingPolicy):
+    """Live replicas in a fixed cycle — the control arm."""
+
+    def __init__(self) -> None:
+        self._next = 0
+
+    def reset(self) -> None:
+        self._next = 0
+
+    def choose(self, req, live: list, fleet) -> int:
+        pick = live[self._next % len(live)]
+        self._next += 1
+        return pick.replica_id
+
+
+def _load_score(replica, req) -> float:
+    """Least-expected-slack load score: a proxy for the seconds until
+    ``req`` would see its first token on this replica — queued +
+    in-flight work at the replica's measured EWMA cadence (the same
+    estimates the PR 7 SLO policy's slack math consumes). Cold
+    replicas (nothing measured yet) score by raw backlog so the very
+    first requests still spread; minimizing the score maximizes the
+    request's remaining deadline slack."""
+    est = max(replica.est_chunk_s, replica.est_step_s)
+    backlog = replica.queue_depth + replica.inflight
+    if est <= 0.0:
+        return 1.0 * backlog
+    return backlog * est
+
+
+class AffinityRouting(RoutingPolicy):
+    """Prefix-affinity with load spill (see module docstring).
+
+    ``affinity_pages`` caps the pages hashed into the key;
+    ``spill_queue`` is the queue-depth excess over the shallowest
+    live replica beyond which the mapped replica is considered hot
+    and the request spills to the least-loaded one."""
+
+    name = "affinity"
+
+    def __init__(self, affinity_pages: int = 2, spill_queue: int = 4):
+        if affinity_pages < 1:
+            raise ValueError(
+                f"affinity_pages must be >= 1, got {affinity_pages}")
+        if spill_queue < 1:
+            raise ValueError(
+                f"spill_queue must be >= 1 (0 would spill every "
+                f"request off its warm replica), got {spill_queue}")
+        self.affinity_pages = int(affinity_pages)
+        self.spill_queue = int(spill_queue)
+        self._map: dict[int, int] = {}
+        # per-choice verdicts the fleet's counters read back (the
+        # choose() return is just an index; the router metrics want
+        # to know WHY)
+        self.last_affinity_hit = False
+        self.last_spill = False
+
+    def reset(self) -> None:
+        self._map.clear()
+        self.last_affinity_hit = False
+        self.last_spill = False
+
+    def _least_loaded(self, req, live: list) -> int:
+        # min score, ties toward the lower replica id (determinism)
+        best = min(live, key=lambda r: (_load_score(r, req),
+                                        r.replica_id))
+        return best.replica_id
+
+    def choose(self, req, live: list, fleet) -> int:
+        self.last_affinity_hit = False
+        self.last_spill = False
+        key = prefix_affinity_key(
+            req.prompt, fleet.page_size, self.affinity_pages)
+        if key is None:
+            return self._least_loaded(req, live)
+        by_id = {r.replica_id: r for r in live}
+        home = self._map.get(key)
+        if home is None or home not in by_id:
+            # first sight of this prefix (or its home died): bind it
+            # to the least-loaded live replica — the pages warm THERE
+            home = self._least_loaded(req, live)
+            self._map[key] = home
+            return home
+        # backlog = queued + in-flight: a replica with every slot
+        # busy and an empty queue is NOT idle — the spill check must
+        # read the same load proxy the scorer does, or a hot home
+        # replica hides behind its seated work
+        busy = {r.replica_id: r.queue_depth + r.inflight for r in live}
+        if busy[home] - min(busy.values()) >= self.spill_queue:
+            # hot prefix: protect the home replica's queue; the map
+            # keeps pointing home so traffic returns once it drains
+            self.last_spill = True
+            return self._least_loaded(req, live)
+        self.last_affinity_hit = True
+        return home
+
+
+def make_routing(policy: str, affinity_pages: int = 2,
+                 spill_queue: int = 4) -> RoutingPolicy:
+    """Build a routing policy by YAML name (``serving.router.policy``)."""
+    if policy == "round_robin":
+        return RoundRobinRouting()
+    if policy == "affinity":
+        return AffinityRouting(affinity_pages=affinity_pages,
+                               spill_queue=spill_queue)
+    raise ValueError(
+        f"router.policy must be 'round_robin' or 'affinity', got "
+        f"{policy!r}")
