@@ -123,7 +123,8 @@ def main() -> None:
     # in faster than this table — decode and gpt_chunked_b32 both did):
     # render them raw rather than silently dropping recorded evidence
     multi_key = ("decode", "decode_int8", "cifar_acc", "comms",
-                 "comms_cpu8", "serve_prefix", "serve_prefix_int8",
+                 "comms_cpu8", "zero", "zero_cpu8",
+                 "serve_prefix", "serve_prefix_int8",
                  "serve_spec", "serve_spec_int8", "serve_http",
                  "serve_http_prio", "serve_kernel", "serve_kernel_spec",
                  "serve_tp", "serve_tp_pallas",
@@ -475,6 +476,37 @@ def main() -> None:
                      if base and arm != "implicit" else "—")
             mb = r.get(f"comms_mbytes_{arm}", "—")
             print(f"| {arm} | {dt} | {delta} | {mb} |")
+
+    # ZeRO-ladder rows: one line per stage arm (step time, wire MB,
+    # per-replica persistent-state HBM, loss delta vs zero1) plus the
+    # two gates the bench computes (overlap-on <= overlap-off, and
+    # reduce-scatter accounting within 10% of the compiled HLO)
+    for name in ("zero", "zero_cpu8"):
+        e = latest.get(name)
+        if e is None:
+            continue
+        r = e.get("result") or {}
+        base = r.get("zero_step_s_zero1")
+        print(f"\n{name} (N={r.get('zero_n_devices', '?')} replicas, "
+              f"{r.get('zero_n_params', '?')} params, "
+              f"{r.get('zero_n_buckets', '?')} buckets; overlap gate "
+              f"{r.get('zero_overlap_ok', '?')}, accounting gate "
+              f"{r.get('zero_accounting_ok', '?')} "
+              f"[rs ratio {r.get('zero_rs_hlo_ratio', '?')}]):")
+        print("| arm | step s | vs zero1 | wire MB | state MB/replica "
+              "| loss Δ% |")
+        print("|---|---|---|---|---|---|")
+        for arm in ("zero1", "zero2", "zero2_overlap", "zero2_int8",
+                    "zero3"):
+            dt = r.get(f"zero_step_s_{arm}")
+            if dt is None:
+                continue
+            delta = (f"{(dt / base - 1) * 100:+.1f}%"
+                     if base and arm != "zero1" else "—")
+            print(f"| {arm} | {dt} | {delta} "
+                  f"| {r.get(f'zero_mbytes_{arm}', '—')} "
+                  f"| {r.get(f'zero_state_mb_{arm}', '—')} "
+                  f"| {r.get(f'zero_loss_delta_pct_{arm}', '—')} |")
 
 
 if __name__ == "__main__":

@@ -12,6 +12,7 @@ from __future__ import annotations
 from scripts.graftlint.rules.config_doc_drift import ConfigDocDriftRule
 from scripts.graftlint.rules.host_sync import HostSyncRule
 from scripts.graftlint.rules.metric_doc_drift import MetricDocDriftRule
+from scripts.graftlint.rules.overlap_hazard import OverlapHazardRule
 from scripts.graftlint.rules.prng_reuse import PrngReuseRule
 from scripts.graftlint.rules.recompile_hazard import RecompileHazardRule
 from scripts.graftlint.rules.traced_branch import TracedBranchRule
@@ -23,6 +24,7 @@ ALL_RULES = (
     PrngReuseRule(),
     UseAfterDonateRule(),
     TracedBranchRule(),
+    OverlapHazardRule(),
     ConfigDocDriftRule(),
     MetricDocDriftRule(),
 )

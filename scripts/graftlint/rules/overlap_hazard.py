@@ -1,0 +1,301 @@
+"""overlap-hazard rule: gradient collectives that cannot overlap.
+
+Two hazard shapes from the comms/schedule work (the exact class the
+ROADMAP's item-5 note names):
+
+1. **Tail sync** — a ``lax`` collective (``psum`` / ``pmean`` /
+   ``psum_scatter`` / ``all_gather`` / ``all_to_all``) whose operand
+   derives from the output of a whole-model ``jax.value_and_grad`` /
+   ``jax.grad`` call in the same function body. Every gradient byte
+   then waits for the LAST backward op before it moves: the collective
+   is issued after the op(s) that produce everything it consumes, so
+   no backward compute can hide it. The fix is structural — bucket the
+   sync into backward (``comms.schedule``'s per-bucket hooks, or a
+   scan-carried bucket queue) — or suppress with a reason when the
+   serialization is the point (an overlap-off control arm).
+
+2. **Barrier-free narrow transport** — a ``lax`` collective whose
+   operand contains a ``.astype(bf16/f16)`` convert with no
+   ``optimization_barrier`` between the convert and the collective.
+   XLA canonicalizes ``collective(convert(x))`` by sinking the convert
+   PAST the collective and silently ships the wide dtype — the hazard
+   ``comms/quantized.py``'s bf16 path documents and pins with
+   HLO-validated accounting. The barrier is the fix, not a style
+   choice.
+
+Scope limits (documented, like every rule here): gradient taint in (1)
+tracks names bound from immediately-invoked or name-bound
+``value_and_grad``/``grad`` callables and propagates through simple
+assignments within ONE function body (``flat, unravel =
+ravel_pytree(grads)`` keeps the taint); collectives reached through a
+helper function (``reduce_flat(...)``) are that helper's business, and
+an interprocedural version would re-flag every deliberate control arm.
+For ``value_and_grad`` only the gradient element of a two-element
+unpack is tainted (``(loss, aux), grads = ...`` — the loss is pmean'd
+legitimately everywhere); for ``grad`` with a tuple unpack the FIRST
+element is (``grads, aux = ...``).
+"""
+from __future__ import annotations
+
+import ast
+
+from scripts.graftlint.core import FileContext, Finding, Rule
+
+RULE_ID = "overlap-hazard"
+
+_COLLECTIVES = {"psum", "pmean", "psum_scatter", "all_gather",
+                "all_to_all", "pmax", "pmin"}
+_GRAD_FNS = {"grad", "value_and_grad"}
+_NARROW = {"bfloat16", "float16"}
+
+
+def _final_attr(node: ast.AST) -> str | None:
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def _is_lax_collective(func: ast.AST) -> bool:
+    """``lax.psum`` / ``jax.lax.all_gather`` — the base must be (or
+    end in) ``lax`` so a user-defined ``pool.psum`` stays clean."""
+    if not isinstance(func, ast.Attribute) or \
+            func.attr not in _COLLECTIVES:
+        return False
+    base = func.value
+    return _final_attr(base) == "lax"
+
+
+def _is_grad_ref(node: ast.AST) -> bool:
+    """``jax.grad`` / ``jax.value_and_grad`` / bare ``value_and_grad``."""
+    name = _final_attr(node)
+    if name not in _GRAD_FNS:
+        return False
+    if isinstance(node, ast.Attribute):
+        return _final_attr(node.value) == "jax"
+    return True
+
+
+def _is_barrier_call(node: ast.AST) -> bool:
+    return isinstance(node, ast.Call) \
+        and _final_attr(node.func) == "optimization_barrier"
+
+
+def _narrow_astype(node: ast.AST) -> bool:
+    """``x.astype(jnp.bfloat16)`` / ``.astype("bfloat16")``."""
+    if not (isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "astype" and node.args):
+        return False
+    arg = node.args[0]
+    if isinstance(arg, ast.Constant):
+        return arg.value in _NARROW
+    return _final_attr(arg) in _NARROW
+
+
+def _unbarriered_narrow_converts(expr: ast.AST) -> list[ast.AST]:
+    """Narrow astype calls in ``expr`` with NO optimization_barrier
+    ancestor within the expression."""
+    found: list[ast.AST] = []
+
+    def walk(node: ast.AST, barriered: bool) -> None:
+        if _is_barrier_call(node):
+            barriered = True
+        elif _narrow_astype(node) and not barriered:
+            found.append(node)
+        for child in ast.iter_child_nodes(node):
+            walk(child, barriered)
+
+    walk(expr, False)
+    return found
+
+
+class _BodyTaint:
+    """Source-order walk of one function body: seed gradient taint at
+    value_and_grad/grad results, propagate through simple assignments,
+    report lax collectives consuming tainted values."""
+
+    def __init__(self, ctx: FileContext, rule_id: str):
+        self.ctx = ctx
+        self.rule_id = rule_id
+        # names bound to grad/value_and_grad(f) -> which of the two
+        # (their tuple-unpack conventions differ: v&g returns
+        # ((loss, aux), grads), grad(has_aux) returns (grads, aux))
+        self.grad_callables: dict[str, str] = {}
+        self.tainted: set[str] = set()
+        self.findings: list[Finding] = []
+
+    # -- taint helpers --
+
+    def _names_in(self, expr: ast.AST) -> set[str]:
+        return {n.id for n in ast.walk(expr)
+                if isinstance(n, ast.Name)
+                and isinstance(n.ctx, ast.Load)}
+
+    def _expr_tainted(self, expr: ast.AST) -> bool:
+        return bool(self._names_in(expr) & self.tainted)
+
+    def _taint_target(self, target: ast.AST) -> None:
+        for sub in ast.walk(target):
+            if isinstance(sub, ast.Name):
+                self.tainted.add(sub.id)
+
+    def _clear_target(self, target: ast.AST) -> None:
+        for sub in ast.walk(target):
+            if isinstance(sub, ast.Name):
+                self.tainted.discard(sub.id)
+                self.grad_callables.pop(sub.id, None)
+
+    def _is_grad_call(self, call: ast.AST) -> str | None:
+        """'direct' for ``jax.grad(f)(x)``-style immediate invocation
+        or a call of a name previously bound to value_and_grad/grad;
+        the callee kind ('grad'/'value_and_grad') otherwise None."""
+        if not isinstance(call, ast.Call):
+            return None
+        func = call.func
+        if isinstance(func, ast.Call) and _is_grad_ref(func.func):
+            return _final_attr(func.func)
+        if isinstance(func, ast.Name) and func.id in self.grad_callables:
+            return self.grad_callables[func.id]
+        return None
+
+    def _seed_from_assign(self, node: ast.Assign) -> bool:
+        """Register grad-callable bindings and grad-result taint;
+        returns True when handled as a seed."""
+        value = node.value
+        if isinstance(value, ast.Call) and _is_grad_ref(value.func):
+            # grad_fn = jax.value_and_grad(loss_fn, ...)
+            kind = _final_attr(value.func)
+            for target in node.targets:
+                if isinstance(target, ast.Name):
+                    self.grad_callables[target.id] = kind
+            return True
+        kind = self._is_grad_call(value)
+        if kind is None:
+            return False
+        for target in node.targets:
+            if isinstance(target, ast.Tuple) and len(target.elts) == 2:
+                # (loss, aux), grads = value_and_grad(...)  — grads is
+                # the SECOND element; jax.grad(..., has_aux) returns
+                # (grads, aux) — the FIRST
+                pick = target.elts[1] if kind == "value_and_grad" \
+                    else target.elts[0]
+                self._taint_target(pick)
+            else:
+                self._taint_target(target)
+        return True
+
+    # -- the walk --
+
+    def walk(self, node: ast.AST) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            return   # nested bodies get their own walker
+        if isinstance(node, ast.Assign):
+            self.check_expr(node.value)
+            if self._seed_from_assign(node):
+                return
+            propagate = self._expr_tainted(node.value)
+            for target in node.targets:
+                if propagate:
+                    self._taint_target(target)
+                else:
+                    self._clear_target(target)
+            return
+        if isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            if node.value is not None:
+                self.check_expr(node.value)
+                if self._expr_tainted(node.value):
+                    self._taint_target(node.target)
+            return
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.expr):
+                self.check_expr(child)
+            else:
+                self.walk(child)
+
+    def check_expr(self, expr: ast.AST) -> None:
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Lambda):
+                continue
+            if not (isinstance(node, ast.Call)
+                    and _is_lax_collective(node.func)):
+                continue
+            operand = node.args[0] if node.args else None
+            if operand is not None and self._expr_tainted(operand):
+                names = sorted(self._names_in(operand) & self.tainted)
+                self.findings.append(self.ctx.finding(
+                    self.rule_id, node,
+                    f"collective lax.{_final_attr(node.func)} consumes"
+                    f" the whole-model gradient ({', '.join(names)} "
+                    f"comes from value_and_grad) — issued after ALL of"
+                    f" backward, so no compute can hide its bytes; "
+                    f"bucket the sync into backward "
+                    f"(comms.schedule overlap) or suppress with a "
+                    f"reason if this serialization is the control arm"))
+
+    def check_narrow(self, call: ast.Call) -> None:
+        for arg in call.args:
+            for conv in _unbarriered_narrow_converts(arg):
+                self.findings.append(self.ctx.finding(
+                    self.rule_id, conv,
+                    f"bf16/f16 convert feeds lax."
+                    f"{_final_attr(call.func)} without an "
+                    f"optimization_barrier — XLA sinks the convert "
+                    f"past the collective and ships the WIDE dtype; "
+                    f"pin the send side with jax.lax."
+                    f"optimization_barrier(x.astype(...)) (see "
+                    f"comms/quantized.py's bf16 path)"))
+
+
+class OverlapHazardRule(Rule):
+    id = RULE_ID
+    summary = ("a gradient collective that serializes after backward, "
+               "or barrier-free narrow-dtype transport")
+    doc = """\
+Why: the comms schedule's whole value is that gradient bytes move
+WHILE backward still computes (step = max(compute, comms) instead of
+the sum). Two code shapes silently forfeit that:
+
+1. Tail sync — `lax.psum/psum_scatter/all_gather/all_to_all/pmean`
+   applied to the output of `jax.value_and_grad`/`jax.grad`: the
+   collective's operand is the WHOLE gradient, so it is issued after
+   the op that produces everything it consumes and zero backward
+   compute can overlap it. Route the sync through the per-bucket
+   backward hooks (`comms.schedule`, `overlap: true`) — or, when the
+   serialized form is deliberate (an overlap-off control arm),
+   suppress with a written reason.
+
+2. Barrier-free bf16/f16 transport — `lax.<collective>(x.astype(
+   jnp.bfloat16))` without `jax.lax.optimization_barrier` around the
+   convert: XLA's canonicalizer sinks converts past collectives, so
+   the wire silently carries fp32 and the 2x byte saving evaporates
+   (the HLO-validated accounting tests exist precisely because this
+   rewrite is invisible at the jaxpr level).
+
+Scope: taint is per-function-body and flows through simple
+assignments (`flat, unravel = ravel_pytree(grads)` stays tainted);
+helpers that wrap collectives (e.g. `reduce_flat`) are not traced
+into — their call sites pass parameters, not value_and_grad results.
+"""
+
+    def check_file(self, ctx: FileContext) -> list[Finding]:
+        findings: list[Finding] = []
+        bodies: list[list[ast.stmt]] = [ctx.tree.body]
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                bodies.append(node.body)
+        for body in bodies:
+            walker = _BodyTaint(ctx, self.id)
+            for stmt in body:
+                walker.walk(stmt)
+            findings.extend(walker.findings)
+        # narrow-transport check: every collective call site, once
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call) \
+                    and _is_lax_collective(node.func):
+                walker = _BodyTaint(ctx, self.id)
+                walker.check_narrow(node)
+                findings.extend(walker.findings)
+        return findings

@@ -201,6 +201,13 @@ QUEUE: list[tuple[str, str, dict, int]] = [
     # the bytes-ratio + loss-delta claims are measured, not modeled
     ("comms", "comms", {}, 1200),
     ("comms_cpu8", "comms", {"BENCH_COMMS_HOST_DEVICES": "8"}, 1500),
+    # ZeRO-ladder A/B (torchbooster_tpu/comms/schedule): zero1 vs
+    # zero2 (overlap off/on) vs zero2+int8 vs zero3 — step time,
+    # per-replica state HBM, the overlap gate (on <= off) and the
+    # reduce-scatter accounting-vs-HLO gate; same 1-chip-vs-cpu8
+    # split as the comms rows
+    ("zero", "zero", {}, 1200),
+    ("zero_cpu8", "zero", {"BENCH_COMMS_HOST_DEVICES": "8"}, 1500),
     ("gpt_chunked_b32", "gpt",
      {"BENCH_GPT_CHUNKED": "1", "BENCH_GPT_BATCH": "32"}, 1200),
     # the r4 chunked-head win, applied at the length where it should

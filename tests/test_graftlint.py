@@ -862,6 +862,103 @@ def test_traced_branch_suppression_round_trip(tmp_path):
 
 
 # =========================================================================
+# overlap-hazard
+# =========================================================================
+
+def test_overlap_hazard_positive_tail_sync_and_barrier_free_bf16(
+        tmp_path):
+    """Both hazard shapes: a collective consuming the value_and_grad
+    output (taint survives the ravel_pytree unpack and a jnp.pad
+    re-assignment), and a bf16 convert feeding a collective without
+    an optimization_barrier."""
+    result = _scan_fixture(tmp_path, """\
+        import jax
+        import jax.numpy as jnp
+        from jax.flatten_util import ravel_pytree
+
+        def sync_step(loss_fn, params, batch, rng):
+            grad_fn = jax.value_and_grad(loss_fn)
+            loss, grads = grad_fn(params, batch, rng)
+            flat, unravel = ravel_pytree(grads)
+            flat = jnp.pad(flat, (0, 8))
+            red = jax.lax.psum(flat, "dp")
+            return loss, unravel(red)
+
+        def ship_narrow(x, axes):
+            return jax.lax.all_to_all(
+                x.astype(jnp.bfloat16), axes, 0, 0)
+        """, "overlap-hazard")
+    messages = [f.message for f in result.findings]
+    assert any("value_and_grad" in m and "psum" in m for m in messages)
+    assert any("optimization_barrier" in m for m in messages)
+    assert len(result.findings) == 2
+
+
+def test_overlap_hazard_near_miss_stays_silent(tmp_path):
+    """Silent on: the pmean'd LOSS (only the grads element of a
+    value_and_grad unpack is tainted), collectives over activations /
+    parameters, a helper that receives grads as a PARAMETER, and a
+    bf16 convert pinned with optimization_barrier."""
+    result = _scan_fixture(tmp_path, """\
+        import jax
+        import jax.numpy as jnp
+
+        def sync_step(loss_fn, params, batch, rng):
+            grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+            (loss, aux), grads = grad_fn(params, batch, rng)
+            loss = jax.lax.pmean(loss, "dp")
+            synced = reduce_helper(grads)
+            return loss, synced
+
+        def reduce_helper(grads):
+            flat = grads.reshape(-1)
+            return jax.lax.psum(flat, "dp")
+
+        def ship_pinned(x, axes):
+            sent = jax.lax.optimization_barrier(
+                x.astype(jnp.bfloat16))
+            return jax.lax.all_to_all(sent, axes, 0, 0)
+        """, "overlap-hazard")
+    assert not result.findings
+
+
+def test_overlap_hazard_suppression_round_trip(tmp_path):
+    source = """\
+        import jax
+
+        def control_arm(loss_fn, params):
+            grads = jax.grad(loss_fn)(params)
+            return jax.lax.psum(grads, "dp")
+        """
+    bare = _scan_fixture(tmp_path, source, "overlap-hazard")
+    assert len(bare.findings) == 1
+    silenced = _scan_fixture(tmp_path, source, "overlap-hazard",
+                             suppressions="""\
+        # deliberate: the overlap-off control arm IS the serialized sync
+        overlap-hazard pkg/mod.py:jax.lax.psum(grads, "dp")
+        """)
+    assert not silenced.findings
+
+
+def test_overlap_hazard_bound_grad_unpack_convention(tmp_path):
+    """A name bound to ``jax.grad(has_aux=True)`` returns
+    ``(grads, aux)`` — the FIRST unpack element is the gradient
+    (value_and_grad's is the SECOND): the real tail psum on grads is
+    flagged, the legitimate aux pmean stays silent."""
+    result = _scan_fixture(tmp_path, """\
+        import jax
+
+        def sync_step(loss_fn, params):
+            gfn = jax.grad(loss_fn, has_aux=True)
+            grads, metrics = gfn(params)
+            metrics = jax.lax.pmean(metrics, "dp")
+            return jax.lax.psum(grads, "dp"), metrics
+        """, "overlap-hazard")
+    assert len(result.findings) == 1
+    assert "psum" in result.findings[0].message
+
+
+# =========================================================================
 # config-doc-drift
 # =========================================================================
 

@@ -17,6 +17,12 @@ the gradient synchronization an explicit, measured, compressible step:
   optimizer state lives as one flat array sharded over the data axes,
   grads reduce-scatter, each replica updates only its shard, updated
   params all-gather — optimizer-state HBM drops by the DP degree;
+- :mod:`schedule` — the rest of the ladder, declaratively: ZeRO-2
+  (gradients reduce-scattered bucket-by-bucket *during* backward via
+  per-bucket custom_vjp hooks) and ZeRO-3 (params sharded at rest,
+  all-gathered just-in-time in forward), composed with any wire
+  format through one :class:`~torchbooster_tpu.comms.schedule
+  .CommsSchedule` (``stage``/``wire``/``overlap``/``bucket_mb``);
 - :mod:`accounting` — static per-step collective-traffic model
   (per-collective byte breakdown) validated against the collectives
   XLA actually compiled, exported as ``comms_bytes_total`` counters.
@@ -226,9 +232,19 @@ from torchbooster_tpu.comms.zero import (  # noqa: E402
     opt_state_specs,
     padded_size,
 )
+from torchbooster_tpu.comms.schedule import (  # noqa: E402
+    BucketPlan,
+    CommsSchedule,
+    STAGES,
+    WIRES,
+    as_schedule,
+    make_schedule,
+)
 
 __all__ = [
-    "GradComms", "MODES", "dequantize", "init_opt_state",
-    "make_grad_comms", "opt_state_specs", "padded_size", "quantize",
-    "reduce_flat", "step_traffic", "xla_collective_traffic",
+    "BucketPlan", "CommsSchedule", "GradComms", "MODES", "STAGES",
+    "WIRES", "as_schedule", "dequantize", "init_opt_state",
+    "make_grad_comms", "make_schedule", "opt_state_specs",
+    "padded_size", "quantize", "reduce_flat", "step_traffic",
+    "xla_collective_traffic",
 ]

@@ -28,7 +28,7 @@ from __future__ import annotations
 import re
 from typing import Any
 
-__all__ = ["step_traffic", "record_step_traffic",
+__all__ = ["overlap_report", "step_traffic", "record_step_traffic",
            "xla_collective_traffic"]
 
 SCALE_BYTES = 4      # fp32 per-bucket scales
@@ -38,20 +38,42 @@ _WIRE_BYTES = {"fp32": 4.0, "bf16": 2.0}
 
 
 def step_traffic(n_params: int, n_shards: int, mode: str,
-                 zero1: bool, bucket_size: int) -> dict:
+                 zero1: bool, bucket_size: int, stage: int | None = None,
+                 overlap: bool = False, padded: int | None = None
+                 ) -> dict:
     """Per-replica bytes the gradient sync of one train step moves,
     broken down per collective. ``n_params`` is the raw parameter
     count; the model accounts for padding to
     ``n_shards * bucket_size`` and, for int8, the fp32 scale
     sidecars. ``implicit`` mode models the all-reduce XLA inserts on
     its own (fp32 ring) so A/B deltas are computable before flipping
-    the YAML line."""
+    the YAML line.
+
+    ``stage`` prices the full ZeRO ladder (None maps the legacy
+    ``zero1`` flag onto stages 0/1). Stage 2 moves the same bytes as
+    stage 1 with an explicit wire — the reduce-scatter just splits
+    into per-bucket collectives issued during backward (pass the
+    bucket plan's ``padded`` total, which carries per-bucket padding).
+    Stage 3 moves the grad reduce-scatter plus ONE fp32 param
+    all-gather: it happens before forward instead of after the
+    update, and the ``jax.checkpoint`` backward re-gather is CSE'd by
+    XLA while the gathered buffer is live (the HLO-validation tests
+    pin this — on a backend that keeps the re-gather, add
+    ``frac·4·padded``). ``overlap`` never changes the byte count,
+    only whether compute hides it (see :func:`overlap_report`)."""
     from torchbooster_tpu.comms.zero import padded_size
 
     n = max(1, n_shards)
-    padded = padded_size(n_params, n, bucket_size)
+    if stage is None:
+        stage = 1 if zero1 else 0
+    zero1 = stage >= 1
+    if padded is None:
+        padded = padded_size(n_params, n, bucket_size)
     frac = (n - 1) / n
     per: dict[str, float] = {}
+    if stage >= 2 and mode == "implicit":
+        raise ValueError("step_traffic: stage >= 2 needs an explicit "
+                         "wire format (fp32/bf16/int8)")
     if mode in ("implicit", "fp32"):
         if zero1 and mode == "fp32":
             per["grad_reduce_scatter"] = frac * GRAD_BYTES * padded
@@ -74,11 +96,54 @@ def step_traffic(n_params: int, n_shards: int, mode: str,
     total = sum(per.values())
     return {
         "mode": mode, "zero1": bool(zero1), "n_shards": n,
+        "stage": stage, "overlap": bool(overlap),
         "padded_params": padded,
         "per_collective": {k: round(v, 1) for k, v in per.items()},
         "total_bytes": round(total, 1),
         "grad_bytes": round(total - per.get("param_all_gather", 0.0), 1),
     }
+
+
+def overlap_report(step_s_on: float, step_s_off: float,
+                   grad_bytes: float,
+                   bandwidth_gbs: float | None = None,
+                   tolerance: float = 0.05) -> dict:
+    """The overlap-verification gate: prove bytes are actually hidden
+    by comparing wall-clock step time against the serialized model.
+
+    The serialized roofline says ``step = compute + comms``; the
+    overlapped roofline says ``step = max(compute, comms)``. Both arms
+    move IDENTICAL bytes (``overlap`` is a scheduling choice, not a
+    wire change), so the overlap-off arm measures
+    ``compute + comms_exposed`` and every second the overlap-on arm
+    shaves off is communication hidden behind backward compute:
+    ``hidden_bytes = grad_bytes · hidden_s / comms_s``. With a
+    ``bandwidth_gbs`` estimate the report also models ``comms_s`` and
+    the hidden fraction; without one it still answers the gate
+    question — overlap-on must not be slower than overlap-off (within
+    ``tolerance``, the measurement noise floor). Mirrors the
+    accounting-vs-HLO 10% gate in spirit: a schedule that *claims*
+    overlap but serializes anyway fails loudly in the bench instead
+    of shipping a no-op knob."""
+    out = {
+        "step_s_on": round(step_s_on, 6),
+        "step_s_off": round(step_s_off, 6),
+        "speedup": round(step_s_off / step_s_on, 4) if step_s_on else None,
+        "hidden_s": round(max(0.0, step_s_off - step_s_on), 6),
+        "grad_bytes": round(grad_bytes, 1),
+        "overlap_ok": step_s_on <= step_s_off * (1.0 + tolerance),
+    }
+    if bandwidth_gbs:
+        comms_s = grad_bytes / (bandwidth_gbs * 1e9)
+        out["modeled_comms_s"] = round(comms_s, 6)
+        out["serialized_model_s"] = round(step_s_off, 6)
+        out["overlapped_model_s"] = round(
+            max(step_s_off - comms_s, comms_s), 6)
+        if comms_s > 0:
+            frac = min(1.0, out["hidden_s"] / comms_s)
+            out["hidden_frac"] = round(frac, 4)
+            out["hidden_bytes"] = round(grad_bytes * frac, 1)
+    return out
 
 
 def record_step_traffic(traffic: dict, registry: Any = None) -> None:

@@ -1032,45 +1032,116 @@ class LoadgenConfig(BaseConfig):
 
 @dataclass
 class CommsConfig(BaseConfig):
-    """Gradient-communication plan (torchbooster_tpu/comms): the wire
-    format of the data-parallel gradient sync and the ZeRO-1 switch.
-    No reference analogue — the reference's DDP all-reduce was NCCL's
-    business; here the bytes are a config line.
+    """Gradient-communication schedule (torchbooster_tpu/comms): the
+    ZeRO stage, the wire format of the data-parallel gradient sync,
+    and whether the sync overlaps backward. No reference analogue —
+    the reference's DDP all-reduce was NCCL's business; here the
+    bytes are a config line.
 
     YAML block::
 
         comms:
-          mode: implicit     # implicit | fp32 | bf16 | int8
-          zero1: false       # shard the optimizer update over dp
+          stage: 0           # ZeRO ladder: 0 | 1 | 2 | 3
+          wire: fp32         # fp32 | bf16 | int8 (grad wire format)
+          overlap: false     # stage>=2: reduce-scatter inside backward
+          bucket_mb: 4.0     # comm-bucket size for the overlapped sync
           bucket_size: 512   # int8 quantization bucket (fp32 scale each)
 
-    ``implicit`` (default) keeps XLA's own fp32 psum — bit-identical
-    to not having this block. ``fp32`` makes the same sync explicit
-    (the A/B control and the accounting anchor). ``bf16``/``int8``
-    compress the wire 2×/~4×; int8 carries error-feedback residuals
-    in ``TrainState.comms`` so training tracks the fp32 loss curve.
-    ``zero1: true`` reduce-scatters grads, updates a 1/N optimizer
-    shard per replica, and all-gathers updated params — optimizer
-    HBM drops by the data-parallel degree. See
-    docs/parallelism.md "Gradient communication" for the mode matrix.
+    ``stage: 0`` all-reduces gradients (explicit, the A/B control);
+    ``stage: 1`` (ZeRO-1) shards the optimizer update; ``stage: 2``
+    (ZeRO-2) reduce-scatters gradients bucket-by-bucket — *during*
+    backward with ``overlap: true``; ``stage: 3`` (ZeRO-3) also
+    shards params at rest and all-gathers them just in time in
+    forward — inherently overlapped (the gather hooks' backward IS
+    the reduce-scatter), so ``overlap`` normalizes to true at stage
+    3; there is no serialized variant. ``wire: bf16``/``int8`` compress the grad bytes 2×/~4×
+    (int8 carries error-feedback residuals in ``TrainState.comms``).
+    Bad combinations fail loudly naming the offending keys
+    (``overlap`` needs ``stage`` >= 2; stages >= 2 need an explicit
+    ``wire``). Omitting the whole block keeps XLA's own implicit
+    fp32 psum, bit-identical to before this subsystem existed.
+
+    Legacy keys ``mode:`` (``implicit | fp32 | bf16 | int8``) and
+    ``zero1:`` still load — they shim onto ``{stage: 0|1, wire:
+    mode}`` with a deprecation note — but cannot be mixed with the
+    schedule keys in one block. See docs/parallelism.md
+    "Gradient communication" for the ladder matrix.
     """
 
-    mode: str = "implicit"             # implicit | fp32 | bf16 | int8
-    zero1: bool = False
+    stage: int = -1                    # 0 | 1 | 2 | 3 (-1: unset/legacy)
+    wire: str = ""                     # fp32 | bf16 | int8 ("": unset)
+    overlap: bool = False              # stage>=2 only
+    bucket_mb: float = 4.0             # comm-bucket target (MB, fp32)
+    mode: str = "implicit"             # legacy: implicit|fp32|bf16|int8
+    zero1: bool = False                # legacy: stage-1 switch
     bucket_size: int = 512
 
     def make(self, env: Any = None, mesh: Any = None) -> Any:
-        """Build the :class:`~torchbooster_tpu.comms.GradComms` for
-        ``mesh`` (or the ``env``'s cached mesh): pass it to
+        """Build the :class:`~torchbooster_tpu.comms.CommsSchedule`
+        for ``mesh`` (or the ``env``'s cached mesh): pass it to
         ``utils.make_step(comms=...)`` and build states with
         ``.create_state(params, tx)``."""
+        import logging
+
         from torchbooster_tpu import distributed as dist
-        from torchbooster_tpu.comms import make_grad_comms
+        from torchbooster_tpu.comms import make_schedule
 
         if mesh is None:
             mesh = dist.get_mesh(env)
-        return make_grad_comms(mesh, mode=self.mode, zero1=self.zero1,
-                               bucket_size=self.bucket_size)
+        selector_keys = {}
+        if self.stage != -1:
+            selector_keys["stage"] = self.stage
+        if self.wire:
+            selector_keys["wire"] = self.wire
+        if self.overlap:
+            selector_keys["overlap"] = self.overlap
+        tuning_keys = {}
+        if self.bucket_mb != 4.0:
+            tuning_keys["bucket_mb"] = self.bucket_mb
+        new_keys = {**selector_keys, **tuning_keys}
+        legacy_keys = {}
+        if self.mode != "implicit":
+            legacy_keys["mode"] = self.mode
+        if self.zero1:
+            legacy_keys["zero1"] = self.zero1
+        if new_keys and legacy_keys:
+            raise ValueError(
+                f"comms: block mixes legacy keys "
+                f"{sorted(legacy_keys)} with schedule keys "
+                f"{sorted(new_keys)} — express the whole plan as "
+                f"stage/wire/overlap (mode: {self.mode!r} zero1: "
+                f"{self.zero1} is comms: {{stage: "
+                f"{1 if self.zero1 else 0}, wire: {self.mode!r}}})")
+        if tuning_keys and not selector_keys:
+            raise ValueError(
+                f"comms: {{bucket_mb: {self.bucket_mb}}} only shapes "
+                f"the stage>=2 comm buckets — on its own it would "
+                f"silently replace the implicit psum with an explicit "
+                f"stage-0 sync. Add stage: (and wire:) to select the "
+                f"schedule, or drop bucket_mb.")
+        if new_keys:
+            return make_schedule(mesh,
+                                 stage=max(0, self.stage),
+                                 wire=self.wire or "fp32",
+                                 overlap=self.overlap,
+                                 bucket_mb=self.bucket_mb,
+                                 bucket_size=self.bucket_size)
+        # legacy shim: mode/zero1 map onto stages 0/1 bit-for-bit
+        # (implicit grads + sharded update stays the implicit-wire
+        # stage-1 schedule it always silently was — now it says so)
+        stage = 1 if self.zero1 else 0
+        if legacy_keys:
+            logging.warning(
+                "comms: mode/zero1 are deprecated — this block is the "
+                "schedule comms: {stage: %d, wire: %s}; the schedule "
+                "keys also unlock stage 2/3 and overlap",
+                stage, self.mode)
+        from torchbooster_tpu.comms import (as_schedule,
+                                            make_grad_comms)
+
+        return as_schedule(make_grad_comms(
+            mesh, mode=self.mode, zero1=self.zero1,
+            bucket_size=self.bucket_size))
 
 
 @dataclass

@@ -289,6 +289,15 @@ def make_step(
     scatter layout — keep the implicit path there). The returned step
     exports its modeled per-collective bytes through the
     ``comms_bytes_total`` counter when telemetry is enabled.
+
+    A :class:`~torchbooster_tpu.comms.schedule.CommsSchedule` with
+    ``stage >= 2`` extends the ladder: ZeRO-2 reduce-scatters the
+    gradients bucket-by-bucket (inside backward when ``overlap``),
+    ZeRO-3 additionally keeps params sharded at rest and all-gathers
+    them just in time in forward — see
+    :mod:`torchbooster_tpu.comms.schedule`. Same constraints as
+    ``zero1`` plus: no gradient accumulation, elementwise optimizers
+    only.
     """
     accumulate = accumulate_every > 1
 
@@ -296,6 +305,11 @@ def make_step(
         raise ValueError("make_step(rules=...) needs mesh= as well")
     explicit = comms is not None and comms.mode != "implicit"
     zero1 = bool(comms is not None and comms.zero1)
+    # ZeRO ladder: stage 0/1 rides the original explicit/zero1 paths
+    # below bit-for-bit; stage >= 2 (ZeRO-2/3, optionally overlapped)
+    # dispatches to the comms.schedule step — one fused shard_map over
+    # fwd+bwd+sharded update (torchbooster_tpu/comms/schedule.py)
+    stage = int(getattr(comms, "stage", 1 if zero1 else 0))
     if (explicit or zero1) and rules is not None:
         raise ValueError(
             "make_step(comms=...) explicit modes / zero1 need fully "
@@ -339,6 +353,28 @@ def make_step(
 
             diff_fn = cast_loss_fn
         comms_state = state.comms
+        if stage >= 2:
+            # ZeRO-2/3: per-bucket reduce-scatter (inside backward
+            # when the schedule overlaps), elementwise update on this
+            # replica's flat shard, params re-gathered (stage 2) or
+            # kept sharded at rest (stage 3)
+            from torchbooster_tpu.comms.schedule import sharded_step
+
+            (loss, aux), params, opt_state, comms_state = sharded_step(
+                comms, diff_fn, tx, clip, state.params,
+                state.opt_state, state.comms or {}, batch_cast,
+                step_rng, has_aux=has_aux)
+            ema = state.ema
+            if ema_decay is not None and ema is not None:
+                d = jnp.minimum(ema_decay,
+                                (1.0 + state.step) / (10.0 + state.step))
+                ema = jax.tree.map(lambda e, p: e * d + (1.0 - d) * p,
+                                   ema, params)
+            new_state = state.replace(
+                params=params, opt_state=opt_state,
+                step=state.step + 1, rng=rng, ema=ema,
+                comms=comms_state)
+            return new_state, {"loss": loss, **aux}
         if explicit:
             # per-replica fwd+bwd under shard_map, then the explicit
             # sync in the configured wire format; with zero1 the sync
