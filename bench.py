@@ -2253,6 +2253,210 @@ def bench_serve_fleet() -> dict:
     return out
 
 
+def bench_serve_spill() -> dict:
+    """The host-RAM page spill tier A/B (the PR-16 tentpole): one
+    probe tenant's shared-prefix request timed through IDENTICAL
+    engine geometry in three states — COLD (prefix_cache off: full
+    recompute), HBM-HIT (prefix resident in the pool), HOST-HIT (the
+    prefix demoted to the host pool by a tenant churn that overflows
+    the HBM cache, promoted back over one compiled H2D write) — plus
+    a dense-cache parity control.
+
+    Gates (``serve_spill_ok``):
+
+    1. **Token parity**: cold == HBM-hit == host-hit == dense — the
+       quantize/dequantize round trip through host DRAM must be
+       token-invisible (int8 pools spill losslessly; wide pools ride
+       the same int8+scale format the ``cache_dtype: int8`` engine
+       already proved token-safe).
+    2. **TTFT**: host-hit >= ``BENCH_SPILL_MIN_RATIO`` (default 1.5)
+       x faster than cold at a >= 4-page prefix — the promotion pays
+       PCIe stream time, not recompute FLOPs.
+    3. **Zero new compiles**: decode == prefill == 1 on every arm
+       and exactly ONE promote executable after the demote/promote
+       churn (the fixed-shape staging contract).
+    4. **Accounting**: the engine's measured ``promoted_bytes`` is
+       EQUAL (not approximately) to ``comms.accounting.
+       promotion_traffic``'s model for the promoted page count.
+
+    Also emitted: the modeled break-even prefix length
+    (``spill_breakeven`` at ``BENCH_SPILL_H2D_GBS`` /
+    ``BENCH_SPILL_FLOPS_TPS``), spill/promotion counters, and the
+    host-pool occupancy after churn."""
+    from torchbooster_tpu.comms.accounting import (promotion_traffic,
+                                                   spill_breakeven)
+    from torchbooster_tpu.models.gpt import GPT, GPTConfig
+    from torchbooster_tpu.serving import (ContinuousBatcher,
+                                          PagedEngine, Request)
+
+    page = int(os.environ.get("BENCH_SPILL_PAGE", 64))
+    n_pages = int(os.environ.get("BENCH_SPILL_PAGES", 64))
+    slots = int(os.environ.get("BENCH_SPILL_SLOTS", 4))
+    seq = int(os.environ.get("BENCH_SPILL_SEQ", 2048))
+    n_layers = int(os.environ.get("BENCH_SPILL_LAYERS", 12))
+    kv = int(os.environ.get("BENCH_SPILL_KV_HEADS", 4))
+    prefix_pages = int(os.environ.get("BENCH_SPILL_PREFIX_PAGES", 6))
+    tenants = int(os.environ.get("BENCH_SPILL_TENANTS", 12))
+    chunk_pages = int(os.environ.get("BENCH_SPILL_CHUNK_PAGES", 2))
+    budget_mb = float(os.environ.get("BENCH_SPILL_BUDGET_MB", 256.0))
+    min_ratio = float(os.environ.get("BENCH_SPILL_MIN_RATIO", 1.5))
+    cache_dtype = os.environ.get("BENCH_SPILL_CACHE_DTYPE") or None
+    if prefix_pages < 4:
+        raise ValueError(
+            f"BENCH_SPILL_PREFIX_PAGES ({prefix_pages}) must be >= 4:"
+            " the acceptance gate is stated at >= 4-page prefixes")
+    # the churn working set must overflow the HBM pool or nothing
+    # demotes and the host arm silently measures an HBM hit
+    if tenants * prefix_pages <= n_pages - 1:
+        raise ValueError(
+            f"BENCH_SPILL_TENANTS ({tenants}) x prefix_pages "
+            f"({prefix_pages}) must overflow the pool "
+            f"({n_pages - 1} usable pages) to force demotion")
+
+    rs = np.random.RandomState(0)
+    probe_prefix = rs.randint(0, 50257, prefix_pages * page,
+                              dtype=np.int32)
+    probe_suffix = rs.randint(0, 50257, page // 2, dtype=np.int32)
+    probe_prompt = np.concatenate([probe_prefix, probe_suffix])
+    out_tokens = 8
+
+    def probe_trace():
+        return [Request(prompt=probe_prompt.copy(),
+                        max_new_tokens=out_tokens)]
+
+    cfg = GPTConfig(n_layers=n_layers, seq_len=seq, n_kv_heads=kv)
+    params = GPT.init(jax.random.PRNGKey(0), cfg)
+    # decisive head: token parity must not ride float near-ties
+    params = {**params, "wte": {"table": params["wte"]["table"] * 4.0}}
+    n_params = sum(p.size for p in jax.tree.leaves(params))
+
+    def build(prefix_cache, host_spill):
+        return PagedEngine(params, cfg, page_size=page,
+                           n_pages=n_pages, max_slots=slots,
+                           cache_dtype=cache_dtype,
+                           prefix_cache=prefix_cache,
+                           prefill_chunk_pages=chunk_pages,
+                           host_spill=host_spill,
+                           host_spill_mb=budget_mb)
+
+    out: dict = {"serve_spill_prefix_pages": prefix_pages,
+                 "serve_spill_tenants": tenants}
+    tokens: dict = {}
+    ttft: dict = {}
+
+    # ---- cold arm: no cache, every probe recomputes its prefix ---
+    eng_cold = build(prefix_cache=False, host_spill=False)
+    b = ContinuousBatcher(eng_cold)
+    b.run([Request(prompt=rs.randint(0, 50257, len(probe_prompt),
+                                     dtype=np.int32),
+                   max_new_tokens=2)])      # warm the executables
+    reqs = probe_trace()
+    m = b.run(reqs)
+    ttft["cold"] = m["ttft_mean_s"]
+    tokens["cold"] = list(reqs[0].tokens)
+
+    # ---- HBM-hit + host-hit arms: ONE spill engine, three phases -
+    eng = build(prefix_cache=True, host_spill=True)
+    b = ContinuousBatcher(eng)
+    # warmup registers the probe prefix AND warms the executables
+    b.run([Request(prompt=np.concatenate(
+        [probe_prefix, rs.randint(0, 50257, 8, dtype=np.int32)]),
+        max_new_tokens=2)])
+    reqs = probe_trace()
+    m = b.run(reqs)
+    ttft["hbm"] = m["ttft_mean_s"]
+    tokens["hbm"] = list(reqs[0].tokens)
+    hbm_hit_pages = m["prefix_hit_pages"]
+
+    # tenant churn: enough distinct shared prefixes to overflow the
+    # HBM cache, so LRU demotes the probe tenant's pages to host
+    for t in range(tenants):
+        tp = rs.randint(0, 50257, prefix_pages * page, dtype=np.int32)
+        b.run([Request(prompt=np.concatenate(
+            [tp, rs.randint(0, 50257, 8, dtype=np.int32)]),
+            max_new_tokens=2)])
+    pages_host = int(eng.tables.n_host_pages)
+    if pages_host < prefix_pages:
+        raise RuntimeError(
+            f"churn left only {pages_host} host pages (< "
+            f"{prefix_pages}): the probe prefix did not demote — "
+            "grow BENCH_SPILL_TENANTS or shrink BENCH_SPILL_PAGES")
+
+    hits0, promos0, bytes0 = (eng.host_hit_pages, eng.promotions,
+                              eng.promoted_bytes)
+    reqs = probe_trace()
+    m = b.run(reqs)
+    ttft["host"] = m["ttft_mean_s"]
+    tokens["host"] = list(reqs[0].tokens)
+    host_hit_pages = eng.host_hit_pages - hits0
+    promoted = eng.promotions - promos0
+    promoted_bytes = eng.promoted_bytes - bytes0
+
+    # ---- dense parity control ------------------------------------
+    eng_dense = PagedEngine.dense_control(params, cfg,
+                                          max_slots=slots,
+                                          cache_dtype=cache_dtype)
+    b = ContinuousBatcher(eng_dense)
+    reqs = probe_trace()
+    b.run(reqs)
+    tokens["dense"] = list(reqs[0].tokens)
+
+    # ---- gates ---------------------------------------------------
+    parity = (tokens["cold"] == tokens["hbm"] == tokens["host"]
+              == tokens["dense"])
+    ratio = ttft["cold"] / max(ttft["host"], 1e-9)
+    ttft_ok = ratio >= min_ratio
+    compiles_ok = (eng_cold.decode_compiles == 1
+                   and eng_cold.prefill_compiles == 1
+                   and eng.decode_compiles == 1
+                   and eng.prefill_compiles == 1
+                   and eng.promote_compiles == 1)
+    model = promotion_traffic(promoted, page_size=page,
+                              kv_heads=cfg.kv_heads,
+                              head_dim=cfg.d_model // cfg.n_heads,
+                              n_layers=n_layers)
+    bytes_ok = (host_hit_pages >= 4 and promoted == host_hit_pages
+                and promoted_bytes == model["total_bytes"])
+    ok = parity and ttft_ok and compiles_ok and bytes_ok
+    if not ok:
+        print(f"SERVE_SPILL FAIL: parity={parity}, "
+              f"ttft_ratio={ratio:.2f} (need >={min_ratio}), "
+              f"compiles_ok={compiles_ok}, bytes_ok={bytes_ok} "
+              f"(promoted={promoted}, hit={host_hit_pages}, "
+              f"measured={promoted_bytes}, "
+              f"modeled={model['total_bytes']})", file=sys.stderr)
+
+    be = spill_breakeven(
+        n_params=n_params, page_size=page,
+        per_page_bytes=model["per_page_bytes"],
+        h2d_gbs=float(os.environ.get("BENCH_SPILL_H2D_GBS", 16.0)),
+        flops_tps=float(os.environ.get("BENCH_SPILL_FLOPS_TPS",
+                                       180.0)),
+        n_pages=prefix_pages)
+    out.update({
+        "serve_spill_ttft_cold_s": ttft["cold"],
+        "serve_spill_ttft_hbm_s": ttft["hbm"],
+        "serve_spill_ttft_host_s": ttft["host"],
+        "serve_spill_ttft_ratio": round(ratio, 2),
+        "serve_spill_token_parity": parity,
+        "serve_spill_hbm_hit_pages": hbm_hit_pages,
+        "serve_spill_host_hit_pages": host_hit_pages,
+        "serve_spill_promoted_pages": promoted,
+        "serve_spill_promoted_bytes": promoted_bytes,
+        "serve_spill_modeled_bytes": model["total_bytes"],
+        "serve_spill_bytes_match": bytes_ok,
+        "serve_spill_pages_host": pages_host,
+        "serve_spill_spills": eng.spills,
+        "serve_spill_one_compile": compiles_ok,
+        "serve_spill_promote_compiles": eng.promote_compiles,
+        "serve_spill_breakeven_pages": (
+            round(be["breakeven_pages"], 2)
+            if be["breakeven_pages"] != float("inf") else -1),
+        "serve_spill_ok": ok,
+    })
+    return out
+
+
 def bench_obs(steps: int) -> dict:
     """Telemetry overhead A/B: the SAME GPT bench step (bench_gpt
     geometry + knobs) timed with observability disabled, then enabled
@@ -3076,6 +3280,8 @@ def _sub_main(name: str) -> None:
         print(json.dumps(bench_replay_http()))
     elif name == "serve_fleet":
         print(json.dumps(bench_serve_fleet()))
+    elif name == "serve_spill":
+        print(json.dumps(bench_serve_spill()))
     elif name == "obs":
         print(json.dumps(bench_obs(max(4, steps // 4))))
     elif name == "comms":
@@ -3298,6 +3504,11 @@ _SECONDARY_BENCHES = (("gpt", 900), ("gpt_long", 1500), ("loader", 900),
                       # scaling + affinity-vs-round-robin, replayed
                       # in-process from one fingerprinted workload
                       ("serve_fleet", 1800),
+                      # the host spill-tier row (PR 16): cold vs
+                      # HBM-hit vs host-hit TTFT + parity + the
+                      # bytes-accounting gate; shares its run_ab
+                      # QUEUE deadline (two-drivers-must-agree)
+                      ("serve_spill", 1800),
                       ("obs", 900), ("comms", 900),
                       # the ZeRO-ladder row (PR 15): stage/overlap A/B
                       # with the overlap + accounting gates

@@ -130,7 +130,8 @@ def main() -> None:
                  "serve_tp", "serve_tp_pallas",
                  "serve_parallel", "serve_tree",
                  "obs_trace", "replay", "replay_http",
-                 "serve_fleet", "serve_fleet_affinity")
+                 "serve_fleet", "serve_fleet_affinity",
+                 "serve_spill")
     for name in sorted(attempts):
         if name in METRICS or (name in multi_key and name in latest):
             continue  # multi-key ok rows print below; failures fall through
@@ -453,6 +454,34 @@ def main() -> None:
                 f"/{r.get(f'{pre}_ttft_p99_s', '—')} "
                 f"| {r.get(f'{pre}_goodput_tok_s', '—')} "
                 f"| {r.get(f'{pre}_spills', '—')} |")
+
+    # serve_spill row: the host page spill tier — cold vs HBM-hit vs
+    # host-hit TTFT sub-table with the parity/compile/bytes gates in
+    # the header and the modeled break-even prefix length
+    e = latest.get("serve_spill")
+    if e is not None:
+        r = e.get("result") or {}
+        be = r.get("serve_spill_breakeven_pages")
+        print(f"\nserve_spill ({r.get('serve_spill_prefix_pages', '?')}"
+              f"-page prefix x {r.get('serve_spill_tenants', '?')} "
+              "churn tenants, host/cold TTFT ratio "
+              f"{r.get('serve_spill_ttft_ratio', '?')}x (gate >= 1.5)"
+              f", token parity {r.get('serve_spill_token_parity', '?')}"
+              ", bytes model==measured "
+              f"{r.get('serve_spill_bytes_match', '?')} "
+              f"({r.get('serve_spill_promoted_bytes', '?')} B), one "
+              f"compile {r.get('serve_spill_one_compile', '?')}, "
+              "modeled break-even "
+              f"{'n/a' if be == -1 else be} pages, verdict "
+              f"ok={r.get('serve_spill_ok', '?')}):")
+        print("| arm | ttft s | hit pages |")
+        print("|---|---|---|")
+        print(f"| cold | {r.get('serve_spill_ttft_cold_s', '—')} "
+              "| 0 |")
+        print(f"| hbm_hit | {r.get('serve_spill_ttft_hbm_s', '—')} "
+              f"| {r.get('serve_spill_hbm_hit_pages', '—')} |")
+        print(f"| host_hit | {r.get('serve_spill_ttft_host_s', '—')} "
+              f"| {r.get('serve_spill_host_hit_pages', '—')} |")
 
     # comms rows: bytes-moved + step-time deltas across the gradient
     # sync arms, rendered as a compact sub-table (one row per arm)

@@ -54,6 +54,7 @@ BLOCKS = {
     "serving": "ServingConfig",
     "frontend": "FrontendConfig",
     "router": "RouterConfig",
+    "host_spill": "HostSpillConfig",
     "loadgen": "LoadgenConfig",
     "comms": "CommsConfig",
     "observability": "ObservabilityConfig",

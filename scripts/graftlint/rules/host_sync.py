@@ -44,7 +44,15 @@ HOT_PATHS = (
     # the decode loop's own thread, so its pacing/bookkeeping is as
     # step-cadence as the batcher itself — the open-loop pacer's
     # wall-clock TIMESTAMPS are reasoned obs_allowlist.txt entries,
-    # never durations
+    # never durations. The prefix also covers the PR 16 spill tier
+    # (kv_pages.py's HostPagePool + engine.py's demote/promote): its
+    # host-side numpy copies are DELIBERATE — demotion reads a page
+    # once at evict time (jax.device_get, which this rule does not
+    # flag) and promotion stages through pinned numpy into one
+    # compiled device_put'd write, neither on the per-token decode
+    # cadence — so no allowlist entries are needed unless a flagged
+    # pattern (.item() / time.time() / float(<call>)) ever lands
+    # there; the router/directory.py bookkeeping is pure host dicts
     "torchbooster_tpu/serving/",
     # the paged flash-decode kernel wrapper sits INSIDE the compiled
     # decode/verify steps (serving/engine.py calls it per layer per

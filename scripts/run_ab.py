@@ -186,6 +186,15 @@ QUEUE: list[tuple[str, str, dict, int]] = [
     ("serve_fleet", "serve_fleet", {}, 1800),
     ("serve_fleet_affinity", "serve_fleet",
      {"BENCH_FLEET_AFFINITY": "1"}, 1800),
+    # host page spill tier (the PR-16 tentpole): cold vs HBM-hit vs
+    # host-hit TTFT through identical geometry with a tenant churn
+    # overflowing the HBM cache — token parity across all three arms
+    # (+ the dense control), host-hit >= 1.5x faster than cold at a
+    # >= 4-page prefix, exactly one promote executable across the
+    # demote/promote churn, and the accounting model's promotion
+    # bytes EQUAL to the engine's measured counter
+    # (bench.bench_serve_spill; serve_spill_ok is the verdict bit)
+    ("serve_spill", "serve_spill", {}, 1800),
     # recipe accuracy on chip (VERDICT r4 #3): the shipped ResNet
     # CIFAR recipe end to end, ref hyperparams, 20 epochs — real
     # CIFAR-10 if a binary release is under the dataset root (none in

@@ -358,8 +358,8 @@ def test_healthz_readiness_payload_single_batcher():
     assert set(bare) == {"status", "queue_depth", "pages_free",
                          "occupancy"}
     assert set(full) == {"status", "queue_depth", "pages_free",
-                         "pages_cached", "inflight", "occupancy",
-                         "est_step_s"}
+                         "pages_cached", "pages_host", "inflight",
+                         "occupancy", "est_step_s"}
     assert set(full) == set(ready), \
         "the probe and the load scorer must share one payload shape"
 

@@ -1,0 +1,601 @@
+"""PR 16 — the KV-cache memory hierarchy: host-RAM page spill tier
+(kv_pages.HostPagePool + demoting eviction + async H2D promotion) and
+the fleet-wide prefix directory (router.directory).
+
+Layered like the subsystem: pool-policy units, BlockTables tier
+invariants (the three-way partition churn — this PR's satellite
+acceptance), engine-level token parity + zero-recompile + byte
+accounting, the comms cost model, config/loadgen knobs, and the
+fleet directory end-to-end (route-to-holder beats a no-directory
+control; replica death purges and rescues)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torchbooster_tpu.models.gpt import GPT, GPTConfig
+
+
+_SHARED = {}
+
+
+def _decisive_model(seq_len=64):
+    """Tiny GPT with a DECISIVE head (scaled-up tied embeddings widen
+    argmax margins so int8 demote/promote rounding cannot flip greedy
+    picks — the same trick the paged parity tests use)."""
+    if seq_len not in _SHARED:
+        cfg = GPTConfig(vocab=97, n_layers=2, d_model=32, n_heads=4,
+                        seq_len=seq_len, n_kv_heads=2)
+        params = GPT.init(jax.random.PRNGKey(0), cfg)
+        params = {**params,
+                  "wte": {"table": params["wte"]["table"] * 4.0}}
+        _SHARED[seq_len] = (params, cfg)
+    return _SHARED[seq_len]
+
+
+def _paged_tokens(engine, prompt, n_new):
+    slot, first = engine.admit(prompt)
+    toks = [first]
+    for _ in range(n_new - 1):
+        assert engine.grow_slots() == []
+        toks.append(int(engine.step()[slot]))
+    engine.retire(slot)
+    return toks
+
+
+def _fake_fetch(page_size=4):
+    """A stand-in for the engine's quantize-and-copy demotion
+    callback: payload shape/format matches the real one (int8 K/V +
+    fp32 scales over 2 layers, 2 KV heads, head_dim 8 = 384 bytes a
+    page) but the content is just the page id."""
+    def fetch(p):
+        return {"k": np.full((2, page_size, 2, 8), p % 120, np.int8),
+                "k_scale": np.ones((2, page_size, 2, 1), np.float32),
+                "v": np.full((2, page_size, 2, 8), p % 120, np.int8),
+                "v_scale": np.ones((2, page_size, 2, 1), np.float32)}
+    return fetch
+
+
+_PAGE_BYTES = 384   # what one _fake_fetch payload weighs
+
+
+# ---- HostPagePool: residency policy units ----------------------------
+
+def test_host_page_pool_lru_budget_and_counters():
+    from torchbooster_tpu.serving.kv_pages import HostPagePool
+
+    pl = _fake_fetch()
+    pool = HostPagePool(budget_bytes=3 * _PAGE_BYTES)
+    assert pool.put(b"a", pl(1)) == []
+    assert pool.put(b"b", pl(2)) == []
+    assert pool.put(b"c", pl(3)) == []
+    pool.check()
+    assert len(pool) == 3 and pool.used_bytes == 3 * _PAGE_BYTES
+    assert b"a" in pool and pool.get(b"a")["k"][0, 0, 0, 0] == 1
+    # budget overflow evicts OLDEST (b"a" — get() is a peek, not a
+    # touch, so its tick never refreshed)
+    assert pool.put(b"d", pl(4)) == [b"a"]
+    assert b"a" not in pool and pool.n_evictions == 1
+    # refresh == replace: re-putting b"b" mints a new tick, so the
+    # next overflow victim is b"c"
+    pool.put(b"b", pl(5))
+    assert pool.put(b"e", pl(6)) == [b"c"]
+    # pop consumes (promotion's read)
+    got = pool.pop(b"d")
+    assert got is not None and pool.pop(b"d") is None
+    pool.check()
+    # an oversize payload drops rather than wedging the pool
+    huge = {"k": np.zeros(4 * _PAGE_BYTES, np.int8)}
+    evicted = pool.put(b"huge", huge)
+    assert b"huge" in evicted and b"huge" not in pool
+    assert len(pool) == 0 and pool.used_bytes == 0
+    pool.check()
+    assert pool.n_spills == 6    # successful puts (refresh included)
+    with pytest.raises(ValueError):
+        HostPagePool(budget_bytes=0)
+
+
+# ---- BlockTables: demotion, tiered matching, tier events -------------
+
+def test_block_tables_demote_on_evict_and_match_tiered():
+    """Eviction with the spill tier attached DEMOTES: the page's
+    payload lands in the host pool under its chain key, and the next
+    match_tiered walk returns it as the HBM chain's host-resident
+    continuation — one lookup spanning both tiers."""
+    from torchbooster_tpu.serving.kv_pages import (BlockTables,
+                                                   HostPagePool)
+
+    cfg = GPTConfig(seq_len=64)
+    bt = BlockTables(cfg, page_size=4, n_pages=12, max_slots=2,
+                     prefix_cache=True)
+    bt.host_pool = HostPagePool(1 << 20)
+    bt.spill_fetch = _fake_fetch()
+    events = []
+    bt.on_tier_event = lambda kind, key: events.append((kind, key))
+
+    prompt = np.arange(12, dtype=np.int32)        # 3 full pages
+    bt.seat(0, prompt)
+    bt.activate(0, 1)
+    bt.register_prefix(0, prompt)
+    assert [k for k, _ in events] == ["register"] * 3
+    keys = [prompt[:(i + 1) * 4].tobytes() for i in range(3)]
+    bt.retire(0)
+    bt.check()
+
+    # force the cached chain out: evict 2 of the 3 pages → demoted
+    assert bt._evict(2) == 2
+    assert bt.n_host_pages == 2
+    assert [k for k, _ in events[3:]] == ["demote", "demote"]
+    bt.check()
+
+    # tiered match: 1 HBM page, then its 2-deep host continuation
+    ext = np.concatenate([prompt, np.int32([50, 51])])
+    pages, hkeys = bt.match_tiered(ext)
+    assert len(pages) == 1
+    assert hkeys == keys[1:]                  # depth order, by key
+    # the combined chain honors the (len-1)//page_size cap: a query
+    # that IS the chain (last token must be computed) matches one
+    # page fewer
+    pages, hkeys = bt.match_tiered(prompt)
+    assert len(pages) == 1 and hkeys == [keys[1]]
+    # a chain is cut at its first host miss (leading run only)
+    bt.host_pool.pop(keys[1])
+    pages, hkeys = bt.match_tiered(ext)
+    assert len(pages) == 1 and hkeys == []
+    bt.check()
+
+
+def test_block_tables_spill_churn_invariants():
+    """Satellite acceptance: randomized demote/promote/evict churn
+    with the host tier attached. ``check()`` after EVERY op asserts
+    the three-way partition — referenced ∪ cached ∪ free is exactly
+    the pool, host pages occupy no pool id, and one chain key never
+    lives in both tiers — plus the host pool's own byte accounting.
+    The promote path mirrors the engine: pop payloads, seat, publish
+    via promote_keys."""
+    from torchbooster_tpu.serving.kv_pages import (BlockTables,
+                                                   HostPagePool,
+                                                   NULL_PAGE)
+
+    cfg = GPTConfig(seq_len=64)
+    bt = BlockTables(cfg, page_size=4, n_pages=16, max_slots=4,
+                     prefix_cache=True)
+    # a TIGHT host budget (6 pages) so churn overflows it: demote,
+    # promote, HBM-evict AND host-evict all fire
+    bt.host_pool = HostPagePool(6 * _PAGE_BYTES)
+    bt.spill_fetch = _fake_fetch()
+    kinds = set()
+    bt.on_tier_event = lambda kind, key: kinds.add(kind)
+
+    rng = np.random.RandomState(13)
+    # THREE tenants' shared prefixes over a tight pool: while one
+    # tenant is idle its chain demotes under the others' pressure, so
+    # its next arrival walks into the host tier — the promote path
+    tenants = [rng.randint(0, 97, 12).astype(np.int32)
+               for _ in range(3)]
+    live = {}
+    promoted_pages = 0
+    host_hits = 0
+    for op in range(500):
+        roll = rng.rand()
+        slot = bt.free_slot()
+        if roll < 0.45 and slot is not None:
+            tail = rng.randint(0, 97,
+                               int(rng.randint(1, 16))).astype(np.int32)
+            shared = tenants[int(rng.randint(3))]
+            prompt = (np.concatenate([shared, tail])
+                      if rng.rand() < 0.6 else tail)
+            if bt.pages_for(len(prompt)) > bt.n_available_pages:
+                continue
+            matched, hkeys = bt.match_tiered(prompt)
+            payloads = [bt.host_pool.pop(k) for k in hkeys]
+            try:
+                _, n_matched = bt.seat(slot, prompt, matched=matched)
+            except RuntimeError:
+                for k, pl in zip(hkeys, payloads):
+                    bt.host_pool.put(k, pl)
+                bt.check()
+                continue
+            host_hits += len(hkeys)
+            bt.activate(slot, int(rng.randint(0, 97)))
+            # the engine's promotion, bookkeeping side: the popped
+            # payloads' content lands in the seated pages, then the
+            # keys re-enter the HBM index
+            bt.promote_keys(slot, hkeys, n_matched)
+            promoted_pages += len(hkeys)
+            bt.register_prefix(slot, prompt)
+            live[slot] = True
+        elif roll < 0.8 and live:
+            slot = int(rng.choice(sorted(live)))
+            if bt.lengths[slot] < cfg.seq_len and \
+                    bt.ensure_next_page(slot):
+                bt.advance(slot, int(rng.randint(0, 97)))
+        elif live:
+            slot = int(rng.choice(sorted(live)))
+            bt.retire(slot)
+            del live[slot]
+        bt.check()
+
+    assert host_hits > 0, "churn never hit the host tier"
+    assert promoted_pages > 0
+    assert bt.host_pool.n_evictions > 0, \
+        "the tight budget never overflowed"
+    assert {"register", "demote", "promote",
+            "host_evict"} <= kinds, kinds
+    for slot in list(live):
+        bt.retire(slot)
+    bt.check()
+    # host pages are OUTSIDE the pool partition: the whole pool is
+    # still reclaimable whatever the host tier holds
+    assert bt.n_available_pages == bt.n_pages - 1
+    assert (bt.tables == NULL_PAGE).all()
+
+
+# ---- engine: parity, zero new compiles, byte accounting --------------
+
+@pytest.mark.parametrize("cache_dtype", [None, "int8"])
+def test_engine_host_hit_parity_and_zero_recompiles(cache_dtype):
+    """The tentpole acceptance at engine level: the same probe decoded
+    cold, as an HBM prefix hit, and as a host-tier hit (demote → async
+    promote) yields IDENTICAL greedy tokens; the whole demote/promote
+    cycle compiles exactly one promotion executable and zero new
+    decode/prefill executables; and the measured H2D bytes EQUAL the
+    comms cost model, not approximately."""
+    from torchbooster_tpu.comms.accounting import promotion_traffic
+    from torchbooster_tpu.serving import PagedEngine
+
+    params, cfg = _decisive_model()
+    PAGE = 4
+    eng = PagedEngine(params, cfg, page_size=PAGE, n_pages=16,
+                      max_slots=2, compute_dtype=jnp.float32,
+                      cache_dtype=cache_dtype, prefix_cache=True,
+                      prefill_chunk_pages=2, host_spill=True,
+                      host_spill_mb=4.0)
+    rs = np.random.RandomState(5)
+    prefix = rs.randint(0, 97, 4 * PAGE).astype(np.int32)
+    probe = np.concatenate([prefix, np.int32([5, 9])])
+
+    cold = _paged_tokens(eng, probe, 6)
+    hbm = _paged_tokens(eng, probe, 6)          # HBM prefix hit
+    assert eng.prefix_hit_pages >= 4
+    assert eng.host_hit_pages == 0 and eng.promote_compiles == 0
+
+    # churn distinct prompts through the tight pool until the probe's
+    # registered prefix demotes to the host tier
+    for i in range(20):
+        junk = np.full(2 * PAGE, 1 + (i % 90), np.int32) + \
+            np.arange(2 * PAGE, dtype=np.int32) % 3
+        junk[0] = 1 + i
+        _paged_tokens(eng, junk, 2)
+    assert eng.spills >= 4 and eng.tables.n_host_pages >= 4
+    assert all(k not in eng.tables._index for k in [
+        prefix[:(i + 1) * PAGE].tobytes() for i in range(4)]), \
+        "churn left the probe prefix HBM-resident"
+
+    host = _paged_tokens(eng, probe, 6)         # host-tier hit
+    assert eng.host_hit_pages >= 4
+    assert eng.promotions >= 4
+    assert cold == hbm == host, \
+        "the tier a prefix is served from changed its tokens"
+    # zero NEW compiles: one decode, one prefill-chunk, and exactly
+    # one promotion executable across the whole cycle
+    assert eng.decode_compiles == 1
+    assert eng.prefill_compiles == 1
+    assert eng.promote_compiles == 1
+    # measured == modeled, to the byte
+    model = promotion_traffic(
+        eng.promotions, page_size=PAGE, kv_heads=cfg.n_kv_heads,
+        head_dim=cfg.d_model // cfg.n_heads, n_layers=cfg.n_layers)
+    assert eng.promoted_bytes == model["total_bytes"]
+    stats = eng.debug_stats()
+    assert stats["host_spill"] and stats["spills"] == eng.spills
+    assert stats["compiles"]["promote"] == 1
+    eng.tables.check()
+
+
+def test_engine_retire_beats_promotion_reputs_payloads():
+    """Promotion-or-bust: admit_begin pops host payloads eagerly
+    (seat-time demotions could otherwise LRU them away), so a retire
+    that lands before the promotion must put them BACK — the chain
+    stays host-resident and the next request still host-hits."""
+    from torchbooster_tpu.serving import PagedEngine
+
+    params, cfg = _decisive_model()
+    PAGE = 4
+    eng = PagedEngine(params, cfg, page_size=PAGE, n_pages=16,
+                      max_slots=2, compute_dtype=jnp.float32,
+                      prefix_cache=True, prefill_chunk_pages=2,
+                      host_spill=True, host_spill_mb=4.0)
+    rs = np.random.RandomState(9)
+    prefix = rs.randint(0, 97, 3 * PAGE).astype(np.int32)
+    probe = np.concatenate([prefix, np.int32([2, 7])])
+    _paged_tokens(eng, probe, 3)                # register
+    for i in range(16):                         # demote
+        _paged_tokens(eng, np.full(2 * PAGE, 1 + i, np.int32), 2)
+    keys = [prefix[:(i + 1) * PAGE].tobytes() for i in range(3)]
+    assert all(k in eng.tables.host_pool for k in keys)
+
+    slot = eng.admit_begin(probe)               # payloads popped here
+    assert slot is not None
+    # (count the CHAIN's keys, not pool totals — seat itself demotes
+    # other cached pages under pressure, muddying the byte totals)
+    assert all(k not in eng.tables.host_pool for k in keys)
+    eng.retire(slot)                            # beats the promotion
+    assert all(k in eng.tables.host_pool for k in keys), \
+        "retire-before-promote dropped the popped payloads"
+    eng.tables.check()
+    h0 = eng.host_hit_pages
+    toks = _paged_tokens(eng, probe, 3)
+    assert eng.host_hit_pages - h0 >= 3 and len(toks) == 3
+
+
+def test_engine_spill_off_collapse_and_validation():
+    """host_spill=False is PR-4 behavior bit-for-bit: no host pool,
+    no promotion executable (the jit doesn't exist, not merely
+    uncalled), zeroed counters; and the invalid combinations refuse
+    loudly at construction."""
+    from torchbooster_tpu.serving import PagedEngine
+
+    params, cfg = _decisive_model()
+    eng = PagedEngine(params, cfg, page_size=4, n_pages=16,
+                      max_slots=2, compute_dtype=jnp.float32,
+                      prefix_cache=True)
+    _paged_tokens(eng, np.arange(10, dtype=np.int32), 4)
+    for i in range(16):                        # eviction churn: pure
+        _paged_tokens(eng, np.full(8, 1 + i, np.int32), 2)
+    assert eng.tables.host_pool is None
+    assert eng.promote_compiles == 0 and eng._promote_jit is None
+    stats = eng.debug_stats()
+    assert not stats["host_spill"]
+    assert stats["pages_host"] == 0 and stats["spills"] == 0
+    assert stats["promoted_bytes"] == 0
+    assert stats["compiles"]["promote"] == 0
+
+    with pytest.raises(ValueError, match="needs prefix_cache"):
+        PagedEngine(params, cfg, page_size=4, n_pages=16, max_slots=2,
+                    compute_dtype=jnp.float32, host_spill=True)
+
+
+# ---- comms cost model ------------------------------------------------
+
+def test_promotion_traffic_and_spill_breakeven():
+    from torchbooster_tpu.comms.accounting import (promotion_traffic,
+                                                   spill_breakeven)
+
+    # integer bytes, the engine's demotion format exactly: K and V
+    # int8 + one fp32 scale per (layer, token, kv head)
+    m = promotion_traffic(3, page_size=4, kv_heads=2, head_dim=8,
+                          n_layers=2)
+    elems = 2 * 4 * 2
+    assert m["per_page_bytes"] == 2 * elems * 8 + 2 * elems * 4
+    assert m["total_bytes"] == 3 * m["per_page_bytes"]
+    assert promotion_traffic(0, page_size=4, kv_heads=2, head_dim=8,
+                             n_layers=2)["total_bytes"] == 0
+    with pytest.raises(ValueError):
+        promotion_traffic(-1, page_size=4, kv_heads=2, head_dim=8,
+                          n_layers=2)
+
+    # a fast PCIe stream vs an expensive recompute: finite break-even,
+    # and past it the modeled host TTFT wins
+    be = spill_breakeven(n_params=7_000_000_000, page_size=64,
+                         per_page_bytes=1 << 20, h2d_gbs=16.0,
+                         flops_tps=180.0, n_pages=32)
+    assert be["host_wins_per_page"]
+    assert 0 < be["breakeven_pages"] < float("inf")
+    assert be["ttft_host_s"] < be["ttft_recompute_s"]
+    # a stream no faster than recompute: the tier never wins TTFT
+    slow = spill_breakeven(n_params=1_000_000, page_size=4,
+                           per_page_bytes=1 << 20, h2d_gbs=1.0,
+                           flops_tps=500.0)
+    assert not slow["host_wins_per_page"]
+    assert slow["breakeven_pages"] == float("inf")
+    with pytest.raises(ValueError):
+        spill_breakeven(n_params=1, page_size=4, per_page_bytes=1,
+                        h2d_gbs=0.0, flops_tps=1.0)
+
+
+# ---- config + loadgen knobs ------------------------------------------
+
+def test_host_spill_yaml_block_resolves():
+    from torchbooster_tpu.config import (HostSpillConfig,
+                                         ServingConfig, resolve_types)
+
+    data = {"page_size": 8, "n_pages": 32, "prefix_cache": True,
+            "host_spill": {"enabled": True, "budget_mb": 8.0}}
+    cfg = ServingConfig(**resolve_types(ServingConfig, data))
+    assert isinstance(cfg.host_spill, HostSpillConfig)
+    assert cfg.host_spill.enabled and cfg.host_spill.budget_mb == 8.0
+    # the default is OFF — a config that never mentions the block
+    # builds the spill-less engine
+    plain = ServingConfig(**resolve_types(ServingConfig,
+                                          {"page_size": 8}))
+    assert not plain.host_spill.enabled
+
+
+def test_loadgen_tenant_prefix_knobs():
+    """Multi-tenant prefix traffic: deterministic from seed, tenant
+    prompts share page-aligned prefixes, and — the separate-stream
+    contract — plain traffic is BYTE-IDENTICAL with the knobs off:
+    the tenant stream never perturbs the main one, so every tenant
+    prompt is the plain prompt plus a prefix."""
+    from torchbooster_tpu.serving.loadgen import synthesize
+
+    kw = dict(n_requests=12, seed=3, vocab=97, prompt_len=(4, 10),
+              max_new_tokens=(2, 4))
+    plain = synthesize("poisson", **kw)
+    a = synthesize("poisson", tenants=3, prefix_pages=2, page_size=4,
+                   **kw)
+    b = synthesize("poisson", tenants=3, prefix_pages=2, page_size=4,
+                   **kw)
+    assert a.fingerprint() == b.fingerprint() != plain.fingerprint()
+    assert a.meta["tenants"] == 3 and a.meta["prefix_pages"] == 2
+    assert "tenants" not in plain.meta
+
+    prefixes = {r.prompt[:8].tobytes() for r in a.requests}
+    assert len(prefixes) <= 3, "more distinct prefixes than tenants"
+    # arrival order survives the prefix concat, so pair by arrival
+    for rp, rt in zip(plain.requests, a.requests):
+        assert rt.arrival_s == rp.arrival_s
+        assert rt.prompt[8:].tobytes() == rp.prompt.tobytes(), \
+            "the tenant stream perturbed the main prompt stream"
+
+    with pytest.raises(ValueError):
+        synthesize("poisson", tenants=2, **kw)          # no pages
+    with pytest.raises(ValueError):
+        synthesize("poisson", prefix_pages=2, **kw)     # no tenants
+    with pytest.raises(ValueError):
+        synthesize("poisson", tenants=2, prefix_pages=2,
+                   page_size=0, **kw)
+
+
+# ---- fleet: the prefix directory ------------------------------------
+
+_PAGE = 4
+
+
+def _spill_fleet(directory):
+    from torchbooster_tpu.serving import (ContinuousBatcher,
+                                          EngineFleet, PagedEngine)
+
+    params, cfg = _decisive_model()
+    bs = [ContinuousBatcher(PagedEngine(
+        params, cfg, page_size=_PAGE, n_pages=16, max_slots=2,
+        compute_dtype=jnp.float32, prefix_cache=True,
+        prefill_chunk_pages=2, host_spill=True, host_spill_mb=4.0))
+        for _ in range(2)]
+    return EngineFleet(bs, routing="affinity", directory=directory)
+
+
+def _drain(fleet, clock, max_steps=4000):
+    steps = 0
+    while fleet.has_work and steps < max_steps:
+        fleet.step()
+        clock.advance(0.005)
+        steps += 1
+    assert steps < max_steps, "fleet wedged"
+
+
+def _bind_and_churn(directory, prefix):
+    """Session 1 of the directory scenarios: a keyless junk job loads
+    r0 so the tenant's first arrival least-loads onto r1 (its home),
+    then churn evicts the tenant's pages off home's HBM — they end
+    the session HOST-resident on home. Returns (fleet, clock, home)
+    with the session finished (the affinity map is gone; only the
+    directory remembers where the prefix lives)."""
+    from torchbooster_tpu.serving.batcher import Request
+    from torchbooster_tpu.serving.loadgen import ReplayClock
+
+    fleet = _spill_fleet(directory)
+    clock = ReplayClock()
+    fleet.clock = clock
+    fleet.start_session()
+    rs = np.random.RandomState(7)
+    fleet.submit(Request(prompt=rs.randint(0, 97, 3).astype(np.int32),
+                         max_new_tokens=12, request_id="junk"),
+                 arrival=0.0)
+    fleet.submit(Request(prompt=np.concatenate([prefix,
+                                                np.int32([5, 9])]),
+                         max_new_tokens=3, request_id="ta-0"),
+                 arrival=0.0)
+    _drain(fleet, clock)
+    home = dict(fleet.assignment_log)["ta-0"]
+    rep = fleet.replicas[home]
+    for i in range(20):
+        rep.batcher.submit(Request(
+            prompt=np.full(2 * _PAGE, 1 + (i % 90), np.int32),
+            max_new_tokens=2, request_id=f"ch{i}"))
+        while rep.batcher.has_work:
+            rep.batcher.step()
+    fleet.finish_session()
+    eng = rep.batcher.engine
+    assert eng.tables.n_host_pages >= len(prefix) // _PAGE, \
+        "churn failed to demote the tenant prefix"
+    return fleet, clock, home
+
+
+def test_fleet_directory_routes_to_holder_and_beats_control():
+    """The fleet acceptance: after the affinity map resets, a
+    re-arriving tenant with the directory routes BACK to the replica
+    holding its (now host-tier) prefix and promotes it — prefix-hit
+    pages strictly exceed the no-directory control, which cold-fills
+    on whichever replica least-loaded picks."""
+    from torchbooster_tpu.serving.batcher import Request
+
+    rs = np.random.RandomState(7)
+    prefix = rs.randint(0, 97, 3 * _PAGE).astype(np.int32)
+
+    def rearrive(directory):
+        fleet, clock, home = _bind_and_churn(directory, prefix)
+        base = sum(r.batcher.engine.host_hit_pages
+                   + r.batcher.engine.prefix_hit_pages
+                   for r in fleet.replicas)
+        fleet.start_session()
+        fleet.submit(Request(prompt=np.concatenate(
+            [prefix, np.int32([7, 3])]), max_new_tokens=3,
+            request_id="ta-1"), arrival=0.0)
+        _drain(fleet, clock)
+        hits = sum(r.batcher.engine.host_hit_pages
+                   + r.batcher.engine.prefix_hit_pages
+                   for r in fleet.replicas) - base
+        route = dict(fleet.assignment_log)["ta-1"]
+        n_dir = fleet.n_directory_hits
+        fleet.finish_session()
+        return fleet, hits, route, home, n_dir
+
+    fleet, hits, route, home, n_dir = rearrive(directory=True)
+    assert route == home, "the directory failed to route to holder"
+    assert n_dir >= 1
+    assert hits >= 3, "routing home never touched the cached prefix"
+    assert fleet.directory is not None
+    fleet.directory.check()
+    assert fleet.router_stats()["directory"]["entries"] > 0
+
+    _, hits_ctl, route_ctl, home_ctl, _ = rearrive(directory=False)
+    assert route_ctl != home_ctl, (
+        "control routed home by luck — the comparison proves nothing")
+    assert hits > hits_ctl, \
+        "the directory bought no hit pages over the control"
+
+
+def test_replica_death_purges_directory_and_rescues_host_pages():
+    """Satellite 6 regression (affinity metadata used to dangle on a
+    dead replica): kill the home replica while the tenant's pages are
+    host-tier — its directory entries purge (counted), the host
+    chains re-home onto the survivor by numpy copy, and the tenant's
+    re-arrival routes to the survivor and PROMOTES there instead of
+    recomputing."""
+    from torchbooster_tpu.observability.export import prometheus_text
+    from torchbooster_tpu.serving.batcher import Request
+
+    rs = np.random.RandomState(7)
+    prefix = rs.randint(0, 97, 3 * _PAGE).astype(np.int32)
+    fleet, clock, home = _bind_and_churn(directory=True, prefix=prefix)
+    survivor = fleet.replicas[1 - home]
+
+    fleet.start_session()
+    assert len(fleet.directory) > 0
+    fleet.kill(home)
+    assert fleet.n_directory_evictions > 0, \
+        "death left the dead replica's directory entries dangling"
+    assert fleet.directory.entries_for(home) == []
+    assert fleet.directory.n_reassigned > 0, \
+        "no host chain was rescued off the dead replica"
+    assert survivor.batcher.engine.tables.n_host_pages >= 3
+    fleet.directory.check()
+
+    h0 = survivor.batcher.engine.host_hit_pages
+    d0 = fleet.n_directory_hits
+    fleet.submit(Request(prompt=np.concatenate(
+        [prefix, np.int32([2, 8])]), max_new_tokens=3,
+        request_id="ta-2"), arrival=0.0)
+    _drain(fleet, clock)
+    assert dict(fleet.assignment_log)["ta-2"] == 1 - home
+    assert fleet.n_directory_hits > d0
+    assert survivor.batcher.engine.host_hit_pages > h0, \
+        "the rescued chain never promoted on the survivor"
+    stats = fleet.finish_session()
+    assert stats["router"]["n_directory_evictions"] > 0
+    txt = prometheus_text()
+    assert "router_directory_evictions_total" in txt
+    assert "router_directory_hits_total" in txt

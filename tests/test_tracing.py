@@ -326,6 +326,7 @@ _STABLE_KEYS = {
     "prefix_hit_pages", "prefix_hit_rate", "n_spec_steps",
     "n_spec_proposed", "n_spec_accepted", "spec_accept_rate",
     "spec_mean_accepted", "n_forks", "fork_pages", "n_cow_copies",
+    "n_spills", "n_promotions", "host_hit_pages",
     "n_shed", "n_cancelled",
     "deadline_hit_rate", "classes",
 }

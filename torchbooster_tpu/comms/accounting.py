@@ -28,7 +28,8 @@ from __future__ import annotations
 import re
 from typing import Any
 
-__all__ = ["overlap_report", "step_traffic", "record_step_traffic",
+__all__ = ["overlap_report", "promotion_traffic", "spill_breakeven",
+           "step_traffic", "record_step_traffic",
            "xla_collective_traffic"]
 
 SCALE_BYTES = 4      # fp32 per-bucket scales
@@ -160,6 +161,74 @@ def record_step_traffic(traffic: dict, registry: Any = None) -> None:
         "modeled per-replica gradient-sync bytes moved")
     for name, n_bytes in traffic["per_collective"].items():
         counter.inc(n_bytes, collective=name, mode=traffic["mode"])
+
+
+def promotion_traffic(n_pages: int, *, page_size: int, kv_heads: int,
+                      head_dim: int, n_layers: int,
+                      scale_bytes: int = SCALE_BYTES) -> dict:
+    """Host->HBM bytes of promoting ``n_pages`` spilled KV pages —
+    the PCIe (or, for a peer fetch, ICI) stream the spill tier pays
+    INSTEAD of recompute FLOPs. The payload is the engine's demotion
+    format exactly: per page, K and V as int8 (1 byte/elem over
+    ``n_layers * page_size * kv_heads * head_dim``) plus one fp32
+    scale per (layer, token, head) — per-(token, head) symmetric
+    quantization, ``models/gpt._quantize_kv``'s shape. Integer bytes:
+    the serve_spill bench gates this model EQUAL to the engine's
+    measured ``promoted_bytes`` counter, not approximately so."""
+    if n_pages < 0:
+        raise ValueError(f"n_pages must be >= 0, got {n_pages}")
+    elems = n_layers * page_size * kv_heads
+    per_page = 2 * elems * head_dim + 2 * elems * scale_bytes
+    return {
+        "n_pages": int(n_pages),
+        "payload_bytes_per_page": 2 * elems * head_dim,
+        "scale_bytes_per_page": 2 * elems * scale_bytes,
+        "per_page_bytes": per_page,
+        "total_bytes": per_page * int(n_pages),
+    }
+
+
+def spill_breakeven(*, n_params: int, page_size: int,
+                    per_page_bytes: int, h2d_gbs: float,
+                    flops_tps: float, launch_s: float = 50e-6,
+                    n_pages: int | None = None) -> dict:
+    """The spill tier's roofline (docs/performance.md "Page spill
+    tier"): a host-tier hit streams ``per_page_bytes`` per page over
+    PCIe at ``h2d_gbs`` GB/s; a cold miss recomputes prefill at ``2 *
+    n_params`` FLOPs per token on a ``flops_tps`` TFLOP/s chip. Both
+    costs are LINEAR in pages, so which side wins per page never
+    changes with prefix length — what makes short prefixes lose is
+    the fixed ``launch_s`` overhead of the promotion dispatch
+    (staging device_put + one executable launch). Break-even prefix
+    length::
+
+        P* = launch_s / (recompute_s_per_page - host_s_per_page)
+
+    — float('inf') when the stream is no faster per page than
+    recompute (then the tier only ever saves FLOPs, never TTFT, and
+    the operator should shrink ``budget_mb`` to zero). Pass
+    ``n_pages`` to also evaluate both modeled TTFTs at a concrete
+    prefix."""
+    if h2d_gbs <= 0 or flops_tps <= 0:
+        raise ValueError(
+            f"h2d_gbs and flops_tps must be > 0, got {h2d_gbs}, "
+            f"{flops_tps}")
+    host_s = per_page_bytes / (h2d_gbs * 1e9)
+    rec_s = 2.0 * n_params * page_size / (flops_tps * 1e12)
+    gain = rec_s - host_s
+    out = {
+        "host_s_per_page": host_s,
+        "recompute_s_per_page": rec_s,
+        "launch_s": float(launch_s),
+        "breakeven_pages": (launch_s / gain) if gain > 0
+        else float("inf"),
+        "host_wins_per_page": gain > 0,
+    }
+    if n_pages is not None:
+        out["n_pages"] = int(n_pages)
+        out["ttft_host_s"] = launch_s + n_pages * host_s
+        out["ttft_recompute_s"] = n_pages * rec_s
+    return out
 
 
 # `= f32[2,4]{1,0} all-reduce(` / `= (s8[512]{0}, f32[4]{0}) all-to-all(`

@@ -701,6 +701,37 @@ class FrontendConfig(BaseConfig):
 
 
 @dataclass
+class HostSpillConfig(BaseConfig):
+    """The KV-cache host spill tier (PR 16), nested under
+    ``serving:`` as its ``host_spill:`` sub-block. No reference
+    analogue — this is the memory hierarchy under the paged prefix
+    cache.
+
+    YAML block::
+
+        serving:
+          host_spill:
+            enabled: true      # demote evicted prefix pages to host
+            budget_mb: 64.0    # host-pool LRU byte budget
+
+    ``enabled: true`` (needs ``prefix_cache: true``) turns LRU
+    eviction of registered prefix pages into DEMOTION: the page's
+    K/V quantize to int8 (+ fp32 per-(token, head) scales —
+    ``models/gpt._quantize_kv``'s exact shape; int8 pools copy
+    losslessly) into a host-DRAM pool bounded by ``budget_mb``, and
+    a later request matching the chain promotes them back through
+    one compiled fixed-shape H2D write instead of recomputing
+    prefill — TTFT on a host hit pays PCIe stream time, not FLOPs
+    (docs/performance.md "Page spill tier" has the roofline and the
+    break-even prefix length). Off (the default), eviction frees
+    pages exactly as PR 4 shipped it, and no staging buffers exist.
+    """
+
+    enabled: bool = False              # demote instead of free
+    budget_mb: float = 64.0            # host LRU pool byte budget
+
+
+@dataclass
 class RouterConfig(BaseConfig):
     """The engine-fleet router (torchbooster_tpu/serving/router):
     N data-parallel engine replicas behind one front door. Nested
@@ -739,6 +770,15 @@ class RouterConfig(BaseConfig):
     with their generated tokens folded into their prompts (nothing
     lost, nothing duplicated). See docs/serving.md "The engine
     fleet" for the full contract.
+
+    ``directory: true`` (the default) maintains the fleet-wide
+    PREFIX DIRECTORY (PR 16): chain-key -> {replica, tier} from every
+    replica's page-tier events, consulted by the affinity policy on a
+    map miss so a re-arriving tenant routes to whichever replica
+    actually holds its pages (HBM- or host-tier) instead of
+    recomputing; replica death purges the dead entries (the
+    ``router_directory_evictions`` counter) and rescues its host-tier
+    chains onto a survivor. ``directory: false`` is the A/B control.
     """
 
     n_replicas: int = 1                # 1 = plain single batcher
@@ -747,6 +787,7 @@ class RouterConfig(BaseConfig):
     spill_queue: int = 4               # hot-prefix spill threshold
     rebalance_queue: int = 0           # 0 = hot-spot rebalance off
     rebalance_after: int = 8           # sustained-imbalance steps
+    directory: bool = True             # fleet-wide prefix directory
 
     def make_routing(self) -> Any:
         from torchbooster_tpu.serving.router import make_routing
@@ -762,7 +803,8 @@ class RouterConfig(BaseConfig):
 
         return EngineFleet(batchers, routing=self.make_routing(),
                            rebalance_queue=self.rebalance_queue,
-                           rebalance_after=self.rebalance_after)
+                           rebalance_after=self.rebalance_after,
+                           directory=self.directory)
 
 
 @dataclass
@@ -789,6 +831,13 @@ class ServingConfig(BaseConfig):
     interleaves between decode steps: one compiled chunk shape serves
     every prompt length, and decode latency stays bounded by one
     chunk while long prompts stream in.
+
+    ``host_spill:`` (see :class:`HostSpillConfig`; needs
+    ``prefix_cache``) adds the second page tier under the prefix
+    cache: LRU eviction demotes registered prefix pages to a bounded
+    host-DRAM pool instead of freeing them, and a later match
+    promotes them back over PCIe through one compiled fixed-shape
+    write — host-hit TTFT pays stream time, not recompute FLOPs.
 
     ``speculative: true`` switches decode to draft + batched-verify
     (serving/speculative.py): model-free prompt-lookup drafting
@@ -858,6 +907,8 @@ class ServingConfig(BaseConfig):
         default_factory=FrontendConfig)  # HTTP front door + scheduler
     router: RouterConfig = dataclasses.field(
         default_factory=RouterConfig)  # engine-fleet replica scale-out
+    host_spill: HostSpillConfig = dataclasses.field(
+        default_factory=HostSpillConfig)  # host-RAM page spill tier
 
     def make(self, params: Any, model_cfg: Any,
              compute_dtype: Any = None,
@@ -922,6 +973,8 @@ class ServingConfig(BaseConfig):
                 tree_width=self.spec_tree_width,
                 parallel_sampling=self.parallel_sampling,
                 decode_backend=self.decode_backend,
+                host_spill=self.host_spill.enabled,
+                host_spill_mb=self.host_spill.budget_mb,
                 tp=self.tp, mesh=mesh)
 
         # ONE policy object serves every replica AND the fleet-level
@@ -976,6 +1029,13 @@ class LoadgenConfig(BaseConfig):
     fan-out (``n = best_of`` drawn in ``[2, n_max]``), so replays
     carry OpenAI ``n``/``best_of`` traffic through the harness —
     serve them against a ``serving.parallel_sampling: true`` engine.
+    ``tenants > 0`` (with ``prefix_pages >= 1``) prepends each
+    synthetic request with one of ``tenants`` fixed page-aligned
+    system prompts of ``prefix_pages * prefix_page_size`` tokens —
+    the many-tenant shared-prefix shape that overflows the HBM
+    prefix cache and exercises the host spill tier (match
+    ``prefix_page_size`` to ``serving.page_size``); ``tenants: 0``
+    traffic is byte-identical to pre-knob workloads.
 
     ``make()`` returns the
     :class:`~torchbooster_tpu.serving.loadgen.workload.Workload`;
@@ -997,6 +1057,9 @@ class LoadgenConfig(BaseConfig):
     cancel_frac: float = 0.0           # recorded client disconnects
     n_frac: float = 0.0                # fraction with n/best_of > 1
     n_max: int = 4                     # largest synthetic n
+    tenants: int = 0                   # 0 = no shared tenant prefixes
+    prefix_pages: int = 0              # tenant system-prompt pages
+    prefix_page_size: int = 64         # page alignment of the prefix
 
     def make(self) -> Any:
         from torchbooster_tpu.serving.loadgen.workload import (
@@ -1021,7 +1084,10 @@ class LoadgenConfig(BaseConfig):
                 prompt_len=tuple(self.prompt_len),
                 max_new_tokens=tuple(self.max_new_tokens),
                 classes=self.classes, cancel_frac=self.cancel_frac,
-                n_frac=self.n_frac, n_max=self.n_max)
+                n_frac=self.n_frac, n_max=self.n_max,
+                tenants=self.tenants,
+                prefix_pages=self.prefix_pages,
+                page_size=self.prefix_page_size)
         # the block's replay default: drivers called without an
         # explicit speed= read it back from the workload, so the
         # YAML knob actually governs the replay (meta never enters
@@ -1283,6 +1349,7 @@ __all__ = [
     "DatasetConfig",
     "EnvConfig",
     "EnvironementConfig",
+    "HostSpillConfig",
     "HyperParameterConfig",
     "LoadgenConfig",
     "LoaderConfig",

@@ -61,7 +61,13 @@ _DTYPE = np.dtype([
     ("seq", np.int64), ("kind", np.int8),
     ("slots_live", np.int16), ("slots_filling", np.int16),
     ("pages_live", np.int32), ("pages_free", np.int32),
-    ("pages_cached", np.int32), ("queue_depth", np.int32),
+    ("pages_cached", np.int32),
+    # the host spill tier (PR 16): host-resident demoted pages plus
+    # this step's tier traffic — a TTFT post-mortem must distinguish
+    # "recomputed" from "streamed back over PCIe"
+    ("pages_host", np.int32), ("spills", np.int32),
+    ("promotions", np.int32), ("host_hit_pages", np.int32),
+    ("queue_depth", np.int32),
     ("tokens", np.int32), ("accept_rate", np.float32),
     ("wall_s", np.float32), ("recompiled", np.bool_),
     # tensor-parallel head shards the step ran over (1 = single-chip):
@@ -119,7 +125,9 @@ class FlightRecorder:
                queue_depth: int, tokens: int, accept_rate: float,
                wall_s: float, recompiled: bool = False,
                inflight: Iterable[str] = (), tp: int = 1,
-               branches: int = 0) -> None:
+               branches: int = 0, pages_host: int = 0,
+               spills: int = 0, promotions: int = 0,
+               host_hit_pages: int = 0) -> None:
         """Write one step record in place and run the watchdog."""
         seq = self._seq
         row = self._ring[seq % self.capacity]
@@ -130,6 +138,10 @@ class FlightRecorder:
         row["pages_live"] = pages_live
         row["pages_free"] = pages_free
         row["pages_cached"] = pages_cached
+        row["pages_host"] = pages_host
+        row["spills"] = spills
+        row["promotions"] = promotions
+        row["host_hit_pages"] = host_hit_pages
         row["queue_depth"] = queue_depth
         row["tokens"] = tokens
         row["accept_rate"] = accept_rate

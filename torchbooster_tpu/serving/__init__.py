@@ -74,6 +74,7 @@ from torchbooster_tpu.serving.frontend import (
 )
 from torchbooster_tpu.serving.kv_pages import (
     BlockTables,
+    HostPagePool,
     NULL_PAGE,
     make_pool,
 )
@@ -85,7 +86,7 @@ from torchbooster_tpu.serving.speculative import (
 
 
 _ROUTER_NAMES = ("EngineFleet", "InProcessReplica", "AffinityRouting",
-                 "RoundRobinRouting")
+                 "RoundRobinRouting", "PrefixDirectory")
 
 
 def __getattr__(name: str):
@@ -102,8 +103,9 @@ def __getattr__(name: str):
 
 
 __all__ = ["AffinityRouting", "BlockTables", "ContinuousBatcher",
-           "EngineFleet", "FCFSPolicy", "InProcessReplica",
-           "NO_DRAFT", "NULL_PAGE", "PagedEngine", "PriorityClass",
-           "PromptLookupDrafter", "Request", "RoundRobinRouting",
-           "SLOPolicy", "SchedulerPolicy", "ServingFrontend",
-           "TreeLookupDrafter", "make_pool"]
+           "EngineFleet", "FCFSPolicy", "HostPagePool",
+           "InProcessReplica", "NO_DRAFT", "NULL_PAGE", "PagedEngine",
+           "PrefixDirectory", "PriorityClass", "PromptLookupDrafter",
+           "Request", "RoundRobinRouting", "SLOPolicy",
+           "SchedulerPolicy", "ServingFrontend", "TreeLookupDrafter",
+           "make_pool"]

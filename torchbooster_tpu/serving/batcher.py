@@ -244,6 +244,9 @@ class _Session:
         self.hits0 = eng.prefix_hit_pages
         self.lookups0 = eng.prefix_lookup_pages
         self.chunks0 = eng.prefill_chunks
+        self.spills0 = eng.spills
+        self.promotions0 = eng.promotions
+        self.host_hits0 = eng.host_hit_pages
         self.spec_steps0 = eng.spec_steps
         self.spec_prop0 = eng.spec_proposed
         self.spec_acc0 = eng.spec_accepted
@@ -472,6 +475,7 @@ class ContinuousBatcher:
             "queue_depth": self.queue_depth,
             "pages_free": int(eng.tables.n_free_pages),
             "pages_cached": int(eng.tables.n_cached_pages),
+            "pages_host": int(eng.tables.n_host_pages),
             "inflight": self.inflight,
             "occupancy": round(self.occupancy, 4),
             "est_step_s": round(self.est_step_s, 6),
@@ -649,6 +653,19 @@ class ContinuousBatcher:
                 "private tail pages copied at fork (the only bytes "
                 "n-way sampling duplicates)"),
         }
+        if self.engine.host_spill:
+            # the host spill tier only (absent with host_spill=False
+            # so the spill-less registry view is untouched): tier
+            # traffic counters, host integer adds per landing
+            inst["spills"] = reg.counter(
+                "serving_page_spills_total",
+                "KV pages demoted HBM -> host at eviction")
+            inst["promotions"] = reg.counter(
+                "serving_page_promotions_total",
+                "KV pages promoted host -> HBM at seat time")
+            inst["host_hits"] = reg.counter(
+                "serving_host_hit_pages_total",
+                "prompt pages matched in the host spill tier")
         if self.engine.tp > 1:
             # tensor-parallel serving only (absent at tp=1 so the
             # single-chip registry view is untouched): the modeled
@@ -1007,6 +1024,12 @@ class ContinuousBatcher:
         eng = self.engine
         c0 = (eng.decode_compiles + eng.verify_compiles
               + eng.prefill_compiles)
+        # host-tier baselines: the flight row carries THIS step's tier
+        # traffic (deltas of the engine's cumulative counters). The
+        # promote executable is excluded from the recompile diff for
+        # the same reason the cow one is: its single lazy first-use
+        # compile is the contract, not an anomaly.
+        sp0, pr0, hh0 = eng.spills, eng.promotions, eng.host_hit_pages
         st = {"wall": 0.0, "prefill": False, "decode": False,
               "spec": False, "prop": 0, "acc": 0}
         events: list = []
@@ -1026,6 +1049,10 @@ class ContinuousBatcher:
                 pages_live=int(eng.tables.n_live_pages),
                 pages_free=int(eng.tables.n_free_pages),
                 pages_cached=int(eng.tables.n_cached_pages),
+                pages_host=int(eng.tables.n_host_pages),
+                spills=eng.spills - sp0,
+                promotions=eng.promotions - pr0,
+                host_hit_pages=eng.host_hit_pages - hh0,
                 queue_depth=len(s.queue),
                 tokens=sum(len(toks) for _, toks in events),
                 accept_rate=(st["acc"] / st["prop"]) if st["prop"]
@@ -1106,6 +1133,14 @@ class ContinuousBatcher:
         # decode: long prompts stream in while the live slots keep
         # producing tokens ---
         if self.engine.has_pending:
+            # host->HBM promotions dispatch BEFORE the chunk issues:
+            # a host-tier hit's TTFT pays the async H2D stream
+            # (overlapped with this iteration's chunk/decode work),
+            # never the recompute FLOPs the hit skipped — and a chunk
+            # that attends promoted pages is ordered after the write
+            # by the donated-pool data dependency
+            if self.engine.host_spill:
+                self.engine.issue_promotions()
             # the chunk's slot, read only when tracing will use it
             # (pending_slots builds a list — not free on the hot loop)
             fill_slot = (self.engine.pending_slots[0]
@@ -1340,6 +1375,12 @@ class ContinuousBatcher:
         inst["spec_rate"].set(n_spec_acc / max(n_spec_prop, 1))
         inst["fork_pages"].inc(self.engine.fork_pages - s.fork_pages0)
         inst["cow_copies"].inc(self.engine.cow_copies - s.cow0)
+        if "spills" in inst:
+            inst["spills"].inc(self.engine.spills - s.spills0)
+            inst["promotions"].inc(
+                self.engine.promotions - s.promotions0)
+            inst["host_hits"].inc(
+                self.engine.host_hit_pages - s.host_hits0)
         if self.policy.slo:
             for name, cs in s.per_class.items():
                 inst["slo_ttft_rate"].set(
@@ -1414,6 +1455,13 @@ class ContinuousBatcher:
             "spec_mean_accepted": round(
                 (self.engine.spec_accepted - s.spec_acc0)
                 / max(self.engine.spec_steps - s.spec_steps0, 1), 4),
+            # host spill tier stats (all zero on a spill-less
+            # engine): demotions, promotions, and the prompt pages
+            # whose TTFT paid the H2D stream instead of recompute
+            "n_spills": self.engine.spills - s.spills0,
+            "n_promotions": self.engine.promotions - s.promotions0,
+            "host_hit_pages":
+                self.engine.host_hit_pages - s.host_hits0,
             # copy-on-write parallel sampling (all zero on a
             # non-parallel engine): forks performed, pages SHARED
             # into branches (HBM reads amortized), and the private
@@ -1444,6 +1492,8 @@ class ContinuousBatcher:
                     "n_admissions": 0, "n_preemptions": 0,
                     "n_prefill_chunks": 0, "prefix_hit_pages": 0,
                     "prefix_hit_rate": 0.0,
+                    "n_spills": 0, "n_promotions": 0,
+                    "host_hit_pages": 0,
                     "n_spec_steps": 0, "n_spec_proposed": 0,
                     "n_spec_accepted": 0, "spec_accept_rate": 0.0,
                     "spec_mean_accepted": 0.0,

@@ -78,6 +78,7 @@ from torchbooster_tpu.ops.paged_attention import paged_attention
 from torchbooster_tpu.serving.kv_pages import (
     NULL_PAGE,
     BlockTables,
+    HostPagePool,
     make_pool,
 )
 from torchbooster_tpu.serving.tp import (
@@ -95,6 +96,19 @@ from torchbooster_tpu.serving.speculative import (
     tree_accept_path,
     tree_masks,
 )
+
+
+def _quantize_page_np(x: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """Host-side mirror of ``models.gpt._quantize_kv`` for one page
+    slab (float32 in): symmetric per-(token, head) int8 over the head
+    dim. The host payload keeps FLOAT32 scales — the compiled promote
+    write casts to the pool's scale dtype, so an int8-pool round-trip
+    through the host tier is bit-exact and a wide-pool round-trip
+    costs exactly the int8 cache's noise budget, never more."""
+    scale = np.max(np.abs(x), axis=-1, keepdims=True) / 127.0
+    scale = np.maximum(scale, 1e-8).astype(np.float32)
+    q = np.clip(np.round(x / scale), -127, 127).astype(np.int8)
+    return q, scale
 
 
 class PagedEngine:
@@ -211,7 +225,9 @@ class PagedEngine:
                  mesh: Any = None,
                  parallel_sampling: bool = False,
                  spec_tree: bool = False,
-                 tree_width: int = 2):
+                 tree_width: int = 2,
+                 host_spill: bool = False,
+                 host_spill_mb: float = 64.0):
         if cfg.seq_len % page_size:
             # a last partial page per slot would shift page_pos math;
             # geometry is static, so fail loudly at construction
@@ -254,6 +270,18 @@ class PagedEngine:
                 "exclusive: the per-branch PRNG/logprob accounting "
                 "rides the plain decode step — serve n-way traffic "
                 "on a non-speculative engine")
+        if host_spill and not prefix_cache:
+            raise ValueError(
+                "host_spill=True needs prefix_cache=True: the spill "
+                "tier demotes REGISTERED prefix pages at eviction — "
+                "without the prefix index there is nothing to demote "
+                "or promote")
+        if host_spill and tp > 1:
+            raise ValueError(
+                f"host_spill with tp={tp} is not supported yet: the "
+                "promotion executable would need a shard_map wrapper "
+                "over the KV-head-sharded pool — run the spill tier "
+                "on tp=1 replicas (the fleet path)")
         # same params/config positional-encoding guard the dense
         # generate() applies — a rope checkpoint served with
         # pos="learned" (or vice versa, or a tp-major-permuted tree)
@@ -297,6 +325,36 @@ class PagedEngine:
         self.pool = make_pool(cfg, page_size, n_pages,
                               cache_dtype=cache_dtype,
                               compute_dtype=compute_dtype)
+        # the host spill tier (PR 16): LRU eviction demotes registered
+        # prefix pages to a host-DRAM pool (int8 + scales) and a later
+        # seat promotes them back through ONE fixed-shape compiled
+        # write over pinned staging buffers — the H2D stream replaces
+        # the recompute FLOPs (docs/performance.md "Page spill tier").
+        # Off (the default), no staging buffers exist and eviction
+        # frees pages exactly as PR 4 shipped it.
+        self.host_spill = bool(host_spill)
+        self._promote_jit = None
+        self._promote_lanes = 0
+        self._stage: dict[str, np.ndarray] = {}
+        if self.host_spill:
+            self.tables.host_pool = HostPagePool(
+                max(1, int(host_spill_mb * (1 << 20))))
+            self.tables.spill_fetch = self._spill_fetch
+            head_dim = cfg.d_model // cfg.n_heads
+            lanes = self.prefill_chunk_pages
+            self._promote_lanes = lanes
+            stage_shape = (lanes, cfg.n_layers, page_size,
+                           cfg.kv_heads, head_dim)
+            # pinned host staging: fixed shapes so every promotion
+            # group rides the same device_put layout and the compiled
+            # write never re-specializes; device_put snapshots the
+            # buffer, so lane reuse across groups cannot race
+            self._stage = {
+                "k": np.zeros(stage_shape, np.int8),
+                "v": np.zeros(stage_shape, np.int8),
+                "k_scale": np.ones(stage_shape[:-1] + (1,), np.float32),
+                "v_scale": np.ones(stage_shape[:-1] + (1,), np.float32),
+            }
         if self.tp > 1:
             # one-time layout work, never per step: permute the qkv
             # columns rank-major (rank i holds [q_i | k_i | v_i] — a
@@ -328,6 +386,10 @@ class PagedEngine:
         self.prefill_chunks = 0
         self.prefix_hit_pages = 0
         self.prefix_lookup_pages = 0
+        self.spills = 0          # pages demoted HBM -> host
+        self.promotions = 0      # pages promoted host -> HBM
+        self.host_hit_pages = 0  # seat-time matches served host-tier
+        self.promoted_bytes = 0  # measured H2D payload bytes staged
         self.spec_proposed = 0
         self.spec_accepted = 0
         self.spec_steps = 0
@@ -784,6 +846,107 @@ class PagedEngine:
 
         return copy(pool_k), copy(pool_v)
 
+    # ---- the host spill tier -------------------------------------
+    def _spill_fetch(self, p: int) -> dict:
+        """Demotion payload for pool page ``p``: int8 K/V values plus
+        float32 per-(token, head) scales across every layer, as host
+        numpy arrays keyed like the staging buffers. This is the
+        spill tier's ONE deliberate device->host read, and it runs on
+        the ADMISSION cadence (an eviction inside ``seat``), never
+        inside a decode step. int8 pools ship their stored payload
+        verbatim (a lossless round-trip); wide pools quantize here,
+        mirroring ``_quantize_kv``."""
+        if self.quantized:
+            k = np.asarray(jax.device_get(self.pool["k"][0][:, p]))
+            v = np.asarray(jax.device_get(self.pool["v"][0][:, p]))
+            ks = np.asarray(jax.device_get(
+                self.pool["k"][1][:, p])).astype(np.float32)
+            vs = np.asarray(jax.device_get(
+                self.pool["v"][1][:, p])).astype(np.float32)
+        else:
+            kf = np.asarray(jax.device_get(
+                self.pool["k"][:, p])).astype(np.float32)
+            vf = np.asarray(jax.device_get(
+                self.pool["v"][:, p])).astype(np.float32)
+            k, ks = _quantize_page_np(kf)
+            v, vs = _quantize_page_np(vf)
+        self.spills += 1
+        return {"k": k, "k_scale": ks, "v": v, "v_scale": vs}
+
+    def _promote_fn(self, pool_k, pool_v, k_q, k_s, v_q, v_s, dst):
+        """The host->HBM promotion write: staged pages land at pool
+        ids ``dst`` across every layer. Fixed shapes — the ``(lanes,
+        n_layers, page_size, kv_heads, head_dim)`` staging block plus
+        a ``(lanes,)`` id vector, pad lanes targeting the reserved
+        null page (junk on page 0 is masked everywhere — the cow
+        pad's contract) — so promotion churn compiles exactly ONE
+        executable. The pools are donated and rebound by the caller:
+        any chunk or decode step dispatched after a promotion reads
+        the rebound arrays, so ordering is a device-side data
+        dependency and the host never blocks on the stream."""
+
+        def write(pool, q, s):
+            vals = jnp.moveaxis(q, 0, 1)   # (L, lanes, ps, H, D)
+            scl = jnp.moveaxis(s, 0, 1)
+            if isinstance(pool, tuple):
+                return (pool[0].at[:, dst].set(vals),
+                        pool[1].at[:, dst].set(
+                            scl.astype(pool[1].dtype)))
+            wide = (vals.astype(jnp.float32) * scl).astype(pool.dtype)
+            return pool.at[:, dst].set(wide)
+
+        return write(pool_k, k_q, k_s), write(pool_v, v_q, v_s)
+
+    def issue_promotions(self) -> int:
+        """Dispatch every queued host->HBM promotion. The batcher
+        calls this right before chunk issue, so a host hit's TTFT
+        pays the H2D stream time while the first non-dependent chunk
+        overlaps it; ``prefill_step`` also fires it defensively for
+        directly-driven engines. Payloads stream through the fixed
+        staging buffers in ``lanes``-sized groups — the same compiled
+        write every group — and the promoted keys then re-enter the
+        HBM prefix index at their seated table positions. Returns the
+        number of pages promoted (host integers; the dispatch itself
+        is async)."""
+        if not self.host_spill:
+            return 0
+        # lazy ONE-time build (first promotion of the engine's life):
+        # fixed staging shapes mean this is the only compile ever
+        if self._promote_jit is None:
+            self._promote_jit = jax.jit(self._promote_fn,
+                                        donate_argnums=(0, 1))
+        n = 0
+        for p in self._pending:
+            work = p.pop("promote", None)
+            if not work:
+                continue
+            keys, payloads = work["keys"], work["payloads"]
+            start_idx = work["start_idx"]
+            row = self.tables.tables[p["slot"]]
+            lanes = self._promote_lanes
+            with span("serving_promote"):
+                for g in range(0, len(keys), lanes):
+                    grp = payloads[g:g + lanes]
+                    dst = np.zeros(lanes, np.int32)  # pad -> null
+                    for i, pl in enumerate(grp):
+                        for name in ("k", "k_scale", "v", "v_scale"):
+                            self._stage[name][i] = pl[name]
+                        dst[i] = row[start_idx + g + i]
+                        self.promoted_bytes += sum(
+                            int(a.nbytes) for a in pl.values())
+                    pool_k, pool_v = self._promote_jit(
+                        self.pool["k"], self.pool["v"],
+                        jax.device_put(self._stage["k"]),
+                        jax.device_put(self._stage["k_scale"]),
+                        jax.device_put(self._stage["v"]),
+                        jax.device_put(self._stage["v_scale"]),
+                        jnp.asarray(dst))
+                    self.pool = {"k": pool_k, "v": pool_v}
+            self.tables.promote_keys(p["slot"], keys, start_idx)
+            self.promotions += len(keys)
+            n += len(keys)
+        return n
+
     # ---- host lifecycle ------------------------------------------
     def can_admit(self, prompt_ids: np.ndarray) -> bool:
         """Dry-run of :meth:`admit_begin`'s checks (slot, horizon, and
@@ -839,12 +1002,28 @@ class PagedEngine:
         # quadratic in prompt length, so never repeated within an
         # attempt; a failed attempt that got past the bail above may
         # re-walk on retry, which only happens when a seat is
-        # plausibly one retire away)
-        matched = self.tables.match_pages(prompt)
+        # plausibly one retire away). With the spill tier on, the
+        # walk continues past the HBM chain into the host pool —
+        # host-tier matches still need pool pages ALLOCATED (only HBM
+        # hits discount the capacity math), they just skip the
+        # prefill FLOPs: their content arrives over PCIe instead.
+        matched, host_keys = self.tables.match_tiered(prompt)
         n_matched = len(matched)
         if self.tables.pages_for(s0) - n_matched \
                 > self.tables.n_available_pages:
             return None
+        # pop the host payloads BEFORE seating: seat() itself can
+        # evict-demote under pressure, and a demotion landing in the
+        # host pool could LRU-evict the very pages just matched. Once
+        # popped they are promotion-or-bust — re-put on seat failure
+        # (below) or on a retire that beats the promotion.
+        payloads: list[dict] = []
+        for i, key in enumerate(host_keys):
+            pl = self.tables.host_pool.pop(key)
+            if pl is None:           # defensive: cut the chain at a gap
+                host_keys = host_keys[:i]
+                break
+            payloads.append(pl)
         try:
             self.tables.seat(slot, prompt, matched=matched)
         except RuntimeError:
@@ -856,9 +1035,13 @@ class PagedEngine:
             # LRU), so the request just stays queued until retires
             # return pages — the same contract as any other
             # not-enough-pages admission.
+            for key, pl in zip(host_keys, payloads):
+                self.tables.host_pool.put(key, pl)
             return None
         self.prefix_lookup_pages += (s0 - 1) // self.page_size
         self.prefix_hit_pages += n_matched
+        n_host = len(host_keys)
+        self.host_hit_pages += n_host
         if self.parallel:
             # admission-cadence host jax (never per step): the base
             # key identifies the REQUEST, the folded key its branch
@@ -872,16 +1055,21 @@ class PagedEngine:
             # the prompt seeds the slot's lookup stream — prompt
             # tokens are exactly what prompt-lookup drafting mines
             self._drafter.begin(slot, prompt)
-        # chunking starts at the matched boundary (page-aligned by
+        # chunking starts past BOTH tiers' matches (page-aligned by
         # construction) — the cache hit's whole point is skipping the
-        # matched pages' chunks; pad the tail to a whole chunk
-        start = n_matched * self.page_size
+        # matched pages' chunks: HBM hits are mapped shares, host
+        # hits get filled by the promotion stream before the first
+        # chunk issues; pad the tail to a whole chunk
+        start = (n_matched + n_host) * self.page_size
         n_chunks = -(-(s0 - start) // self.chunk_tokens)
         padded = np.zeros(start + n_chunks * self.chunk_tokens,
                           np.int32)
         padded[:s0] = prompt
-        self._pending.append(
-            {"slot": slot, "ids": padded, "s0": s0, "start": start})
+        pend = {"slot": slot, "ids": padded, "s0": s0, "start": start}
+        if host_keys:
+            pend["promote"] = {"keys": host_keys, "payloads": payloads,
+                               "start_idx": n_matched}
+        self._pending.append(pend)
         return slot
 
     @property
@@ -911,6 +1099,11 @@ class PagedEngine:
         None."""
         if not self._pending:
             return None
+        if self.host_spill:
+            # defensive for directly-driven engines: the batcher
+            # already promoted before chunk issue; a chunk must never
+            # attend host-matched pages that were not written yet
+            self.issue_promotions()
         p = self._pending[0]
         if self.parallel:
             # the slot's BRANCH KEY rides the rng operand: the chunk
@@ -1250,6 +1443,14 @@ class PagedEngine:
         """Release the slot (cancelling any in-flight prefill); shared
         prefix pages stay resident for later hits, everything else
         frees (kv_pages.py refcount/evict lifetime)."""
+        for p in self._pending:
+            # a retire that beats the promotion: the popped host
+            # payloads go back to the host pool instead of vanishing
+            # with the cancelled prefill
+            if p["slot"] == slot and "promote" in p:
+                work = p.pop("promote")
+                for key, pl in zip(work["keys"], work["payloads"]):
+                    self.tables.host_pool.put(key, pl)
         self._pending = [p for p in self._pending
                          if p["slot"] != slot]
         if self._drafter is not None:
@@ -1287,6 +1488,16 @@ class PagedEngine:
             "prefix_hit_pages": self.prefix_hit_pages,
             "prefix_lookup_pages": self.prefix_lookup_pages,
             "prefix_hit_rate": round(self.prefix_hit_rate, 4),
+            "host_spill": self.host_spill,
+            "pages_host": int(t.n_host_pages),
+            "spills": self.spills,
+            "promotions": self.promotions,
+            "host_hit_pages": self.host_hit_pages,
+            "promoted_bytes": self.promoted_bytes,
+            "host_bytes_used": (int(t.host_pool.used_bytes)
+                                if t.host_pool is not None else 0),
+            "host_evictions": (int(t.host_pool.n_evictions)
+                               if t.host_pool is not None else 0),
             "spec_steps": self.spec_steps,
             "spec_proposed": self.spec_proposed,
             "spec_accepted": self.spec_accepted,
@@ -1296,7 +1507,8 @@ class PagedEngine:
             "branch_slots": self.branch_slot_count,
             "compiles": {"decode": self.decode_compiles,
                          "prefill": self.prefill_compiles,
-                         "verify": self.verify_compiles},
+                         "verify": self.verify_compiles,
+                         "promote": self.promote_compiles},
         }
 
     @property
@@ -1365,6 +1577,17 @@ class PagedEngine:
         (the verify executable does not exist on the cold engine)."""
         return (self._verify_jit._cache_size()
                 if self._verify_jit is not None else 0)
+
+    @property
+    def promote_compiles(self) -> int:
+        """Compiled promotion-write count — exactly ONE whatever
+        group sizes demote/promote churn produces (fixed staging
+        shapes, pad lanes hit the null page); always 0 until the
+        first host hit, and always 0 with ``host_spill=False`` (the
+        executable does not exist on the spill-less engine — the same
+        collapse contract as the cow/verify executables)."""
+        return (self._promote_jit._cache_size()
+                if self._promote_jit is not None else 0)
 
     @property
     def spec_accept_rate(self) -> float:

@@ -52,6 +52,21 @@ inactive slots' write targets all point at it, its refcount stays 0
 forever, and the attention sweep masks it out — so a dead slot can
 scribble into the pool without a branch and without corrupting any
 live sequence.
+
+**The host spill tier (PR 16).** With a :class:`HostPagePool`
+attached (``serving.host_spill.enabled``), LRU eviction becomes a
+DEMOTION: the evicted page's K/V stream to a host-DRAM buffer (int8
+values + per-(token, head) float32 scales — 1 byte/elem on the wire,
+the quantized-transfer playbook) keyed by the SAME chain-key bytes
+the HBM index uses, and the pool slot returns to the free list. The
+three-way partition invariant is untouched — a host-resident page
+occupies NO pool id, is never refcounted, and exists only as (key →
+payload) in the host pool. :meth:`match_tiered` extends the chain
+walk across both tiers in one lookup: the HBM-resident prefix first,
+then the host-resident continuation, so the engine can map the HBM
+pages shared and PROMOTE the host pages back (one fixed-shape H2D
+copy instead of recompute FLOPs). The host pool is itself LRU under
+a byte budget; pages that fall off its tail are gone for real.
 """
 from __future__ import annotations
 
@@ -96,6 +111,94 @@ def make_pool(cfg: GPTConfig, page_size: int, n_pages: int,
     else:
         mk = lambda: jnp.zeros(shape, compute_dtype)
     return {"k": mk(), "v": mk()}
+
+
+class HostPagePool:
+    """The host-DRAM page spill tier: demoted prefix pages as
+    ``chain-key bytes -> payload`` entries under a byte budget.
+
+    A payload is an opaque dict of HOST numpy arrays (the engine's
+    demotion callback builds it: int8 K/V values + float32 scales for
+    one page across every layer) — this class only owns the
+    residency policy: LRU by insertion/touch tick, evict-oldest when
+    a ``put`` would overflow ``budget_bytes``. Pure host bookkeeping,
+    no device handles anywhere — which is what lets a fleet move
+    entries between replicas' pools with a plain numpy copy (the
+    router's host-tier fetch) and lets the tier survive a replica
+    death (host DRAM outlives the replica's device state).
+
+    Counters (host integers, exported via ``debug_stats``/flight):
+    ``n_spills`` pages demoted in, ``n_evictions`` pages dropped by
+    the budget, ``used_bytes`` current residency."""
+
+    def __init__(self, budget_bytes: int):
+        if budget_bytes < 1:
+            raise ValueError(
+                f"host pool budget must be >= 1 byte, got "
+                f"{budget_bytes}")
+        self.budget_bytes = int(budget_bytes)
+        self._pages: dict[bytes, dict] = {}
+        self._nbytes: dict[bytes, int] = {}
+        self._lru: dict[bytes, int] = {}
+        self._tick = 0
+        self.used_bytes = 0
+        self.n_spills = 0
+        self.n_evictions = 0
+
+    def __contains__(self, key: bytes) -> bool:
+        return key in self._pages
+
+    def __len__(self) -> int:
+        return len(self._pages)
+
+    def keys(self) -> list[bytes]:
+        return list(self._pages)
+
+    def get(self, key: bytes) -> dict | None:
+        """Peek a payload (no residency change)."""
+        return self._pages.get(key)
+
+    def put(self, key: bytes, payload: dict) -> list[bytes]:
+        """Insert (or refresh) a page; returns the keys the byte
+        budget pushed out. A payload larger than the whole budget is
+        refused by eviction-to-empty — the page just drops (returned
+        in the evicted list) rather than wedging the pool."""
+        nbytes = sum(int(a.nbytes) for a in payload.values())
+        self.pop(key)                    # refresh == replace
+        evicted: list[bytes] = []
+        while self._lru and self.used_bytes + nbytes > self.budget_bytes:
+            old = min(self._lru, key=self._lru.get)
+            self.pop(old)
+            self.n_evictions += 1
+            evicted.append(old)
+        if nbytes > self.budget_bytes:
+            self.n_evictions += 1
+            return evicted + [key]
+        self._tick += 1
+        self._pages[key] = payload
+        self._nbytes[key] = nbytes
+        self._lru[key] = self._tick
+        self.used_bytes += nbytes
+        self.n_spills += 1
+        return evicted
+
+    def pop(self, key: bytes) -> dict | None:
+        """Remove and return a payload (promotion consumes it)."""
+        payload = self._pages.pop(key, None)
+        if payload is not None:
+            self.used_bytes -= self._nbytes.pop(key)
+            del self._lru[key]
+        return payload
+
+    def check(self) -> None:
+        """Structural invariants (the spill churn test's assert)."""
+        assert self._pages.keys() == self._nbytes.keys() \
+            == self._lru.keys(), "host pool key-map drift"
+        assert self.used_bytes == sum(self._nbytes.values()), (
+            "host pool byte accounting drift")
+        assert self.used_bytes <= self.budget_bytes, (
+            f"host pool over budget: {self.used_bytes} > "
+            f"{self.budget_bytes}")
 
 
 class BlockTables:
@@ -198,6 +301,15 @@ class BlockTables:
         # LIFO free list: recently-freed pages are re-issued first
         # (their bytes are hottest in cache); page 0 never enters
         self._free = list(range(n_pages - 1, 0, -1))
+        # the host spill tier (all optional; None = PR-4 behavior
+        # bit-for-bit): host_pool holds demoted pages' payloads,
+        # spill_fetch is the ENGINE's demotion callback (page id ->
+        # host payload dict — the one deliberate device read of the
+        # tier), on_tier_event is the fleet directory's feed
+        # ((kind, chain-key bytes) on register/demote/promote/evict)
+        self.host_pool: HostPagePool | None = None
+        self.spill_fetch = None
+        self.on_tier_event = None
 
     # ---- queries -------------------------------------------------
     @property
@@ -214,6 +326,14 @@ class BlockTables:
         """Free + evictable — the admission capacity check (cached
         prefixes never block an admission; they evict under it)."""
         return len(self._free) + len(self._lru)
+
+    @property
+    def n_host_pages(self) -> int:
+        """Host-tier resident pages (0 with the spill tier off).
+        Deliberately NOT part of :attr:`n_available_pages`: a host
+        page occupies no pool id, so it neither consumes nor provides
+        admission capacity."""
+        return len(self.host_pool) if self.host_pool is not None else 0
 
     def free_slot(self) -> int | None:
         """Lowest unseated slot id, or None when all are occupied."""
@@ -260,6 +380,32 @@ class BlockTables:
                 break
             pages.append(p)
         return pages
+
+    def match_tiered(self, prompt: np.ndarray
+                     ) -> tuple[list[int], list[bytes]]:
+        """The two-tier chain walk, ONE lookup per page: the
+        HBM-resident prefix (page ids, exactly :meth:`match_pages`)
+        followed by its host-resident continuation (chain-key bytes
+        the engine promotes). Same ``(len - 1) // page_size`` cap
+        across the combined chain. A chain that leaves the host tier
+        and re-enters HBM is cut at the host miss — seat maps only a
+        LEADING contiguous run, and a mid-chain tier sandwich is a
+        transient (the stranded HBM page demotes or evicts on its
+        own)."""
+        pages = self.match_pages(prompt)
+        if self.host_pool is None or not self.prefix_cache \
+                or len(prompt) < 1:
+            return pages, []
+        prompt = np.ascontiguousarray(prompt, np.int32)
+        limit = (len(prompt) - 1) // self.page_size
+        keys: list[bytes] = []
+        while len(pages) + len(keys) < limit:
+            key = prompt[:(len(pages) + len(keys) + 1)
+                         * self.page_size].tobytes()
+            if key not in self.host_pool:
+                break
+            keys.append(key)
+        return pages, keys
 
     # ---- mutations -----------------------------------------------
     def seat(self, slot: int, prompt: np.ndarray,
@@ -418,8 +564,34 @@ class BlockTables:
                 continue
             self._index[key] = p
             self._page_key[p] = key
+            if self.host_pool is not None:
+                # a freshly-prefilled copy supersedes a stale host
+                # payload (the HBM bytes are exact, the host ones
+                # quantized) — one key never lives in both tiers
+                self.host_pool.pop(key)
+            if self.on_tier_event is not None:
+                self.on_tier_event("register", key)
             n_new += 1
         return n_new
+
+    def promote_keys(self, slot: int, keys: list[bytes],
+                     start_idx: int) -> None:
+        """Publish promoted pages back into the HBM prefix index:
+        ``keys[i]`` describes the content the engine's promotion just
+        wrote into the slot's page at table index ``start_idx + i``.
+        Host bookkeeping only (the device copy already happened);
+        first-writer-wins exactly like :meth:`register_prefix`, so a
+        racing cold prefill that registered the same chain keeps its
+        entry and the promoted copy just stays private to its slot."""
+        for i, key in enumerate(keys):
+            p = int(self.tables[slot, start_idx + i])
+            if p == NULL_PAGE or key in self._index \
+                    or p in self._page_key:
+                continue
+            self._index[key] = p
+            self._page_key[p] = key
+            if self.on_tier_event is not None:
+                self.on_tier_event("promote", key)
 
     def ensure_next_page(self, slot: int) -> bool:
         """Make sure the page that position ``lengths[slot]`` (the
@@ -563,12 +735,31 @@ class BlockTables:
 
     def _evict(self, n: int) -> int:
         """Reclaim up to ``n`` LRU cached prefix pages into the free
-        list (dropping their index entries); returns how many."""
+        list (dropping their index entries); returns how many. With
+        the spill tier attached the reclaim is a DEMOTION: the page's
+        K/V stream to the host pool (``spill_fetch`` — the engine's
+        quantize-and-copy callback) under the same chain key before
+        the pool slot frees, so a later request promotes instead of
+        recomputing. The pool partition is unchanged either way —
+        the page leaves the cached set and enters the free set."""
         got = 0
         while got < n and self._lru:
             p = min(self._lru, key=self._lru.get)
             del self._lru[p]
-            del self._index[self._page_key.pop(p)]
+            key = self._page_key.pop(p)
+            del self._index[key]
+            if self.host_pool is not None and self.spill_fetch is not None:
+                payload = self.spill_fetch(p)
+                if payload is not None:
+                    dropped = self.host_pool.put(key, payload)
+                    if self.on_tier_event is not None:
+                        self.on_tier_event("demote", key)
+                        for k in dropped:
+                            self.on_tier_event("host_evict", k)
+                elif self.on_tier_event is not None:
+                    self.on_tier_event("evict", key)
+            elif self.on_tier_event is not None:
+                self.on_tier_event("evict", key)
             self.page_pos[p] = 0
             self._free.append(int(p))
             got += 1
@@ -740,6 +931,18 @@ class BlockTables:
             assert self._page_key.get(p) == key, "index/page_key drift"
         for p in cached:
             assert p in self._page_key and self.refcount[p] == 0
+        if self.host_pool is not None:
+            # the spill tier's side of the three-way partition: host
+            # pages occupy NO pool id (the pool partition above is
+            # already exact without them), are never refcounted, and
+            # one chain key never lives in both tiers
+            self.host_pool.check()
+            for key in self.host_pool.keys():
+                assert key not in self._index, (
+                    "chain key resident in both tiers")
+                assert len(key) % (4 * self.page_size) == 0, (
+                    "host pool key is not page-aligned int32 bytes")
 
 
-__all__ = ["BlockTables", "NULL_PAGE", "PoolExhausted", "make_pool"]
+__all__ = ["BlockTables", "HostPagePool", "NULL_PAGE", "PoolExhausted",
+           "make_pool"]

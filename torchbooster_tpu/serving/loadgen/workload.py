@@ -461,7 +461,9 @@ def synthesize(kind: str = "poisson", *, n_requests: int = 32,
                cancel_frac: float = 0.0, burst_on_s: float = 1.0,
                burst_off_s: float = 2.0, burst_mult: float = 4.0,
                period_s: float = 60.0, n_frac: float = 0.0,
-               n_max: int = 4) -> Workload:
+               n_max: int = 4, tenants: int = 0,
+               prefix_pages: int = 0,
+               page_size: int = 64) -> Workload:
     """Synthetic workloads in the capture format, deterministic from
     ``seed`` — so a synthetic A/B carries a fingerprint exactly like a
     captured one and flows through the same replay driver.
@@ -476,7 +478,20 @@ def synthesize(kind: str = "poisson", *, n_requests: int = 32,
     requests get a recorded client disconnect at a random delivered-
     token offset; ``n_frac`` of requests carry parallel-sampling
     fan-out (``n = best_of`` drawn uniformly in ``[2, n_max]`` —
-    replay them against a ``parallel_sampling: true`` engine)."""
+    replay them against a ``parallel_sampling: true`` engine).
+
+    ``tenants > 0`` (with ``prefix_pages >= 1``) models the
+    many-tenant shared-system-prompt shape the spill tier (PR 16)
+    exists for: each request is assigned one of ``tenants`` tenants
+    and its prompt is PREPENDED with that tenant's fixed
+    ``prefix_pages * page_size``-token system prompt — page-aligned,
+    so every tenant's prefix registers as whole pages in the prefix
+    index and the affinity/directory keys. With enough tenants the
+    working set overflows the HBM prefix cache and re-arrivals
+    exercise the host tier. All tenant draws come from their own
+    seed-derived stream, so ``tenants: 0`` (the default) traffic is
+    byte-identical to pre-knob workloads and the format version is
+    unchanged (a tenant prefix is just prompt tokens)."""
     if kind not in SYNTHETIC_KINDS:
         raise ValueError(
             f"unknown synthetic workload kind {kind!r}: expected one "
@@ -495,6 +510,18 @@ def synthesize(kind: str = "poisson", *, n_requests: int = 32,
         raise ValueError(
             f"n_max must be >= 2 (n_frac requests fan out), got "
             f"{n_max}")
+    if tenants < 0 or prefix_pages < 0:
+        raise ValueError(
+            f"tenants/prefix_pages must be >= 0, got "
+            f"tenants={tenants}, prefix_pages={prefix_pages}")
+    if (tenants > 0) != (prefix_pages > 0):
+        raise ValueError(
+            f"tenants={tenants} with prefix_pages={prefix_pages}: "
+            "both must be set together (a tenant without a shared "
+            "prefix, or a prefix with no tenant to own it, is "
+            "surely a config typo)")
+    if tenants > 0 and page_size < 1:
+        raise ValueError(f"page_size must be >= 1, got {page_size}")
     p_lo, p_hi = int(prompt_len[0]), int(prompt_len[1])
     o_lo, o_hi = int(max_new_tokens[0]), int(max_new_tokens[1])
     if not 1 <= p_lo <= p_hi or not 1 <= o_lo <= o_hi:
@@ -547,6 +574,19 @@ def synthesize(kind: str = "poisson", *, n_requests: int = 32,
     rs_fan = np.random.RandomState((seed ^ 0x5EED5EED) & 0xFFFFFFFF)
     fanout = rs_fan.random_sample(n_requests) < n_frac
     fan_n = rs_fan.randint(2, n_max + 1, n_requests)
+    # tenant prefixes likewise draw from their OWN stream (same
+    # reasoning as the fan-out draws: tenants=0 traffic must stay
+    # byte-identical to pre-knob workloads for a given seed)
+    tenant_prefixes: list[np.ndarray] = []
+    tenant_idx = np.zeros(n_requests, np.int64)
+    if tenants > 0:
+        rs_ten = np.random.RandomState(
+            (seed ^ 0x7EA0A77) & 0xFFFFFFFF)
+        tenant_prefixes = [
+            rs_ten.randint(0, vocab, prefix_pages * page_size,
+                           dtype=np.int32)
+            for _ in range(tenants)]
+        tenant_idx = rs_ten.randint(0, tenants, n_requests)
     requests = []
     for i in range(n_requests):
         out_budget = int(olens[i])
@@ -554,14 +594,21 @@ def synthesize(kind: str = "poisson", *, n_requests: int = 32,
         if cancels[i]:
             cancel = int(rs.randint(1, out_budget + 1))
         n_i = int(fan_n[i]) if fanout[i] else 1
+        prompt = rs.randint(0, vocab, int(plens[i]), dtype=np.int32)
+        if tenants > 0:
+            prompt = np.concatenate(
+                [tenant_prefixes[int(tenant_idx[i])], prompt])
         requests.append(WorkloadRequest(
             arrival_s=float(arrivals[i]),
             max_new_tokens=out_budget,
-            prompt=rs.randint(0, vocab, int(plens[i]), dtype=np.int32),
+            prompt=prompt,
             priority=names[int(cls_idx[i])],
             request_id=f"w{seed}-{i:05d}",
             cancel_after_tokens=cancel,
             n=n_i))
+    meta = {"seed": int(seed), "rate": float(rate)}
+    if tenants > 0:
+        meta["tenants"] = int(tenants)
+        meta["prefix_pages"] = int(prefix_pages)
     return Workload(requests=requests, kind=f"synthetic:{kind}",
-                    vocab=vocab, meta={"seed": int(seed),
-                                       "rate": float(rate)})
+                    vocab=vocab, meta=meta)

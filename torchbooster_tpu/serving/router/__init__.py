@@ -12,6 +12,11 @@ ROADMAP item 2's scale-out subsystem, in three layers:
   affinity with a load-spill threshold over a least-expected-slack
   scorer), a pure function of host-side counters so multi-replica
   replay is deterministic;
+- :mod:`directory` — the fleet-wide prefix directory
+  (:class:`PrefixDirectory`): chain-key -> ``{replica: tier}``
+  maintained from the replicas' BlockTables tier events, consulted
+  by AffinityRouting on a map miss (route-to-holder over recompute)
+  and purged/reassigned on replica death;
 - :mod:`fleet` — :class:`EngineFleet`, the batcher-shaped front-door
   core: arrival-time routing, one step per live replica per fleet
   step, cross-replica readmission on replica death or sustained
@@ -22,6 +27,7 @@ ROADMAP item 2's scale-out subsystem, in three layers:
 under the deterministic clock; the ``serving.router:`` YAML block
 (``config.RouterConfig``) builds one from config.
 """
+from torchbooster_tpu.serving.router.directory import PrefixDirectory
 from torchbooster_tpu.serving.router.fleet import EngineFleet
 from torchbooster_tpu.serving.router.replica import (
     InProcessReplica,
@@ -39,6 +45,7 @@ __all__ = [
     "AffinityRouting",
     "EngineFleet",
     "InProcessReplica",
+    "PrefixDirectory",
     "Replica",
     "RoundRobinRouting",
     "RoutingPolicy",

@@ -56,6 +56,7 @@ from collections import deque
 
 from torchbooster_tpu.observability import get_registry
 from torchbooster_tpu.serving.batcher import ContinuousBatcher, Request
+from torchbooster_tpu.serving.router.directory import PrefixDirectory
 from torchbooster_tpu.serving.router.replica import (
     InProcessReplica,
     Replica,
@@ -79,7 +80,8 @@ class EngineFleet:
     :class:`RoutingPolicy` or its YAML name."""
 
     def __init__(self, replicas: list, routing=None, *,
-                 rebalance_queue: int = 0, rebalance_after: int = 8):
+                 rebalance_queue: int = 0, rebalance_after: int = 8,
+                 directory: bool = True):
         if not replicas:
             raise ValueError("EngineFleet needs at least one replica")
         wrapped: list[Replica] = []
@@ -132,10 +134,29 @@ class EngineFleet:
         self._session = False
         self._t0 = 0.0
         self._hot_streak = 0
+        # the fleet-wide prefix directory (PR 16): key -> {replica:
+        # tier}, maintained from every in-process replica's
+        # BlockTables tier events, consulted by AffinityRouting on a
+        # map miss so a re-arriving tenant lands where its pages
+        # actually ARE (HBM or host tier) instead of recomputing.
+        # `directory=False` is the A/B control arm; socket replicas
+        # would maintain it from their event streams instead of a
+        # callback, which is why it lives here and not in the engine.
+        self.directory: PrefixDirectory | None = None
+        if directory and isinstance(wrapped[0], InProcessReplica):
+            self.directory = PrefixDirectory(
+                self.page_size,
+                max_pages=getattr(self.routing, "affinity_pages", 2))
+            for rep in wrapped:
+                if isinstance(rep, InProcessReplica):
+                    rep.batcher.engine.tables.on_tier_event = \
+                        self.directory.observer(rep.replica_id)
         # router session stats (the metrics-dict "router" block)
         self.n_routed = 0
         self.n_affinity_hits = 0
         self.n_spills = 0
+        self.n_directory_hits = 0
+        self.n_directory_evictions = 0
         self.n_readmitted = 0
         self.n_rebalanced = 0
         self.n_fleet_cancelled = 0
@@ -258,6 +279,7 @@ class EngineFleet:
         self.routing.reset()
         self._hot_streak = 0
         self.n_routed = self.n_affinity_hits = self.n_spills = 0
+        self.n_directory_hits = self.n_directory_evictions = 0
         self.n_readmitted = self.n_rebalanced = 0
         self.n_fleet_cancelled = 0
         self.assignment_log = []
@@ -283,6 +305,13 @@ class EngineFleet:
             "rebalanced": reg.counter(
                 "router_rebalanced_total",
                 "queued requests migrated off a sustained hot-spot"),
+            "dir_hits": reg.counter(
+                "router_directory_hits_total",
+                "affinity-map misses resolved by the fleet prefix "
+                "directory (routed to a page holder)"),
+            "dir_evict": reg.counter(
+                "router_directory_evictions_total",
+                "directory entries dropped when their replica died"),
             "live": reg.gauge(
                 "router_replicas_live",
                 "replicas currently alive in the fleet"),
@@ -354,11 +383,61 @@ class EngineFleet:
             self._owner.pop(id(req), None)
             self._pending.append(req)
         self.n_readmitted += len(orphans)
+        # the PR 16 satellite fix: affinity metadata used to die
+        # SILENTLY with the replica — the directory kept routing-grade
+        # entries for pages that no longer exist anywhere. Death now
+        # purges every entry naming the dead replica (counted, so an
+        # operator sees the fleet's warm-page loss) and RESCUES its
+        # host-tier chains: in-process, the dead engine's host-DRAM
+        # pool outlives the object, so its payloads copy into a
+        # survivor's pool (the directory-mediated host-tier fetch)
+        # and re-record under the new holder.
+        if self.directory is not None:
+            dropped, host_keys = self.directory.purge_replica(
+                rep.replica_id)
+            self.n_directory_evictions += dropped
+            if self._inst is not None and dropped:
+                self._inst["dir_evict"].inc(dropped)
+            self._reassign_host_pages(rep, host_keys)
         if self._inst is not None:
             self._inst["live"].set(self.n_live)
             if orphans:
                 self._inst["readmit"].inc(len(orphans), reason=reason)
         return len(orphans)
+
+    def _reassign_host_pages(self, dead: Replica,
+                             host_keys: list) -> int:
+        """Copy a dead replica's directory-known host-tier payloads
+        into the least-loaded surviving replica's host pool and
+        re-record the new holder — numpy copies through process
+        memory today; the directory API is the seam where a socket
+        fleet's page-fetch RPC slots in. Chains are moved page-ordered
+        (shallowest first) so the survivor's LRU never holds a child
+        page without its parent longer than one put. Best-effort: no
+        survivor with a host pool, nothing to do."""
+        if not host_keys or not isinstance(dead, InProcessReplica):
+            return 0
+        src = dead.batcher.engine.tables.host_pool
+        if src is None:
+            return 0
+        targets = [r for r in self.live_replicas
+                   if isinstance(r, InProcessReplica)
+                   and r.batcher.engine.tables.host_pool is not None]
+        if not targets:
+            return 0
+        target = min(targets, key=lambda r: (r.queue_depth,
+                                             r.replica_id))
+        dst = target.batcher.engine.tables.host_pool
+        moved = 0
+        for key in sorted(host_keys, key=len):
+            payload = src.pop(key)
+            if payload is None:
+                continue        # already LRU-dropped: a stale hint
+            dst.put(key, payload)
+            self.directory.record(key, target.replica_id, "host")
+            moved += 1
+        self.directory.n_reassigned += moved
+        return moved
 
     def _route_arrivals(self, now: float) -> None:
         if not self._pending:
@@ -390,6 +469,9 @@ class EngineFleet:
             if getattr(self.routing, "last_spill", False):
                 self.n_spills += 1
                 self._inst["spills"].inc()
+            if getattr(self.routing, "last_directory_hit", False):
+                self.n_directory_hits += 1
+                self._inst["dir_hits"].inc()
 
     def _drain_cancels(self, events: list) -> None:
         while self._inbox_cancel:
@@ -567,9 +649,18 @@ class EngineFleet:
             "n_routed": self.n_routed,
             "n_affinity_hits": self.n_affinity_hits,
             "n_spills": self.n_spills,
+            "n_directory_hits": self.n_directory_hits,
+            "n_directory_evictions": self.n_directory_evictions,
             "n_readmitted": self.n_readmitted,
             "n_rebalanced": self.n_rebalanced,
             "n_pending": len(self._pending),
+            "directory": (None if self.directory is None else {
+                "entries": len(self.directory),
+                "n_records": self.directory.n_records,
+                "n_hits": self.directory.n_hits,
+                "n_evictions": self.directory.n_evictions,
+                "n_reassigned": self.directory.n_reassigned,
+            }),
         }
 
     # ---- metrics merge -------------------------------------------

@@ -129,11 +129,13 @@ class AffinityRouting(RoutingPolicy):
         # to know WHY)
         self.last_affinity_hit = False
         self.last_spill = False
+        self.last_directory_hit = False
 
     def reset(self) -> None:
         self._map.clear()
         self.last_affinity_hit = False
         self.last_spill = False
+        self.last_directory_hit = False
 
     def _least_loaded(self, req, live: list) -> int:
         # min score, ties toward the lower replica id (determinism)
@@ -144,6 +146,7 @@ class AffinityRouting(RoutingPolicy):
     def choose(self, req, live: list, fleet) -> int:
         self.last_affinity_hit = False
         self.last_spill = False
+        self.last_directory_hit = False
         key = prefix_affinity_key(
             req.prompt, fleet.page_size, self.affinity_pages)
         if key is None:
@@ -151,8 +154,22 @@ class AffinityRouting(RoutingPolicy):
         by_id = {r.replica_id: r for r in live}
         home = self._map.get(key)
         if home is None or home not in by_id:
-            # first sight of this prefix (or its home died): bind it
-            # to the least-loaded live replica — the pages warm THERE
+            # first sight of this prefix (or its home died): before
+            # binding blind, ask the fleet prefix DIRECTORY (PR 16)
+            # whether some live replica already holds the chain's
+            # pages — HBM- or host-tier. Routing to the holder turns
+            # the miss into that replica's own tiered match (an HBM
+            # hit or a host promotion) instead of a recompute; the
+            # map then re-binds there so later arrivals follow.
+            directory = getattr(fleet, "directory", None)
+            if directory is not None:
+                hit = directory.lookup(req.prompt, live_ids=by_id)
+                if hit is not None:
+                    self._map[key] = hit[0]
+                    self.last_directory_hit = True
+                    return hit[0]
+            # nobody holds it: bind to the least-loaded live replica
+            # — the pages warm THERE
             home = self._least_loaded(req, live)
             self._map[key] = home
             return home
