@@ -2253,6 +2253,201 @@ def bench_serve_fleet() -> dict:
     return out
 
 
+def bench_obs_fleet() -> dict:
+    """Fleet health & SLO signal-plane overhead A/B (the PR-17
+    tentpole): the serve_fleet shared-system-prompt workload replayed
+    through IDENTICAL affinity fleets with the signal plane OFF
+    (registry disabled, no audit ring, no health scorer) and ON
+    (registry enabled, 256-deep routing audit, FleetHealth on a
+    2-step cadence, SLOBurnEngine ticked on a synthetic export
+    cadence) — ``health_aware`` stays OFF on both arms, so the plane
+    may only ever OBSERVE.
+
+    Gates (``obs_fleet_ok``):
+
+    1. **Overhead < 3%**: decode tok/s (decoded tokens over measured
+       host wall time), arms interleaved in alternating order,
+       verdict = min over adjacent pairs (the obs_trace discipline).
+    2. **Zero new compiles**: every replica of every arm holds
+       exactly one decode + one prefill compile after all repeats.
+    3. **Routing byte-identity**: the plane-on arm's
+       ``assignment_log`` equals the plane-off arm's on EVERY repeat
+       — observing a decision must never move it.
+    4. **The diff gate round-trips**: ``replay_diff --routing`` exits
+       0 on the two arms' (identical) artifacts, 1 on an
+       injected decision flip, 2 on a fingerprint mismatch.
+
+    Also emitted: burn-rate/alert counts from the SLO engine, the
+    health scorer's observation/flap counts, and audit-ring depth.
+    Knobs: the BENCH_FLEET_* set plus BENCH_OBS_FLEET_RUNS."""
+    import copy
+    import json as _json
+
+    from torchbooster_tpu.models.gpt import GPT, GPTConfig
+    from torchbooster_tpu.observability import set_enabled
+    from torchbooster_tpu.observability.slo import SLOBurnEngine
+    from torchbooster_tpu.serving import (ContinuousBatcher,
+                                          EngineFleet, PagedEngine)
+    from torchbooster_tpu.serving.frontend import (SLOPolicy,
+                                                   parse_classes)
+    from torchbooster_tpu.serving.loadgen import replay_inprocess
+    from torchbooster_tpu.serving.router import (AffinityRouting,
+                                                 FleetHealth,
+                                                 routing_artifact)
+
+    k = _fleet_env()
+    runs = int(os.environ.get("BENCH_OBS_FLEET_RUNS", 3))
+    workload = _fleet_workload(k)
+    fp = workload.fingerprint()
+    cfg = GPTConfig(n_layers=k["n_layers"], seq_len=k["seq"],
+                    d_model=k["d_model"], n_heads=k["heads"],
+                    n_kv_heads=k["kv"])
+    params = GPT.init(jax.random.PRNGKey(0), cfg)
+    params = {**params, "wte": {"table": params["wte"]["table"] * 4.0}}
+
+    def build_fleet(plane_on):
+        classes = parse_classes(
+            f"interactive:{k['ttft_ms']:g}:0,batch:0:0")
+        policy = SLOPolicy(classes, default="batch")
+        batchers = []
+        for _ in range(k["replicas"]):
+            engine = PagedEngine(
+                params, cfg, page_size=k["page"],
+                n_pages=k["n_pages"], max_slots=k["slots"],
+                prefix_cache=True, prefill_chunk_pages=1)
+            batchers.append(ContinuousBatcher(engine, policy=policy))
+        routing = AffinityRouting(spill_queue=k["spill"])
+        if plane_on:
+            return EngineFleet(batchers, routing=routing, audit=256,
+                               health=FleetHealth(every=2),
+                               health_aware=False)
+        return EngineFleet(batchers, routing=routing, audit=0)
+
+    from torchbooster_tpu.observability.registry import get_registry
+
+    registry_was = get_registry().enabled
+    fleet_off = build_fleet(False)
+    set_enabled(True)      # the on arm's plane needs live series
+    fleet_on = build_fleet(True)
+    slo = SLOBurnEngine(target=0.99, fast_window_s=120.0,
+                        slow_window_s=600.0, fire_burn=2.0,
+                        resolve_burn=1.0)
+    set_enabled(False)
+
+    def engines_of(fleet):
+        return [r.batcher.engine for r in fleet.replicas]
+
+    def drive(fleet, plane_on):
+        set_enabled(plane_on)
+        try:
+            t0 = time.perf_counter()
+            res = replay_inprocess(fleet, workload,
+                                   speed=k["ab_speed"])
+            wall = time.perf_counter() - t0
+        finally:
+            set_enabled(False)
+        tokens = sum(len(r.tokens) for r in res.requests)
+        return {"tok_s": tokens / max(wall, 1e-9),
+                "assignments": list(fleet.assignment_log),
+                "report": res.report}
+
+    slo_now = 0.0
+    slo.tick(now=slo_now)          # the windows' base sample
+    off = on = None
+    overheads = []
+    identical_every_run = True
+    for i in range(max(runs, 1)):
+        pair = {}
+        order = (("off", fleet_off), ("on", fleet_on))
+        if i % 2:
+            order = order[::-1]
+        for arm, fleet in order:
+            r = drive(fleet, arm == "on")
+            pair[arm] = r
+            if arm == "off":
+                if off is None or r["tok_s"] > off["tok_s"]:
+                    off = r
+            else:
+                if on is None or r["tok_s"] > on["tok_s"]:
+                    on = r
+                # synthetic export cadence: one burn sample per
+                # repeat, virtual-now spaced inside the fast window
+                slo_now += 60.0
+                slo.tick(now=slo_now)
+        overheads.append(
+            (pair["off"]["tok_s"] - pair["on"]["tok_s"])
+            / max(pair["off"]["tok_s"], 1e-9) * 100.0)
+        if pair["off"]["assignments"] != pair["on"]["assignments"]:
+            identical_every_run = False
+    overhead = min(overheads)
+
+    compiles_ok = all(
+        e.decode_compiles == 1 and e.prefill_compiles == 1
+        for fleet in (fleet_off, fleet_on) for e in engines_of(fleet))
+
+    # ---- the replay_diff --routing round trip --------------------
+    from scripts.replay_diff import main as replay_diff_main
+
+    log_dir = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "logs")
+    os.makedirs(log_dir, exist_ok=True)
+    art_off = routing_artifact(fleet_off, fingerprint=fp)
+    art_on = routing_artifact(fleet_on, fingerprint=fp)
+    p_off = os.path.join(log_dir, "obs_fleet_routing_off.json")
+    p_on = os.path.join(log_dir, "obs_fleet_routing_on.json")
+    mutated = copy.deepcopy(art_on)
+    if mutated["assignments"]:
+        row = mutated["assignments"][0]
+        row[1] = (row[1] + 1) % max(k["replicas"], 2)
+    p_mut = os.path.join(log_dir, "obs_fleet_routing_mut.json")
+    foreign = copy.deepcopy(art_on)
+    foreign["workload_fingerprint"] = "not-this-trace"
+    p_for = os.path.join(log_dir, "obs_fleet_routing_foreign.json")
+    for path, art in ((p_off, art_off), (p_on, art_on),
+                      (p_mut, mutated), (p_for, foreign)):
+        with open(path, "w") as f:
+            _json.dump(art, f)
+    rc_clean = replay_diff_main([p_off, p_on, "--routing"])
+    rc_mut = replay_diff_main([p_off, p_mut, "--routing"])
+    rc_foreign = replay_diff_main([p_off, p_for, "--routing"])
+    diff_ok = (rc_clean, rc_mut, rc_foreign) == (0, 1, 2)
+
+    health = fleet_on.health.snapshot()
+    burns = slo.snapshot()
+    ok = (overhead < 3.0 and compiles_ok and identical_every_run
+          and diff_ok)
+    if not ok:
+        print(f"OBS_FLEET FAIL: overhead {overhead:.2f}% (limit 3%), "
+              f"compiles_ok={compiles_ok}, "
+              f"routing_identical={identical_every_run}, "
+              f"diff_rcs=({rc_clean},{rc_mut},{rc_foreign}) "
+              f"(need (0,1,2))", file=sys.stderr)
+    set_enabled(registry_was)
+    return {
+        "obs_fleet_tok_s_off": round(off["tok_s"], 2),
+        "obs_fleet_tok_s_on": round(on["tok_s"], 2),
+        "obs_fleet_overhead_pct": round(overhead, 2),
+        "obs_fleet_overhead_pcts": [round(o, 2) for o in overheads],
+        "obs_fleet_zero_new_compiles": compiles_ok,
+        "obs_fleet_routing_identical": identical_every_run,
+        "obs_fleet_audit_records": fleet_on.audit.n_records,
+        "obs_fleet_audit_depth": len(fleet_on.audit),
+        "obs_fleet_health_observations": health["n_observations"],
+        "obs_fleet_health_flaps": health["n_flaps"],
+        "obs_fleet_slo_ticks": burns["n_ticks"],
+        "obs_fleet_alerts_fired": burns["n_fired"],
+        "obs_fleet_alerts_resolved": burns["n_resolved"],
+        "obs_fleet_alerts_active": sum(
+            1 for firing in burns["active"].values() if firing),
+        "obs_fleet_diff_rc_clean": rc_clean,
+        "obs_fleet_diff_rc_mutated": rc_mut,
+        "obs_fleet_diff_rc_foreign": rc_foreign,
+        "obs_fleet_goodput_tok_s_on": on["report"]["goodput_tok_s"],
+        "workload_fingerprint": fp,
+        "obs_fleet_ok": ok,
+    }
+
+
 def bench_serve_spill() -> dict:
     """The host-RAM page spill tier A/B (the PR-16 tentpole): one
     probe tenant's shared-prefix request timed through IDENTICAL
@@ -3282,6 +3477,8 @@ def _sub_main(name: str) -> None:
         print(json.dumps(bench_serve_fleet()))
     elif name == "serve_spill":
         print(json.dumps(bench_serve_spill()))
+    elif name == "obs_fleet":
+        print(json.dumps(bench_obs_fleet()))
     elif name == "obs":
         print(json.dumps(bench_obs(max(4, steps // 4))))
     elif name == "comms":
@@ -3509,6 +3706,11 @@ _SECONDARY_BENCHES = (("gpt", 900), ("gpt_long", 1500), ("loader", 900),
                       # bytes-accounting gate; shares its run_ab
                       # QUEUE deadline (two-drivers-must-agree)
                       ("serve_spill", 1800),
+                      # the fleet signal-plane row (PR 17): plane
+                      # on/off overhead + routing byte-identity + the
+                      # replay_diff --routing round trip; shares its
+                      # run_ab QUEUE deadline (two-drivers-must-agree)
+                      ("obs_fleet", 1500),
                       ("obs", 900), ("comms", 900),
                       # the ZeRO-ladder row (PR 15): stage/overlap A/B
                       # with the overlap + accounting gates

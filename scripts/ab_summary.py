@@ -131,7 +131,7 @@ def main() -> None:
                  "serve_parallel", "serve_tree",
                  "obs_trace", "replay", "replay_http",
                  "serve_fleet", "serve_fleet_affinity",
-                 "serve_spill")
+                 "serve_spill", "obs_fleet")
     for name in sorted(attempts):
         if name in METRICS or (name in multi_key and name in latest):
             continue  # multi-key ok rows print below; failures fall through
@@ -482,6 +482,35 @@ def main() -> None:
               f"| {r.get('serve_spill_hbm_hit_pages', '—')} |")
         print(f"| host_hit | {r.get('serve_spill_ttft_host_s', '—')} "
               f"| {r.get('serve_spill_host_hit_pages', '—')} |")
+
+    # obs_fleet row: the fleet signal-plane A/B — plane off vs on
+    # decode tok/s with the <3% headline, the routing byte-identity +
+    # compile proofs, the replay_diff --routing rc triple, and the
+    # plane's own outputs (alerts fired/resolved, health flaps,
+    # audit-ring records)
+    e = latest.get("obs_fleet")
+    if e is not None:
+        r = e.get("result") or {}
+        rcs = (f"{r.get('obs_fleet_diff_rc_clean', '?')}/"
+               f"{r.get('obs_fleet_diff_rc_mutated', '?')}/"
+               f"{r.get('obs_fleet_diff_rc_foreign', '?')}")
+        print(f"\nobs_fleet (overhead "
+              f"{r.get('obs_fleet_overhead_pct', '?')}% of limit 3%, "
+              f"routing identical "
+              f"{r.get('obs_fleet_routing_identical', '?')}, zero new "
+              f"compiles {r.get('obs_fleet_zero_new_compiles', '?')}, "
+              f"replay_diff rcs {rcs} (need 0/1/2), verdict "
+              f"ok={r.get('obs_fleet_ok', '?')}):")
+        print("| arm | decode tok/s | alerts fired/resolved "
+              "| health flaps | audit records |")
+        print("|---|---|---|---|---|")
+        print(f"| plane off | {r.get('obs_fleet_tok_s_off', '—')} "
+              "| — | — | — |")
+        print(f"| plane on | {r.get('obs_fleet_tok_s_on', '—')} "
+              f"| {r.get('obs_fleet_alerts_fired', '—')}"
+              f"/{r.get('obs_fleet_alerts_resolved', '—')} "
+              f"| {r.get('obs_fleet_health_flaps', '—')} "
+              f"| {r.get('obs_fleet_audit_records', '—')} |")
 
     # comms rows: bytes-moved + step-time deltas across the gradient
     # sync arms, rendered as a compact sub-table (one row per arm)

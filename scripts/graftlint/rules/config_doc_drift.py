@@ -59,6 +59,8 @@ BLOCKS = {
     "comms": "CommsConfig",
     "observability": "ObservabilityConfig",
     "tracing": "TracingConfig",
+    "health": "RouterHealthConfig",
+    "slo": "SLOBurnConfig",
 }
 
 _FENCE = re.compile(r"^```yaml\s*$")

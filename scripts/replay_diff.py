@@ -20,12 +20,24 @@ base → candidate) and the verdict line lists the regressed classes by
 name — the aggregate gate says *that* conformance slipped, this mode
 says *who* it slipped for. Exit codes are unchanged either way.
 
+``--routing`` switches the gate to ROUTING artifacts instead of SLO
+reports: the two files are ``routing_artifact(fleet)`` dumps
+(``kind: "routing"``) and the comparison is exact — same policy, same
+replica count, and the same (request_id → replica) assignment at
+every position, no tolerance. This is the determinism gate for the
+fleet: two replays of one capture through the same fleet config must
+route identically, and any divergence lists the first differing
+decisions by request id. The fingerprint refusal applies unchanged.
+
 Usage:
     python scripts/replay_diff.py baseline.json candidate.json \
         [--tol 0.1] [--per-class]
+    python scripts/replay_diff.py base_routing.json cand_routing.json \
+        --routing
 
-Exit codes: 0 = no regression, 1 = regression(s) flagged,
-2 = not comparable (fingerprint mismatch) or unreadable input.
+Exit codes: 0 = no regression (or identical routing),
+1 = regression(s) flagged (or routing diverged),
+2 = not comparable (fingerprint/kind mismatch) or unreadable input.
 """
 from __future__ import annotations
 
@@ -63,12 +75,58 @@ def _print_per_class(base: dict, cand: dict,
         print("\nregressed classes: none")
 
 
+def _routing_main(paths: list[str]) -> int:
+    """The --routing gate: exact assignment-sequence comparison of
+    two routing artifacts (see module docstring for exit codes)."""
+    from torchbooster_tpu.serving.router.audit import (  # noqa: E402
+        diff_routing,
+    )
+
+    artifacts = []
+    for path in paths:
+        try:
+            with open(path) as f:
+                artifacts.append(json.load(f))
+        except (OSError, ValueError) as exc:
+            print(f"cannot read routing artifact {path!r}: {exc}",
+                  file=sys.stderr)
+            return 2
+    base, cand = artifacts
+    try:
+        diverged = diff_routing(base, cand)
+    except ValueError as exc:
+        print(f"NOT COMPARABLE: {exc}", file=sys.stderr)
+        return 2
+    print(f"baseline  : {paths[0]} (policy {base.get('policy', '?')}, "
+          f"{base.get('n_routed', '?')} decisions, fingerprint "
+          f"{base.get('workload_fingerprint', '?')})")
+    print(f"candidate : {paths[1]} (policy {cand.get('policy', '?')}, "
+          f"{cand.get('n_routed', '?')} decisions, fingerprint "
+          f"{cand.get('workload_fingerprint', '?')})")
+    if diverged:
+        print(f"\nROUTING DIVERGED ({len(diverged)} line(s)):")
+        for line in diverged:
+            print(f"  DIVERGED {line}")
+        return 1
+    print("\nrouting identical: every decision matches")
+    return 0
+
+
 def main(argv: list[str] | None = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     tol = 0.10
     per_class = "--per-class" in argv
     if per_class:
         argv.remove("--per-class")
+    routing = "--routing" in argv
+    if routing:
+        argv.remove("--routing")
+        if len(argv) != 2:
+            print("usage: python scripts/replay_diff.py "
+                  "<base_routing.json> <cand_routing.json> --routing",
+                  file=sys.stderr)
+            return 2
+        return _routing_main(argv)
     if "--tol" in argv:
         i = argv.index("--tol")
         try:

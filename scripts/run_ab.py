@@ -195,6 +195,14 @@ QUEUE: list[tuple[str, str, dict, int]] = [
     # bytes EQUAL to the engine's measured counter
     # (bench.bench_serve_spill; serve_spill_ok is the verdict bit)
     ("serve_spill", "serve_spill", {}, 1800),
+    # fleet signal plane (the PR-17 tentpole): plane-off vs plane-on
+    # (audit ring + health scorer + SLO burn engine, health_aware OFF)
+    # over the serve_fleet workload — < 3% decode tok/s overhead, zero
+    # new compiles, routing decisions byte-identical on every repeat,
+    # and the replay_diff --routing gate round-tripping (0 clean / 1
+    # injected flip / 2 fingerprint refusal)
+    # (bench.bench_obs_fleet; obs_fleet_ok is the verdict bit)
+    ("obs_fleet", "obs_fleet", {}, 1500),
     # recipe accuracy on chip (VERDICT r4 #3): the shipped ResNet
     # CIFAR recipe end to end, ref hyperparams, 20 epochs — real
     # CIFAR-10 if a binary release is under the dataset root (none in

@@ -98,6 +98,14 @@ def test_hot_paths_cover_step_cadence_serving_files():
                 "torchbooster_tpu/serving/router/fleet.py",
                 "torchbooster_tpu/serving/router/routing.py",
                 "torchbooster_tpu/serving/router/replica.py",
+                # the fleet signal plane (PR 17): health observation
+                # runs inside the fleet step loop, audit records land
+                # per routing decision, and the burn engine ticks on
+                # the exporter thread next to the serving loop — all
+                # must stay under the host-sync rule
+                "torchbooster_tpu/serving/router/health.py",
+                "torchbooster_tpu/serving/router/audit.py",
+                "torchbooster_tpu/observability/slo.py",
                 # the paged flash-decode kernel wrapper runs inside
                 # the compiled decode/verify steps (PR 8)
                 "torchbooster_tpu/ops/paged_attention.py"):

@@ -359,7 +359,8 @@ def test_healthz_readiness_payload_single_batcher():
                          "occupancy"}
     assert set(full) == {"status", "queue_depth", "pages_free",
                          "pages_cached", "pages_host", "inflight",
-                         "occupancy", "est_step_s"}
+                         "occupancy", "est_step_s",
+                         "step_seq", "stamped_s"}
     assert set(full) == set(ready), \
         "the probe and the load scorer must share one payload shape"
 

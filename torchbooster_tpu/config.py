@@ -732,6 +732,66 @@ class HostSpillConfig(BaseConfig):
 
 
 @dataclass
+class RouterHealthConfig(BaseConfig):
+    """Per-replica health scoring (serving/router/health.py), nested
+    under ``router:`` as its ``health:`` sub-block. No reference
+    analogue — this is the fleet signal plane's replica scorer.
+
+    YAML block::
+
+        router:
+          health:
+            enabled: true        # observe replica health every step
+            every: 8             # fleet steps between observations
+            degrade_after: 2     # consecutive bad obs per level down
+            recover_after: 4     # consecutive clean obs per level up
+            queue_limit: 32      # queue-depth strike threshold
+            min_free_pages: 0    # claimable-pages strike threshold
+            stale_s: 2.0         # frozen-step_seq staleness window
+            degraded_weight: 4.0   # health_aware score multiplier
+            unhealthy_weight: 16.0 # health_aware score multiplier
+
+    ``enabled: true`` attaches a
+    :class:`~torchbooster_tpu.serving.router.FleetHealth` scorer to
+    the fleet: every ``every`` fleet steps it folds flight-recorder
+    anomalies (stall watchdog hits, recompiles), queue depth,
+    claimable pages, and readiness staleness into a hysteretic
+    healthy/degraded/unhealthy state per replica, exported as
+    ``router_replica_health{replica}``. The scorer only OBSERVES;
+    routing consults it solely under ``router.health_aware`` (see
+    :class:`RouterConfig`). Off (the default), no scorer exists and
+    the fleet's step loop is unchanged.
+    """
+
+    enabled: bool = False              # build the FleetHealth scorer
+    every: int = 8                     # fleet steps per observation
+    degrade_after: int = 2             # bad obs per level down
+    recover_after: int = 4             # clean obs per level up
+    queue_limit: int = 32              # queue-depth strike threshold
+    min_free_pages: int = 0            # claimable-pages threshold
+    stale_s: float = 2.0               # readiness staleness window
+    degraded_weight: float = 4.0       # health_aware multiplier
+    unhealthy_weight: float = 16.0     # health_aware multiplier
+
+    def make(self) -> Any:
+        """Build the :class:`FleetHealth` scorer (``None`` when
+        disabled)."""
+        if not self.enabled:
+            return None
+        from torchbooster_tpu.serving.router import FleetHealth
+
+        return FleetHealth(
+            every=self.every,
+            degrade_after=self.degrade_after,
+            recover_after=self.recover_after,
+            queue_limit=self.queue_limit,
+            min_free_pages=self.min_free_pages,
+            stale_s=self.stale_s,
+            degraded_weight=self.degraded_weight,
+            unhealthy_weight=self.unhealthy_weight)
+
+
+@dataclass
 class RouterConfig(BaseConfig):
     """The engine-fleet router (torchbooster_tpu/serving/router):
     N data-parallel engine replicas behind one front door. Nested
@@ -779,6 +839,16 @@ class RouterConfig(BaseConfig):
     recomputing; replica death purges the dead entries (the
     ``router_directory_evictions`` counter) and rescues its host-tier
     chains onto a survivor. ``directory: false`` is the A/B control.
+
+    ``audit`` sizes the routing-decision audit ring (``0`` disables
+    it): one bounded record per choice — reason, affinity key, the
+    per-candidate load picture — surfaced at ``GET /debug/router``
+    and diffable via ``replay_diff --routing``. The ``health:``
+    sub-block (:class:`RouterHealthConfig`) builds the per-replica
+    health scorer; ``health_aware: true`` (needs ``health.enabled``)
+    additionally lets spill/keyless scoring down-weight degraded
+    replicas — off (the default) routing decisions are byte-identical
+    whether or not the scorer observes.
     """
 
     n_replicas: int = 1                # 1 = plain single batcher
@@ -788,6 +858,10 @@ class RouterConfig(BaseConfig):
     rebalance_queue: int = 0           # 0 = hot-spot rebalance off
     rebalance_after: int = 8           # sustained-imbalance steps
     directory: bool = True             # fleet-wide prefix directory
+    audit: int = 256                   # decision audit ring (0 = off)
+    health_aware: bool = False         # health-weighted spill scoring
+    health: RouterHealthConfig = dataclasses.field(
+        default_factory=RouterHealthConfig)  # replica health scorer
 
     def make_routing(self) -> Any:
         from torchbooster_tpu.serving.router import make_routing
@@ -801,10 +875,17 @@ class RouterConfig(BaseConfig):
         batchers (normally ``ServingConfig.make``'s job)."""
         from torchbooster_tpu.serving.router import EngineFleet
 
+        if self.health_aware and not self.health.enabled:
+            raise ValueError(
+                "router.health_aware: true needs router.health."
+                "enabled: true (there is no scorer to consult)")
         return EngineFleet(batchers, routing=self.make_routing(),
                            rebalance_queue=self.rebalance_queue,
                            rebalance_after=self.rebalance_after,
-                           directory=self.directory)
+                           directory=self.directory,
+                           audit=self.audit,
+                           health=self.health.make(),
+                           health_aware=self.health_aware)
 
 
 @dataclass
@@ -1257,6 +1338,53 @@ class TracingConfig(BaseConfig):
 
 
 @dataclass
+class SLOBurnConfig(BaseConfig):
+    """SLO burn-rate alerting switch (torchbooster_tpu/observability/
+    slo.py). Nested under ``observability:`` as its ``slo:``
+    sub-block.
+
+    YAML block::
+
+        observability:
+          slo:
+            enabled: false             # burn-rate engine on the export tick
+            target: 0.99               # deadline-hit-rate objective
+            fast_window_s: 60.0        # detection window
+            slow_window_s: 600.0       # blip-veto window
+            fire_burn: 2.0             # fire when BOTH windows >= this
+            resolve_burn: 1.0          # resolve when fast window < this
+            goodput_floor_tok_s: 0.0   # 0 = no goodput-floor alert
+
+    ``make()`` builds the
+    :class:`~torchbooster_tpu.observability.slo.SLOBurnEngine` (or
+    ``None`` when disabled); ``ObservabilityConfig.make()`` hands it
+    to the cadence exporter so burn gauges refresh on every export
+    tick and alert transitions land in the JSONL log."""
+
+    enabled: bool = False
+    target: float = 0.99
+    fast_window_s: float = 60.0
+    slow_window_s: float = 600.0
+    fire_burn: float = 2.0
+    resolve_burn: float = 1.0
+    goodput_floor_tok_s: float = 0.0   # 0 disables the goodput alert
+
+    def make(self, sink: Any = None) -> Any:
+        if not self.enabled:
+            return None
+        from torchbooster_tpu.observability.slo import SLOBurnEngine
+
+        return SLOBurnEngine(
+            target=self.target,
+            fast_window_s=self.fast_window_s,
+            slow_window_s=self.slow_window_s,
+            fire_burn=self.fire_burn,
+            resolve_burn=self.resolve_burn,
+            goodput_floor_tok_s=self.goodput_floor_tok_s,
+            sink=sink)
+
+
+@dataclass
 class ObservabilityConfig(BaseConfig):
     """Telemetry switch + exporter wiring (torchbooster_tpu/
     observability). No reference analogue — the reference's profiling
@@ -1273,6 +1401,8 @@ class ObservabilityConfig(BaseConfig):
           on_recompile: warn                   # ignore | warn | raise
           tracing:                             # request-scoped tracing
             enabled: false
+          slo:                                 # burn-rate alerting
+            enabled: false
 
     ``make()`` returns an :class:`~torchbooster_tpu.observability.
     Observability` session handle (context-manager: flushes exporters
@@ -1281,7 +1411,9 @@ class ObservabilityConfig(BaseConfig):
     ``tracing`` is the per-request trace sub-block
     (:class:`TracingConfig` — build its tracer with
     ``conf.observability.tracing.make()`` and hand it to the serving
-    batcher)."""
+    batcher); ``slo`` is the burn-rate alerting sub-block
+    (:class:`SLOBurnConfig` — its engine rides the exporter
+    cadence)."""
 
     enabled: bool = False
     jsonl_path: str = ""
@@ -1290,6 +1422,8 @@ class ObservabilityConfig(BaseConfig):
     on_recompile: str = "warn"         # ignore | warn | raise
     tracing: TracingConfig = dataclasses.field(
         default_factory=TracingConfig)  # request-scoped tracing
+    slo: SLOBurnConfig = dataclasses.field(
+        default_factory=SLOBurnConfig)  # burn-rate alerting
 
     def make(self) -> Any:
         from torchbooster_tpu import observability as obs
@@ -1310,7 +1444,8 @@ class ObservabilityConfig(BaseConfig):
         return obs.enable(jsonl_path=self.jsonl_path or None,
                           prom_path=self.prom_path or None,
                           cadence_s=self.cadence_s,
-                          on_recompile=self.on_recompile)
+                          on_recompile=self.on_recompile,
+                          slo=self.slo.make())
 
 
 @dataclass
@@ -1356,6 +1491,8 @@ __all__ = [
     "ObservabilityConfig",
     "OptimizerConfig",
     "RouterConfig",
+    "RouterHealthConfig",
+    "SLOBurnConfig",
     "SchedulerConfig",
     "ServingConfig",
     "TracingConfig",

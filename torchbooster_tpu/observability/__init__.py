@@ -21,6 +21,9 @@ that ship it all on a cadence thread.
   ``cost_analysis`` FLOP cross-checks for bench MFU denominators;
 - :mod:`export`    — JSONL event log + Prometheus text snapshots on a
   background cadence thread;
+- :mod:`slo`       — :class:`SLOBurnEngine`, multi-window burn rates
+  over the serving deadline/goodput counters with a firing/resolved
+  alert FSM, ticked by the exporter on the same cadence;
 - :mod:`tracing`   — per-request lifecycle events on a bounded sink,
   exported as JSONL / Chrome trace-event JSON (one Perfetto track per
   request, one per engine step kind);
@@ -62,6 +65,9 @@ from torchbooster_tpu.observability.registry import (
     get_registry,
     set_enabled,
 )
+from torchbooster_tpu.observability.slo import (
+    SLOBurnEngine,
+)
 from torchbooster_tpu.observability.spans import (
     annotate,
     span,
@@ -76,7 +82,8 @@ from torchbooster_tpu.observability.tracing import (
 __all__ = [
     "Counter", "FlightRecorder", "Gauge", "Histogram", "JsonlExporter",
     "MetricsExporter", "Observability", "RecompileError",
-    "RecompileSentinel", "Registry", "RequestTracer", "annotate",
+    "RecompileSentinel", "Registry", "RequestTracer", "SLOBurnEngine",
+    "annotate",
     "cost_analysis", "enable", "flop_check", "get_registry",
     "prometheus_text", "record_memory_gauges", "set_enabled", "span",
     "span_events_subscribe", "trace", "write_chrome_trace", "xla_flops",
@@ -125,12 +132,15 @@ _default_exporter: MetricsExporter | None = None
 
 def enable(jsonl_path: str | None = None, prom_path: str | None = None,
            cadence_s: float = 10.0,
-           on_recompile: str = "warn") -> Observability:
+           on_recompile: str = "warn",
+           slo: SLOBurnEngine | None = None) -> Observability:
     """Programmatic switch-on: enable the default registry and (when
     any path is given) start the cadence exporter. Idempotent on the
     default session: a previously-started default exporter is flushed
     and stopped before the new one starts — calling this twice never
-    double-writes span events or leaks a cadence thread."""
+    double-writes span events or leaks a cadence thread. An optional
+    :class:`SLOBurnEngine` rides the exporter cadence (its burn gauges
+    land in the same snapshot; alert events go to the JSONL log)."""
     global _default_exporter
 
     registry = set_enabled(True)
@@ -141,6 +151,6 @@ def enable(jsonl_path: str | None = None, prom_path: str | None = None,
     if jsonl_path or prom_path:
         exporter = MetricsExporter(
             registry, jsonl_path=jsonl_path, prom_path=prom_path,
-            cadence_s=cadence_s).start()
+            cadence_s=cadence_s, slo=slo).start()
         _default_exporter = exporter
     return Observability(registry, exporter, on_recompile=on_recompile)

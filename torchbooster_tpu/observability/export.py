@@ -118,11 +118,18 @@ class MetricsExporter:
     def __init__(self, registry: Registry | None = None,
                  jsonl_path: str | Path | None = None,
                  prom_path: str | Path | None = None,
-                 cadence_s: float = 10.0):
+                 cadence_s: float = 10.0,
+                 slo=None):
         self.registry = registry if registry is not None else get_registry()
         self.jsonl = JsonlExporter(jsonl_path) if jsonl_path else None
         self.prom_path = Path(prom_path) if prom_path else None
         self.cadence_s = max(float(cadence_s), 0.01)
+        # optional SLOBurnEngine: ticked first each cycle so the burn
+        # gauges it sets land in the very snapshot being exported
+        self.slo = slo
+        if self.slo is not None and self.jsonl is not None \
+                and getattr(self.slo, "sink", None) is None:
+            self.slo.sink = self.jsonl.write
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
 
@@ -132,6 +139,8 @@ class MetricsExporter:
             record_memory_gauges)
 
         record_memory_gauges(self.registry)
+        if self.slo is not None:
+            self.slo.tick()
         if self.jsonl is not None:
             self.jsonl.write({"event": "metrics", "ts": time.time(),
                               **self.registry.snapshot()})

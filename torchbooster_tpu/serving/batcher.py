@@ -468,7 +468,16 @@ class ContinuousBatcher:
         dict serving both the front door's ``GET /healthz?full=1``
         probe and the fleet router's load scorer (the contract that
         keeps an external health check and the routing decision
-        reading the same numbers). Host counters only."""
+        reading the same numbers). Host counters only.
+
+        ``step_seq`` / ``stamped_s`` are the STALENESS stamp the
+        fleet health scorer reads: the flight recorder's step count
+        (advances once per step, always on) paired with the moment
+        of stamping on the batcher's injectable session clock. A
+        payload whose ``step_seq`` froze while ``stamped_s`` kept
+        advancing is a replica that stopped making progress —
+        detectable from the payload alone, which is what an
+        out-of-process replica ships over the wire."""
         eng = self.engine
         return {
             "status": "ok",
@@ -479,6 +488,9 @@ class ContinuousBatcher:
             "inflight": self.inflight,
             "occupancy": round(self.occupancy, 4),
             "est_step_s": round(self.est_step_s, 6),
+            "step_seq": int(self.flight.n_recorded),
+            "stamped_s": (round(self.clock() - self._s.t0, 6)
+                          if self._s is not None else 0.0),
         }
 
     def drain_unfinished(self, retire_seated: bool = True) -> list:

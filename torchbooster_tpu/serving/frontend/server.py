@@ -29,6 +29,9 @@ asyncio HTTP server exposing
 - ``GET /debug/engine`` — pool occupancy, prefix-cache stats, compile
   counts, backend, the flight-recorder tail and its watchdog
   anomalies;
+- ``GET /debug/router`` — fleet front doors only: router stats
+  (+ per-replica health when scored) and the routing-decision audit
+  tail (``?tail=N``); 404 when serving a single batcher;
 - ``GET /debug/trace?id=<request_id>`` — one request's full event
   list from the tracing ring.
 
@@ -60,7 +63,9 @@ from __future__ import annotations
 
 import asyncio
 import concurrent.futures
+import json
 import time
+from pathlib import Path
 from urllib.parse import parse_qs
 
 import numpy as np
@@ -315,10 +320,16 @@ class ServingFrontend:
     def _crash_dump(self) -> None:
         """Terminal-error flight dump: snapshot the engine ring into
         ``last_flight`` and (when ``crash_dump_path`` is set) write
-        ``<prefix>.flight.jsonl`` + ``<prefix>.trace.json``. Must
-        never raise — a failed dump must not mask the pump's own
-        error."""
+        ``<prefix>.flight.jsonl`` + ``<prefix>.trace.json``. A
+        fleet-fronted server dumps EVERY replica's ring tagged with
+        its replica id plus the router audit-trail tail into the one
+        file — a single replica-blind ring would pin the whole
+        fleet's death on replica 0. Must never raise — a failed dump
+        must not mask the pump's own error."""
         try:
+            if hasattr(self.batcher, "replicas"):
+                self._crash_dump_fleet()
+                return
             self.last_flight = self.batcher.flight.dump()
             if self.crash_dump_path:
                 prefix = str(self.crash_dump_path)
@@ -329,6 +340,55 @@ class ServingFrontend:
                         prefix + ".trace.json")
         except Exception:  # noqa: BLE001 — diagnostics only
             pass
+
+    def _crash_dump_fleet(self) -> None:
+        """The fleet post-mortem: one ``.flight.jsonl`` holding every
+        replica's retained flight records/anomalies (each line tagged
+        ``replica``) followed by the router's last routing decisions —
+        who was routed where, and why, right up to the death."""
+        fleet = self.batcher
+        dumps: dict[int, dict] = {}
+        for rep in fleet.replicas:
+            batcher = getattr(rep, "batcher", None)
+            if batcher is None:
+                continue
+            d = batcher.flight.dump()
+            d["alive"] = bool(rep.alive)
+            dumps[rep.replica_id] = d
+        audit_tail = (fleet.audit.tail()
+                      if getattr(fleet, "audit", None) is not None
+                      else [])
+        self.last_flight = {"replicas": dumps,
+                            "router_audit": audit_tail}
+        if not self.crash_dump_path:
+            return
+        prefix = str(self.crash_dump_path)
+        path = Path(prefix + ".flight.jsonl")
+        path.parent.mkdir(parents=True, exist_ok=True)
+        lines = [json.dumps({
+            "event": "fleet_flight_header",
+            "n_replicas": len(fleet.replicas),
+            "n_audit": len(audit_tail)})]
+        for rid in sorted(dumps):
+            d = dumps[rid]
+            lines.append(json.dumps({
+                "event": "flight_header", "replica": rid,
+                "alive": d["alive"], "n_recorded": d["n_recorded"],
+                "capacity": d["capacity"],
+                "rolling_p99_s": d["rolling_p99_s"]}))
+            lines += [json.dumps({"event": "flight_step",
+                                  "replica": rid, **rec})
+                      for rec in d["records"]]
+            lines += [json.dumps({"event": "flight_anomaly",
+                                  "replica": rid, **a})
+                      for a in d["anomalies"]]
+        lines += [json.dumps({"event": "router_decision", **rec},
+                             default=str)
+                  for rec in audit_tail]
+        path.write_text("\n".join(lines) + "\n", encoding="utf-8")
+        if fleet.tracer.enabled:
+            # fleet form: request/engine tracks + the router track
+            fleet.write_chrome(prefix + ".trace.json")
 
     def _register(self, req: Request) -> _Stream:
         stream = _Stream(req)
@@ -406,11 +466,27 @@ class ServingFrontend:
                 payload = await asyncio.get_running_loop() \
                     .run_in_executor(self._exec, self._engine_debug)
                 writer.write(json_response(200, payload))
+            elif route == ("GET", "/debug/router"):
+                # fleet front doors only: router stats + the audit
+                # ring's decision tail (404 for a single batcher — no
+                # router exists to walk)
+                if not hasattr(self.batcher, "debug_router"):
+                    raise HttpError(
+                        404, "no router: this server fronts a single "
+                        "batcher, not an EngineFleet")
+                tail = int((parse_qs(query).get("tail")
+                            or ["64"])[0] or 64)
+                payload = await asyncio.get_running_loop() \
+                    .run_in_executor(
+                        self._exec,
+                        lambda: self.batcher.debug_router(tail=tail))
+                writer.write(json_response(200, payload))
             elif route == ("GET", "/debug/trace"):
                 writer.write(json_response(200, self._trace_of(query)))
             elif path in ("/v1/completions", "/v1/chat/completions",
                           "/metrics", "/healthz", "/debug/requests",
-                          "/debug/engine", "/debug/trace"):
+                          "/debug/engine", "/debug/router",
+                          "/debug/trace"):
                 raise HttpError(405,
                                 f"{request.method} not allowed here")
             else:

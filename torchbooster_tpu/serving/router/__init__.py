@@ -17,6 +17,16 @@ ROADMAP item 2's scale-out subsystem, in three layers:
   maintained from the replicas' BlockTables tier events, consulted
   by AffinityRouting on a map miss (route-to-holder over recompute)
   and purged/reassigned on replica death;
+- :mod:`health` — :class:`FleetHealth`, the per-replica hysteretic
+  healthy/degraded/unhealthy scorer (flight anomalies, queue/page
+  pressure, readiness staleness) exported as
+  ``router_replica_health``; the opt-in ``health_aware`` flag lets
+  spill scoring down-weight degraded replicas;
+- :mod:`audit` — the routing decision audit trail
+  (:class:`RoutingAudit`): one bounded record per choice (reason,
+  key, per-candidate load), surfaced at ``GET /debug/router``, as a
+  Perfetto router track, and as the ``replay_diff --routing``
+  artifact;
 - :mod:`fleet` — :class:`EngineFleet`, the batcher-shaped front-door
   core: arrival-time routing, one step per live replica per fleet
   step, cross-replica readmission on replica death or sustained
@@ -27,8 +37,15 @@ ROADMAP item 2's scale-out subsystem, in three layers:
 under the deterministic clock; the ``serving.router:`` YAML block
 (``config.RouterConfig``) builds one from config.
 """
+from torchbooster_tpu.serving.router.audit import (
+    RoutingAudit,
+    chrome_router_events,
+    diff_routing,
+    routing_artifact,
+)
 from torchbooster_tpu.serving.router.directory import PrefixDirectory
 from torchbooster_tpu.serving.router.fleet import EngineFleet
+from torchbooster_tpu.serving.router.health import FleetHealth
 from torchbooster_tpu.serving.router.replica import (
     InProcessReplica,
     Replica,
@@ -44,11 +61,16 @@ from torchbooster_tpu.serving.router.routing import (
 __all__ = [
     "AffinityRouting",
     "EngineFleet",
+    "FleetHealth",
     "InProcessReplica",
     "PrefixDirectory",
     "Replica",
     "RoundRobinRouting",
+    "RoutingAudit",
     "RoutingPolicy",
+    "chrome_router_events",
+    "diff_routing",
     "make_routing",
     "prefix_affinity_key",
+    "routing_artifact",
 ]

@@ -56,6 +56,7 @@ from collections import deque
 
 from torchbooster_tpu.observability import get_registry
 from torchbooster_tpu.serving.batcher import ContinuousBatcher, Request
+from torchbooster_tpu.serving.router.audit import RoutingAudit
 from torchbooster_tpu.serving.router.directory import PrefixDirectory
 from torchbooster_tpu.serving.router.replica import (
     InProcessReplica,
@@ -63,6 +64,7 @@ from torchbooster_tpu.serving.router.replica import (
 )
 from torchbooster_tpu.serving.router.routing import (
     RoutingPolicy,
+    _load_score,
     make_routing,
 )
 
@@ -81,7 +83,8 @@ class EngineFleet:
 
     def __init__(self, replicas: list, routing=None, *,
                  rebalance_queue: int = 0, rebalance_after: int = 8,
-                 directory: bool = True):
+                 directory: bool = True, audit: int = 256,
+                 health=None, health_aware: bool = False):
         if not replicas:
             raise ValueError("EngineFleet needs at least one replica")
         wrapped: list[Replica] = []
@@ -165,6 +168,27 @@ class EngineFleet:
         self.assignment_log: list[tuple[str, int]] = []
         self.last_error: BaseException | None = None
         self._inst: dict | None = None
+        # the routing decision audit trail (audit.py): one bounded
+        # record per routed request — 0 disables the ring (and the
+        # /debug/router decision tail with it)
+        if audit < 0:
+            raise ValueError(
+                f"audit must be >= 0 (0 = off), got {audit}")
+        self.audit: RoutingAudit | None = \
+            RoutingAudit(audit) if audit else None
+        self._readmitted_ids: set[str] = set()
+        # per-replica health scoring (health.py): observed every
+        # fleet step when attached; consulted by ROUTING only under
+        # the opt-in health_aware flag (decisions stay byte-identical
+        # otherwise — the obs_fleet bench pins it)
+        if health_aware and health is None:
+            raise ValueError(
+                "health_aware=True needs a FleetHealth scorer "
+                "(router.health.enabled in YAML)")
+        self.health = health
+        self.health_aware = bool(health_aware)
+        if self.health_aware:
+            self.routing.health = self.health
 
     # ---- clock plumbing (replay swaps it, every replica follows) --
     @property
@@ -284,6 +308,11 @@ class EngineFleet:
         self.n_fleet_cancelled = 0
         self.assignment_log = []
         self.last_error = None
+        self._readmitted_ids.clear()
+        if self.audit is not None:
+            self.audit.reset()
+        if self.health is not None:
+            self.health.reset()
         self._t0 = self.clock()
         reg = get_registry()
         self._inst = {
@@ -319,6 +348,14 @@ class EngineFleet:
                 "router_queue_depth",
                 "per-replica queue depth (label replica)"),
         }
+        if self.audit is not None:
+            self._inst["audit_depth"] = reg.gauge(
+                "router_audit_depth",
+                "routing decisions currently held in the bounded "
+                "audit ring")
+            self._inst["audit_total"] = reg.counter(
+                "router_audit_records_total",
+                "routing decisions recorded onto the audit ring")
         self._inst["live"].set(self.n_live)
         self._session = True
 
@@ -382,6 +419,8 @@ class EngineFleet:
         for req in orphans:
             self._owner.pop(id(req), None)
             self._pending.append(req)
+            # the audit trail tags the re-route (readmit+<reason>)
+            self._readmitted_ids.add(req.request_id)
         self.n_readmitted += len(orphans)
         # the PR 16 satellite fix: affinity metadata used to die
         # SILENTLY with the replica — the directory kept routing-grade
@@ -472,6 +511,50 @@ class EngineFleet:
             if getattr(self.routing, "last_directory_hit", False):
                 self.n_directory_hits += 1
                 self._inst["dir_hits"].inc()
+            if self.audit is not None:
+                self._audit_record(req, rid, live)
+        if self.audit is not None:
+            self._inst["audit_depth"].set(len(self.audit))
+
+    def _audit_record(self, req: Request, rid: int,
+                      live: list) -> None:
+        """One audit-ring record per routing decision: the verdict
+        (reason + affinity key) and the per-candidate load picture
+        the router scored — request-cadence host dicts only."""
+        routing = self.routing
+        reason = getattr(routing, "last_reason", "") or routing.name
+        if req.request_id in self._readmitted_ids:
+            reason = f"readmit+{reason}"
+        key = getattr(routing, "last_key", None)
+        home = None
+        key_pages = 0
+        if key is not None:
+            home = getattr(routing, "_map", {}).get(key)
+            key_pages = min(
+                len(req.prompt) // max(self.page_size, 1),
+                getattr(routing, "affinity_pages", 0))
+        rec = {
+            "seq": self.audit.n_records,
+            "request_id": req.request_id,
+            "arrival": round(req.arrival, 6),
+            "replica": rid,
+            "reason": reason,
+            "key": key,
+            "candidates": [{
+                "replica": r.replica_id,
+                "queue_depth": r.queue_depth,
+                "inflight": r.inflight,
+                "slack_s": round(_load_score(r, req), 6),
+                "affinity_pages": (key_pages
+                                   if r.replica_id == home else 0),
+            } for r in live],
+        }
+        if self.health is not None:
+            rec["health"] = {
+                str(r.replica_id): self.health.state_name(
+                    r.replica_id) for r in live}
+        self.audit.record(rec)
+        self._inst["audit_total"].inc()
 
     def _drain_cancels(self, events: list) -> None:
         while self._inbox_cancel:
@@ -583,6 +666,8 @@ class EngineFleet:
                     for r in family:
                         self._owner.pop(id(r), None)
         self._rebalance()
+        if self.health is not None:
+            self.health.observe(self)
         for rep in self.replicas:
             self._inst["depth"].set(
                 rep.queue_depth if rep.alive else 0,
@@ -641,6 +726,33 @@ class EngineFleet:
             rows.append(row)
         return {"router": self.router_stats(), "replicas": rows}
 
+    def debug_router(self, tail: int = 64) -> dict:
+        """The ``GET /debug/router`` payload: router stats (policy,
+        counters, health/audit blocks) + the audit ring's newest
+        ``tail`` decision records. Runs on the pump thread like the
+        other debug payloads — host dict reads only."""
+        return {
+            "router": self.router_stats(),
+            "decisions": ([] if self.audit is None
+                          else self.audit.tail(tail)),
+        }
+
+    def write_chrome(self, path) -> "Path":
+        """Chrome trace for the fleet: the shared request tracer's
+        tracks (pid 1 requests / pid 2 engine) MERGED with the router
+        track (pid 3 — one thread row per replica, one instant per
+        routing decision) so Perfetto shows who was routed where on
+        the same timeline the requests run on."""
+        from torchbooster_tpu.observability.tracing import (
+            write_chrome_trace)
+        from torchbooster_tpu.serving.router.audit import (
+            chrome_router_events)
+
+        events = list(self.tracer.chrome_events())
+        if self.audit is not None:
+            events += chrome_router_events(self.audit.tail())
+        return write_chrome_trace(path, events)
+
     def router_stats(self) -> dict:
         return {
             "policy": self.routing.name,
@@ -661,6 +773,14 @@ class EngineFleet:
                 "n_evictions": self.directory.n_evictions,
                 "n_reassigned": self.directory.n_reassigned,
             }),
+            "audit": (None if self.audit is None else {
+                "capacity": self.audit.capacity,
+                "depth": len(self.audit),
+                "n_records": self.audit.n_records,
+            }),
+            "health_aware": self.health_aware,
+            "health": (None if self.health is None
+                       else self.health.snapshot()),
         }
 
     # ---- metrics merge -------------------------------------------

@@ -62,9 +62,20 @@ def prefix_affinity_key(prompt: np.ndarray, page_size: int,
 class RoutingPolicy:
     """Routing hook surface: ``choose`` returns a replica index from
     ``live`` (non-empty, ascending). ``reset()`` clears per-session
-    state at fleet session start so replays are reproducible."""
+    state at fleet session start so replays are reproducible.
+
+    ``last_reason`` / ``last_key`` are per-choice verdict attributes
+    (like ``AffinityRouting.last_*``) the fleet's audit trail reads
+    back after each ``choose``. ``health`` is ``None`` unless the
+    fleet's opt-in ``health_aware`` flag attaches a ``FleetHealth``
+    scorer — policies that score load multiply by its per-replica
+    weight; with ``None`` (the default) no arithmetic changes and
+    decisions stay byte-identical."""
 
     name = "round_robin"
+    last_reason = ""
+    last_key: int | None = None
+    health = None
 
     def reset(self) -> None:
         pass
@@ -83,6 +94,7 @@ class RoundRobinRouting(RoutingPolicy):
         self._next = 0
 
     def choose(self, req, live: list, fleet) -> int:
+        self.last_reason = "round_robin"
         pick = live[self._next % len(live)]
         self._next += 1
         return pick.replica_id
@@ -136,9 +148,22 @@ class AffinityRouting(RoutingPolicy):
         self.last_affinity_hit = False
         self.last_spill = False
         self.last_directory_hit = False
+        self.last_reason = ""
+        self.last_key = None
 
     def _least_loaded(self, req, live: list) -> int:
-        # min score, ties toward the lower replica id (determinism)
+        # min score, ties toward the lower replica id (determinism).
+        # With a health scorer attached (the fleet's opt-in
+        # health_aware flag) the score is down-weighted by the
+        # replica's health multiplier on a SEPARATE branch: the
+        # health=None path runs the exact pre-existing float
+        # arithmetic, so disabled routing stays byte-identical.
+        if self.health is not None:
+            h = self.health
+            best = min(live, key=lambda r: (
+                _load_score(r, req) * h.weight(r.replica_id),
+                r.replica_id))
+            return best.replica_id
         best = min(live, key=lambda r: (_load_score(r, req),
                                         r.replica_id))
         return best.replica_id
@@ -149,7 +174,9 @@ class AffinityRouting(RoutingPolicy):
         self.last_directory_hit = False
         key = prefix_affinity_key(
             req.prompt, fleet.page_size, self.affinity_pages)
+        self.last_key = key
         if key is None:
+            self.last_reason = "least_loaded"
             return self._least_loaded(req, live)
         by_id = {r.replica_id: r for r in live}
         home = self._map.get(key)
@@ -167,11 +194,13 @@ class AffinityRouting(RoutingPolicy):
                 if hit is not None:
                     self._map[key] = hit[0]
                     self.last_directory_hit = True
+                    self.last_reason = "directory"
                     return hit[0]
             # nobody holds it: bind to the least-loaded live replica
             # — the pages warm THERE
             home = self._least_loaded(req, live)
             self._map[key] = home
+            self.last_reason = "bind"
             return home
         # backlog = queued + in-flight: a replica with every slot
         # busy and an empty queue is NOT idle — the spill check must
@@ -182,8 +211,10 @@ class AffinityRouting(RoutingPolicy):
             # hot prefix: protect the home replica's queue; the map
             # keeps pointing home so traffic returns once it drains
             self.last_spill = True
+            self.last_reason = "spill"
             return self._least_loaded(req, live)
         self.last_affinity_hit = True
+        self.last_reason = "affinity"
         return home
 
 
