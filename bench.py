@@ -2652,6 +2652,154 @@ def bench_serve_spill() -> dict:
     return out
 
 
+def bench_serve_structured() -> dict:
+    """Structured-generation A/B (the PR-18 tentpole): three arms over
+    one request trace.
+
+    - **off**: ``structured: false`` engine, plain (unconstrained)
+      trace — the baseline token streams and decode tokens/s;
+    - **plain**: ``structured: true`` engine, the SAME plain trace —
+      the flag's price for traffic that never constrains. Gated on
+      BITWISE token parity with the off arm (the all-ones mask must be
+      a no-op through the compiled steps) and on decode-throughput
+      overhead below ``BENCH_STRUCT_OVERHEAD_PCT`` (default 3%);
+    - **on**: ``structured: true`` engine, a MIXED trace — every
+      schema in the loadgen library plus unconstrained riders — gated
+      on 100% conformance (every constrained completion parses under
+      its own schema, ``finish_reason: stop``) and on the
+      zero-recompile contract: ``decode_compiles`` exactly 1 across
+      the whole schema mix (the mask is a traced value operand, so
+      mixing schemas can never re-specialize the step).
+
+    The decode roofline is pool bytes per step; the cursor advance and
+    mask refresh are host-side table lookups overlapped with the
+    device step, so the structured-on/constrained-off arm should price
+    within noise — the overhead gate is the claim. Timed arms run
+    best-of-``BENCH_STRUCT_REPEATS`` (default 3) to damp host jitter.
+    """
+    from torchbooster_tpu.models.gpt import GPT, GPTConfig
+    from torchbooster_tpu.serving import (ContinuousBatcher,
+                                          PagedEngine, Request)
+    from torchbooster_tpu.serving.structured import (
+        SCHEMA_LIBRARY, conforms, library_response_format,
+        schema_budget)
+
+    n_req = int(os.environ.get("BENCH_STRUCT_REQUESTS", 12))
+    slots = int(os.environ.get("BENCH_STRUCT_SLOTS", 8))
+    page = int(os.environ.get("BENCH_STRUCT_PAGE", 64))
+    n_pages = int(os.environ.get("BENCH_STRUCT_PAGES", 96))
+    seq = int(os.environ.get("BENCH_STRUCT_SEQ", 1024))
+    n_layers = int(os.environ.get("BENCH_STRUCT_LAYERS", 8))
+    vocab = int(os.environ.get("BENCH_STRUCT_VOCAB", 2048))
+    repeats = int(os.environ.get("BENCH_STRUCT_REPEATS", 3))
+    max_pct = float(os.environ.get("BENCH_STRUCT_OVERHEAD_PCT", 3.0))
+    if vocab <= 128:
+        raise ValueError(
+            f"BENCH_STRUCT_VOCAB ({vocab}) must exceed 128: the "
+            "schema library constrains over printable-ASCII token "
+            "ids, and the forced-EOS id must sit outside that range")
+    eos = vocab - 1
+
+    rs = np.random.RandomState(0)
+    prompt_len = 2 * page
+    prompts = [rs.randint(0, vocab, prompt_len, dtype=np.int32)
+               for _ in range(n_req)]
+    out_lens = rs.randint(16, 48, n_req)
+
+    def plain_trace():
+        return [Request(prompt=p, max_new_tokens=int(o))
+                for p, o in zip(prompts, out_lens)]
+
+    lib = sorted(SCHEMA_LIBRARY)
+
+    def mixed_trace():
+        # every library schema appears; every third request rides
+        # unconstrained so the mask's all-ones rows stay exercised
+        reqs = []
+        for i, (p, o) in enumerate(zip(prompts, out_lens)):
+            if i % 3 == 2:
+                reqs.append(Request(prompt=p, max_new_tokens=int(o)))
+                continue
+            sid = lib[i % len(lib)]
+            reqs.append(Request(
+                prompt=p, eos_id=eos,
+                max_new_tokens=max(int(o), schema_budget(sid)),
+                response_format=library_response_format(sid)))
+        return reqs
+
+    cfg = GPTConfig(vocab=vocab, n_layers=n_layers, seq_len=seq,
+                    n_kv_heads=4)
+    params = GPT.init(jax.random.PRNGKey(0), cfg)
+    # scale the embedding so greedy argmax is decisive — conformance
+    # must be the automaton's doing, not numerical ties
+    params = {**params,
+              "wte": {"table": params["wte"]["table"] * 4.0}}
+
+    out: dict = {"serve_structured_requests": n_req,
+                 "serve_structured_vocab": vocab}
+    tokens_by_arm: dict[str, list] = {}
+    for arm, structured in (("off", False), ("plain", True)):
+        engine = PagedEngine(params, cfg, page_size=page,
+                             n_pages=n_pages, max_slots=slots,
+                             structured=structured)
+        batcher = ContinuousBatcher(engine)
+        batcher.run([Request(prompt=prompts[0][:page],
+                             max_new_tokens=4)])
+        best = 0.0
+        for _ in range(max(1, repeats)):
+            reqs = plain_trace()
+            m = batcher.run(reqs)
+            best = max(best, m["decode_tok_s"])
+            tokens_by_arm[arm] = [list(r.tokens) for r in reqs]
+        out[f"serve_structured_tok_s_{arm}"] = best
+        out[f"serve_structured_decode_compiles_{arm}"] = \
+            engine.decode_compiles
+
+    # the constrained arm: fresh engine, the mixed-schema trace
+    engine = PagedEngine(params, cfg, page_size=page, n_pages=n_pages,
+                         max_slots=slots, structured=True)
+    batcher = ContinuousBatcher(engine)
+    batcher.run([Request(prompt=prompts[0][:page], max_new_tokens=4)])
+    reqs = mixed_trace()
+    m = batcher.run(reqs)
+    constrained = [r for r in reqs if r.response_format is not None]
+    conformant = 0
+    for r in constrained:
+        toks = r.tokens[:-1] if r.tokens and r.tokens[-1] == eos \
+            else r.tokens
+        text = "".join(chr(t) for t in toks if t < 256)
+        if r.finish_reason == "stop" and conforms(r.response_format,
+                                                  text):
+            conformant += 1
+    conformance = conformant / max(len(constrained), 1)
+
+    overhead_pct = 100.0 * (
+        1.0 - out["serve_structured_tok_s_plain"]
+        / max(out["serve_structured_tok_s_off"], 1e-9))
+    parity = tokens_by_arm["plain"] == tokens_by_arm["off"]
+    compiles_ok = (out["serve_structured_decode_compiles_plain"] == 1
+                   and engine.decode_compiles == 1)
+    ok = (conformance == 1.0 and parity and compiles_ok
+          and overhead_pct < max_pct)
+    if not ok:
+        print(f"bench serve_structured: conformance={conformance} "
+              f"parity={parity} compiles_ok={compiles_ok} "
+              f"overhead={overhead_pct:.2f}%", file=sys.stderr)
+    out.update({
+        "serve_structured_tok_s_on": m["decode_tok_s"],
+        "serve_structured_overhead_pct": round(overhead_pct, 2),
+        "serve_structured_token_parity": parity,
+        "serve_structured_n_constrained": len(constrained),
+        "serve_structured_conformance": round(conformance, 4),
+        "serve_structured_masked_frac": m["structured_masked_frac"],
+        "serve_structured_n_schemas": len(lib),
+        "serve_structured_decode_compiles_on": engine.decode_compiles,
+        "serve_structured_one_compile": compiles_ok,
+        "serve_structured_ok": ok,
+    })
+    return out
+
+
 def bench_obs(steps: int) -> dict:
     """Telemetry overhead A/B: the SAME GPT bench step (bench_gpt
     geometry + knobs) timed with observability disabled, then enabled
@@ -3477,6 +3625,8 @@ def _sub_main(name: str) -> None:
         print(json.dumps(bench_serve_fleet()))
     elif name == "serve_spill":
         print(json.dumps(bench_serve_spill()))
+    elif name == "serve_structured":
+        print(json.dumps(bench_serve_structured()))
     elif name == "obs_fleet":
         print(json.dumps(bench_obs_fleet()))
     elif name == "obs":
@@ -3706,6 +3856,11 @@ _SECONDARY_BENCHES = (("gpt", 900), ("gpt_long", 1500), ("loader", 900),
                       # bytes-accounting gate; shares its run_ab
                       # QUEUE deadline (two-drivers-must-agree)
                       ("serve_spill", 1800),
+                      # the structured-generation row (PR 18):
+                      # conformance + flag-on parity/overhead + the
+                      # zero-recompile schema-mix gate; shares its
+                      # run_ab QUEUE deadline (two-drivers-must-agree)
+                      ("serve_structured", 1800),
                       # the fleet signal-plane row (PR 17): plane
                       # on/off overhead + routing byte-identity + the
                       # replay_diff --routing round trip; shares its

@@ -131,7 +131,7 @@ def main() -> None:
                  "serve_parallel", "serve_tree",
                  "obs_trace", "replay", "replay_http",
                  "serve_fleet", "serve_fleet_affinity",
-                 "serve_spill", "obs_fleet")
+                 "serve_spill", "serve_structured", "obs_fleet")
     for name in sorted(attempts):
         if name in METRICS or (name in multi_key and name in latest):
             continue  # multi-key ok rows print below; failures fall through
@@ -482,6 +482,36 @@ def main() -> None:
               f"| {r.get('serve_spill_hbm_hit_pages', '—')} |")
         print(f"| host_hit | {r.get('serve_spill_ttft_host_s', '—')} "
               f"| {r.get('serve_spill_host_hit_pages', '—')} |")
+
+    # serve_structured row: the constrained-decoding A/B — the
+    # flag-off baseline vs flag-on-unconstrained (parity + overhead)
+    # vs flag-on-constrained (conformance + the one-compile schema-mix
+    # proof), gates in the header
+    e = latest.get("serve_structured")
+    if e is not None:
+        r = e.get("result") or {}
+        print(f"\nserve_structured "
+              f"({r.get('serve_structured_n_constrained', '?')} "
+              f"constrained of {r.get('serve_structured_requests', '?')}"
+              f" reqs x {r.get('serve_structured_n_schemas', '?')} "
+              "schemas, conformance "
+              f"{r.get('serve_structured_conformance', '?')} (gate "
+              "1.0), flag-on overhead "
+              f"{r.get('serve_structured_overhead_pct', '?')}% of "
+              "limit 3%, token parity "
+              f"{r.get('serve_structured_token_parity', '?')}, one "
+              f"compile {r.get('serve_structured_one_compile', '?')}, "
+              "verdict "
+              f"ok={r.get('serve_structured_ok', '?')}):")
+        print("| arm | decode tok/s | masked frac |")
+        print("|---|---|---|")
+        print(f"| off | {r.get('serve_structured_tok_s_off', '—')} "
+              "| — |")
+        print(f"| on, unconstrained "
+              f"| {r.get('serve_structured_tok_s_plain', '—')} | — |")
+        print(f"| on, constrained "
+              f"| {r.get('serve_structured_tok_s_on', '—')} "
+              f"| {r.get('serve_structured_masked_frac', '—')} |")
 
     # obs_fleet row: the fleet signal-plane A/B — plane off vs on
     # decode tok/s with the <3% headline, the routing byte-identity +

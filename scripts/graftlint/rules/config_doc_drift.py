@@ -61,6 +61,7 @@ BLOCKS = {
     "tracing": "TracingConfig",
     "health": "RouterHealthConfig",
     "slo": "SLOBurnConfig",
+    "structured": "StructuredConfig",
 }
 
 _FENCE = re.compile(r"^```yaml\s*$")

@@ -52,7 +52,12 @@ HOT_PATHS = (
     # compiled device_put'd write, neither on the per-token decode
     # cadence — so no allowlist entries are needed unless a flagged
     # pattern (.item() / time.time() / float(<call>)) ever lands
-    # there; the router/directory.py bookkeeping is pure host dicts
+    # there; the router/directory.py bookkeeping is pure host dicts.
+    # The prefix also covers serving/structured/ (PR 18): cursor
+    # advance + mask refresh run between every decode/verify dispatch
+    # — deliberate host numpy bookkeeping, plain-int arithmetic only,
+    # so a stray .item()/float(<call>) there stalls the decode loop
+    # like one in the engine itself
     "torchbooster_tpu/serving/",
     # the paged flash-decode kernel wrapper sits INSIDE the compiled
     # decode/verify steps (serving/engine.py calls it per layer per

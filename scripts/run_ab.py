@@ -195,6 +195,16 @@ QUEUE: list[tuple[str, str, dict, int]] = [
     # bytes EQUAL to the engine's measured counter
     # (bench.bench_serve_spill; serve_spill_ok is the verdict bit)
     ("serve_spill", "serve_spill", {}, 1800),
+    # structured generation (the PR-18 tentpole): three arms over one
+    # trace — structured-off baseline, structured-on with the SAME
+    # unconstrained trace (bitwise token parity + < 3% decode tok/s
+    # overhead: the all-ones mask must price as a no-op), and
+    # structured-on with every library schema mixed in (100%
+    # conformance, finish_reason stop, decode_compiles exactly 1
+    # across the schema mix — the mask is a traced value operand)
+    # (bench.bench_serve_structured; serve_structured_ok is the
+    # verdict bit)
+    ("serve_structured", "serve_structured", {}, 1800),
     # fleet signal plane (the PR-17 tentpole): plane-off vs plane-on
     # (audit ring + health scorer + SLO burn engine, health_aware OFF)
     # over the serve_fleet workload — < 3% decode tok/s overhead, zero

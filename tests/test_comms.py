@@ -367,6 +367,7 @@ def test_zero_recompiles_across_steps(mode, zero1):
 # GPT loss-curve parity (the acceptance pin): int8+EF within 1% of fp32
 # =========================================================================
 
+@pytest.mark.slow     # heavy compile/train on CPU (tier-1 time budget)
 def test_gpt_int8_loss_within_1pct_of_fp32_after_50_steps():
     from torchbooster_tpu.models.gpt import GPT, GPTConfig
     from torchbooster_tpu.ops.losses import cross_entropy

@@ -35,7 +35,10 @@ def test_lenet_grads_flow():
     assert all(n > 0 for n in flat)
 
 
-@pytest.mark.parametrize("depth", [18, 50])
+@pytest.mark.parametrize("depth", [
+    18,
+    pytest.param(50, marks=pytest.mark.slow),  # tier-1 time budget
+])
 def test_resnet_forward(depth):
     params = ResNet.init(jax.random.PRNGKey(0), depth=depth,
                          num_classes=10, stem="cifar")
@@ -178,6 +181,7 @@ def test_gpt_dropout_real_and_deterministic():
                            dropout=1.5))
 
 
+@pytest.mark.slow     # heavy compile/train on CPU (tier-1 time budget)
 def test_gpt_dropout_changes_training_trajectory():
     """Threaded through make_step's per-step rng, dropout>0 yields a
     different loss sequence than the deterministic model — the knob
@@ -297,6 +301,7 @@ def test_gpt_generate_bf16_cache_decisive_head_parity():
     np.testing.assert_array_equal(np.asarray(got), np.asarray(cur))
 
 
+@pytest.mark.slow     # heavy compile/train on CPU (tier-1 time budget)
 def test_gpt_generate_int8_cache():
     """cache_dtype="int8": the quantized KV cache (symmetric
     per-token-head int8 + bf16 scales) decodes valid ids and, on a
@@ -439,6 +444,7 @@ def test_gpt_generate_moe_smoke():
     assert int(jnp.max(out)) < cfg.vocab
 
 
+@pytest.mark.slow     # heavy compile/train on CPU (tier-1 time budget)
 def test_stem_s2d_matches_plain_conv():
     """Space-to-depth stem repack == the 7x7/s2 pad-3 conv, exactly
     (forward and grads) — and the whole model agrees end to end."""
@@ -533,7 +539,10 @@ def test_ws_kernel_standardization():
                                rtol=1e-5, atol=1e-6)
 
 
-@pytest.mark.parametrize("depth", [18, 50])
+@pytest.mark.parametrize("depth", [
+    18,
+    pytest.param(50, marks=pytest.mark.slow),  # tier-1 time budget
+])
 def test_nf_resnet_forward_and_signal_propagation(depth):
     """The norm-free variant runs on the unchanged param tree, and its
     analytic variance tracking actually holds: with init params the
@@ -560,6 +569,7 @@ def test_nf_resnet_forward_and_signal_propagation(depth):
     assert 0.1 < std < 10.0, f"signal scale drifted: std={std}"
 
 
+@pytest.mark.slow     # heavy compile/train on CPU (tier-1 time budget)
 def test_nf_resnet_trains():
     """A few SGD steps reduce the loss — the variant is trainable
     without any activation norm."""
@@ -683,6 +693,7 @@ def test_gpt_rope_sequence_parallel_matches_single():
                                rtol=2e-3, atol=2e-3)
 
 
+@pytest.mark.slow     # heavy compile/train on CPU (tier-1 time budget)
 def test_gpt_ring_flash_sequence_parallel_matches_single():
     """The model's sp path with the ring-flash body (attn_impl
     ="flash_interpret", sp_strategy="ring"): GPT forward AND grads on
@@ -735,6 +746,7 @@ def test_gpt_pos_validated():
                            seq_len=8, pos="rotary"))
 
 
+@pytest.mark.slow     # heavy compile/train on CPU (tier-1 time budget)
 def test_gpt_swiglu_trains_and_shards():
     """mlp="swiglu": gated MLP (separate fc1/fc3 so tp shards cleanly),
     param count ≈ the gelu MLP's, trains, and a tp mesh matches the
@@ -790,6 +802,7 @@ def test_gpt_swiglu_trains_and_shards():
                            seq_len=8, mlp="geglu"))
 
 
+@pytest.mark.slow     # heavy compile/train on CPU (tier-1 time budget)
 def test_gpt_generate_top_p():
     """Nucleus sampling: top_p→0 degenerates to greedy; top_p=1 keeps
     the full distribution (same draw as unfiltered sampling)."""
@@ -958,6 +971,7 @@ def test_diffusion_schedule_invariants():
         make_schedule("sigmoid", 10)
 
 
+@pytest.mark.slow     # heavy compile/train on CPU (tier-1 time budget)
 def test_unet_shapes_grads_and_time_conditioning():
     from torchbooster_tpu.models.unet import UNet, UNetConfig
 

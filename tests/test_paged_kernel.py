@@ -341,7 +341,8 @@ def _tp_mesh(tp):
 
 @pytest.mark.parametrize("tp,compute_dtype,cache_dtype,kv", [
     (2, jnp.bfloat16, "int8", 2),   # the acceptance pair (GQA+int8)
-    (2, jnp.float32, None, 0),      # full-MHA cache width
+    pytest.param(2, jnp.float32, None, 0,      # full-MHA cache width
+                 marks=pytest.mark.slow),      # tier-1 time budget
     pytest.param(4, jnp.bfloat16, None, 0, marks=pytest.mark.slow),
     pytest.param(4, jnp.bfloat16, "int8", 0,
                  marks=pytest.mark.slow),

@@ -597,10 +597,11 @@ def test_workload_v2_n_fields_roundtrip_fingerprint_and_v1(tmp_path):
     # the fingerprint covers n/best_of whenever set...
     assert plain.fingerprint() != fan.fingerprint()
     assert wl(3, 4).fingerprint() == fan.fingerprint()
-    # ...and round-trips through the v2 file format
+    # ...and round-trips through the current file format
+    from torchbooster_tpu.serving.loadgen.workload import FORMAT_VERSION
     path = fan.save(tmp_path / "w.jsonl")
     header = json.loads(path.read_text().splitlines()[0])
-    assert header["version"] == 2
+    assert header["version"] == FORMAT_VERSION
     loaded = Workload.load(path)
     assert loaded.requests[0].n == 3
     assert loaded.requests[0].best_of == 4

@@ -153,6 +153,7 @@ def test_gpt_routes_through_pipeline_and_matches_single_device():
                                    atol=2e-3)
 
 
+@pytest.mark.slow     # heavy compile/train on CPU (tier-1 time budget)
 def test_gpt_pipeline_dropout_independent_per_microbatch():
     """Dropout under pp must draw INDEPENDENT masks per microbatch
     (the key folds in the microbatch index): identical sample content
@@ -183,6 +184,7 @@ def test_gpt_pipeline_dropout_independent_per_microbatch():
     np.testing.assert_array_equal(out, np.asarray(out2))
 
 
+@pytest.mark.slow     # heavy compile/train on CPU (tier-1 time budget)
 def test_gpt_pipeline_tensor_parallel_matches_single_device():
     """tp INSIDE the pipeline: on a dp:2,pp:2,tp:2 mesh the block
     weights shard Megatron-style across tp within each pp stage
@@ -330,6 +332,7 @@ def test_qkv_tp_major_marker_guards():
                    for k in back["blocks"]["attn_qkv"])
 
 
+@pytest.mark.slow     # heavy compile/train on CPU (tier-1 time budget)
 def test_gpt_pipeline_tp_major_resume_from_canonical_checkpoint():
     """A canonical single-device checkpoint (params + adam mu/nu)
     resumes onto a pp×tp mesh via qkv_state_to_tp_major: the optimizer
@@ -577,6 +580,7 @@ def test_gpt_pipeline_moe_tp_matches_single_device():
                                    rtol=2e-3, atol=2e-3)
 
 
+@pytest.mark.slow     # heavy compile/train on CPU (tier-1 time budget)
 def test_gpt_pipeline_moe_aux_threads_through():
     """MoE blocks pipeline: the load-balance aux rides the GPipe
     schedule (per-microbatch estimator). With generous capacity (no

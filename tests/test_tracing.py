@@ -327,6 +327,7 @@ _STABLE_KEYS = {
     "n_spec_proposed", "n_spec_accepted", "spec_accept_rate",
     "spec_mean_accepted", "n_forks", "fork_pages", "n_cow_copies",
     "n_spills", "n_promotions", "host_hit_pages",
+    "n_structured", "structured_masked_frac",
     "n_shed", "n_cancelled",
     "deadline_hit_rate", "classes",
 }

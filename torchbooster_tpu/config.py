@@ -732,6 +732,37 @@ class HostSpillConfig(BaseConfig):
 
 
 @dataclass
+class StructuredConfig(BaseConfig):
+    """Structured generation (serving/structured), nested under
+    ``serving:`` as its ``structured:`` sub-block. No reference
+    analogue — this is the grammar/JSON-schema-constrained decoding
+    surface over the paged engine.
+
+    YAML block::
+
+        serving:
+          structured:
+            enabled: true      # accept constraining response_format
+
+    ``enabled: true`` builds the engine with the token-DFA machinery:
+    requests may carry an OpenAI ``response_format``
+    (``json_object`` | ``json_schema`` | ``regex``), compiled ONCE
+    per schema into per-state allowed-token masks and enforced per
+    slot as a fixed-shape legality mask threaded through the compiled
+    decode/verify steps as a trailing VALUE operand — zero
+    recompiles, exact token parity for unconstrained traffic, and
+    full composition with speculative decoding and ``n``/``best_of``
+    parallel sampling. Constraining requests require an ``eos_id``
+    (the automaton terminates by forcing EOS at an accepting state).
+    Off (the default), a constraining ``response_format`` is rejected
+    at submit (HTTP 400) and the engine is bit-for-bit the
+    unconstrained one. See docs/serving.md "Structured generation".
+    """
+
+    enabled: bool = False              # token-DFA constrained decoding
+
+
+@dataclass
 class RouterHealthConfig(BaseConfig):
     """Per-replica health scoring (serving/router/health.py), nested
     under ``router:`` as its ``health:`` sub-block. No reference
@@ -948,6 +979,13 @@ class ServingConfig(BaseConfig):
     exclusive with ``speculative``. Off (the default) the engine is
     bit-for-bit the single-stream one.
 
+    ``structured:`` (see :class:`StructuredConfig`) enables
+    schema/regex-constrained decoding: requests carrying an OpenAI
+    ``response_format`` decode under a per-slot token-DFA legality
+    mask — compiled once per schema, threaded through the compiled
+    steps as a trailing value operand (zero recompiles), composing
+    with speculative decoding and parallel sampling.
+
     ``decode_backend: pallas`` swaps the decode/verify pool READ for
     the paged flash-decode kernel (ops/paged_attention.py): block
     tables walked in-kernel, so bytes/step are the live context
@@ -990,6 +1028,8 @@ class ServingConfig(BaseConfig):
         default_factory=RouterConfig)  # engine-fleet replica scale-out
     host_spill: HostSpillConfig = dataclasses.field(
         default_factory=HostSpillConfig)  # host-RAM page spill tier
+    structured: StructuredConfig = dataclasses.field(
+        default_factory=StructuredConfig)  # constrained decoding
 
     def make(self, params: Any, model_cfg: Any,
              compute_dtype: Any = None,
@@ -1056,6 +1096,7 @@ class ServingConfig(BaseConfig):
                 decode_backend=self.decode_backend,
                 host_spill=self.host_spill.enabled,
                 host_spill_mb=self.host_spill.budget_mb,
+                structured=self.structured.enabled,
                 tp=self.tp, mesh=mesh)
 
         # ONE policy object serves every replica AND the fleet-level
@@ -1110,6 +1151,11 @@ class LoadgenConfig(BaseConfig):
     fan-out (``n = best_of`` drawn in ``[2, n_max]``), so replays
     carry OpenAI ``n``/``best_of`` traffic through the harness —
     serve them against a ``serving.parallel_sampling: true`` engine.
+    ``structured_frac`` gives that fraction of synthetic requests an
+    OpenAI ``response_format`` drawn from the built-in schema
+    library (format v3) — serve them against a
+    ``serving.structured.enabled: true`` engine; at ``0.0`` (the
+    default) the workload is byte-identical to pre-knob output.
     ``tenants > 0`` (with ``prefix_pages >= 1``) prepends each
     synthetic request with one of ``tenants`` fixed page-aligned
     system prompts of ``prefix_pages * prefix_page_size`` tokens —
@@ -1138,6 +1184,7 @@ class LoadgenConfig(BaseConfig):
     cancel_frac: float = 0.0           # recorded client disconnects
     n_frac: float = 0.0                # fraction with n/best_of > 1
     n_max: int = 4                     # largest synthetic n
+    structured_frac: float = 0.0       # fraction with response_format
     tenants: int = 0                   # 0 = no shared tenant prefixes
     prefix_pages: int = 0              # tenant system-prompt pages
     prefix_page_size: int = 64         # page alignment of the prefix
@@ -1166,6 +1213,7 @@ class LoadgenConfig(BaseConfig):
                 max_new_tokens=tuple(self.max_new_tokens),
                 classes=self.classes, cancel_frac=self.cancel_frac,
                 n_frac=self.n_frac, n_max=self.n_max,
+                structured_frac=self.structured_frac,
                 tenants=self.tenants,
                 prefix_pages=self.prefix_pages,
                 page_size=self.prefix_page_size)

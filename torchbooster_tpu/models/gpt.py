@@ -1004,8 +1004,26 @@ def _lm_head(params: dict, x: jax.Array) -> jax.Array:
     return x @ params["wte"]["table"].astype(x.dtype).T
 
 
+def _mask_logits(logits: jax.Array, mask: jax.Array | None
+                 ) -> jax.Array:
+    """Constrained-decoding legality mask: forbidden positions drop
+    to the dtype's finite minimum (NOT ``-inf`` — an all-masked row
+    would turn softmax into NaN; finfo.min keeps it a degenerate but
+    finite distribution, and the structured subsystem guarantees at
+    least one legal token per live row anyway). ``mask`` broadcasts
+    against ``(..., vocab)`` and rides into the compiled decode and
+    verify steps as a trailing VALUE operand (serving/engine.py) —
+    shape fixed by pool geometry, so zero recompiles. ``None`` (and
+    an all-True row) is an exact no-op, which is what keeps
+    unconstrained traffic token-identical when the feature is on."""
+    if mask is None:
+        return logits
+    return jnp.where(mask, logits, jnp.finfo(logits.dtype).min)
+
+
 def _filter_logits(logits: jax.Array, temperature: float,
-                   top_k: int | None, top_p: float | None) -> jax.Array:
+                   top_k: int | None, top_p: float | None,
+                   mask: jax.Array | None = None) -> jax.Array:
     """Temperature-scaled, top-k/top-p-filtered fp32 logits — THE
     sampling distribution every decode flavor draws from, factored out
     of :func:`_make_pick` so the speculative verify step
@@ -1017,7 +1035,11 @@ def _filter_logits(logits: jax.Array, temperature: float,
     can keep fewer tokens than either alone, never more). Works on any
     ``(..., vocab)`` shape — the verify step filters a whole
     ``(slots, draft+1, vocab)`` block at once; requires
-    ``temperature > 0`` (greedy never builds a distribution)."""
+    ``temperature > 0`` (greedy never builds a distribution).
+    ``mask`` (optional, broadcastable boolean legality mask from the
+    structured subsystem) applies FIRST via :func:`_mask_logits`, so
+    top-k/top-p measure over the constrained candidate set."""
+    logits = _mask_logits(logits, mask)
     logits = logits.astype(jnp.float32) / temperature
     if top_k is not None or top_p is not None:
         # ONE descending sort serves both filters (this runs per

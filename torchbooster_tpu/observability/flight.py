@@ -78,6 +78,11 @@ _DTYPE = np.dtype([
     # to one under plain load unless the record says how many slots
     # were branches
     ("branches", np.int16),
+    # live slots decoding under a structured-generation automaton
+    # constraint: mask-building is host work on the hot loop, so a
+    # stall post-mortem must show how much of the batch was
+    # constrained when the step ran
+    ("structured", np.int16),
 ])
 
 # watchdog cadence/thresholds: p99 refresh interval (records), minimum
@@ -125,7 +130,8 @@ class FlightRecorder:
                queue_depth: int, tokens: int, accept_rate: float,
                wall_s: float, recompiled: bool = False,
                inflight: Iterable[str] = (), tp: int = 1,
-               branches: int = 0, pages_host: int = 0,
+               branches: int = 0, structured: int = 0,
+               pages_host: int = 0,
                spills: int = 0, promotions: int = 0,
                host_hit_pages: int = 0) -> None:
         """Write one step record in place and run the watchdog."""
@@ -149,6 +155,7 @@ class FlightRecorder:
         row["recompiled"] = recompiled
         row["tp"] = tp
         row["branches"] = branches
+        row["structured"] = structured
         self._seq = seq + 1
         if recompiled:
             self._anomalies.append({

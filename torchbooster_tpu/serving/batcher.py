@@ -77,6 +77,9 @@ from torchbooster_tpu.observability.recompile import POLICIES
 from torchbooster_tpu.observability.tracing import RequestTracer
 from torchbooster_tpu.serving.engine import PagedEngine
 from torchbooster_tpu.serving.kv_pages import PoolExhausted
+from torchbooster_tpu.serving.structured import (
+    validate_response_format,
+)
 from torchbooster_tpu.serving.frontend.scheduler import (
     FCFSPolicy,
     SchedulerPolicy,
@@ -113,7 +116,17 @@ class Request:
     batcher materializes sibling branches as internal child Requests
     (``parent``/``branch``/``branches`` fields) that ride every
     scheduling path — preemption folds and re-admits a branch alone,
-    its key keeps its stream token-exact."""
+    its key keeps its stream token-exact.
+
+    Structured generation (OpenAI ``response_format``; constraining
+    types need a ``structured=True`` engine): ``None`` or ``{"type":
+    "text"}`` is unconstrained; ``json_object``/``json_schema``/
+    ``regex`` bind a token-DFA cursor at seat time that masks every
+    sampling step to legal continuations. Constraining types REQUIRE
+    ``eos_id`` — the automaton signals "the output is complete" by
+    forcing EOS, and without a stop id the request could only ever
+    finish by length, mid-schema. Schema validation (the 400 surface)
+    happens at submit via the engine's compiler, not here."""
     prompt: np.ndarray
     max_new_tokens: int = 32
     eos_id: int | None = None
@@ -124,6 +137,9 @@ class Request:
     n: int = 1
     best_of: int | None = None
     seed: int | None = None
+    # structured generation: an OpenAI response_format object (None =
+    # unconstrained, same as {"type": "text"})
+    response_format: dict | None = None
     # stable identity for tracing and the HTTP surface: auto-generated
     # when empty; the front door honors a client X-Request-Id header
     # by passing it through here
@@ -184,6 +200,17 @@ class Request:
             raise TypeError(
                 f"seed must be an int or None, got "
                 f"{type(self.seed).__name__}")
+        if self.response_format is not None:
+            if not isinstance(self.response_format, dict):
+                raise TypeError(
+                    f"response_format must be a dict or None, got "
+                    f"{type(self.response_format).__name__}")
+            if self.response_format.get("type") != "text" \
+                    and self.eos_id is None:
+                raise ValueError(
+                    "a constraining response_format requires eos_id: "
+                    "the automaton terminates the output by forcing "
+                    "EOS at an accepting state")
         if not self.request_id:
             self.request_id = "req-" + uuid.uuid4().hex[:16]
         if self.seed is None:
@@ -253,6 +280,9 @@ class _Session:
         self.forks0 = eng.forks
         self.fork_pages0 = eng.fork_pages
         self.cow0 = eng.cow_copies
+        self.structured0 = eng.structured_requests
+        self.smasked0 = eng.structured_masked_sum
+        self.srows0 = eng.structured_masked_rows
         self.closed = False
 
     def sample(self, series: list[float], value: float) -> None:
@@ -385,6 +415,34 @@ class ContinuousBatcher:
                    "write-ahead) " if reserve > worst else "")
                 + f"but the pool holds {self._capacity}; grow "
                 f"serving.n_pages")
+        if req.response_format is not None:
+            # syntactic/schema validation FIRST: an unknown type or a
+            # malformed schema is a 400 naming the problem regardless
+            # of engine configuration
+            validate_response_format(req.response_format)
+            if req.response_format.get("type") != "text":
+                if not self.engine.structured:
+                    raise ValueError(
+                        "response_format type "
+                        f"{req.response_format['type']!r} needs a "
+                        "structured-generation engine: set "
+                        "serving.structured.enabled: true")
+                # token-level compile NOW (fingerprint-cached on the
+                # engine): vocabulary-level unsatisfiability and EOS/
+                # alphabet collisions fail at submit, before any
+                # pages move — and the seat path hits a warm cache
+                dfa = self.engine.structured_compile(
+                    req.response_format)
+                if not 0 <= req.eos_id < self.engine.cfg.vocab:
+                    raise ValueError(
+                        f"eos_id {req.eos_id} outside the vocabulary "
+                        f"(size {self.engine.cfg.vocab})")
+                if bool(dfa.mask[:, req.eos_id].any()):
+                    raise ValueError(
+                        f"eos_id {req.eos_id} renders a character "
+                        "the schema can emit — the EOS bit would "
+                        "shadow a legal content token; pick an EOS "
+                        "id outside the schema alphabet")
 
     def est_ttft_s(self, req: Request) -> float:
         """Estimated seconds from now to ``req``'s first token were it
@@ -665,6 +723,19 @@ class ContinuousBatcher:
                 "private tail pages copied at fork (the only bytes "
                 "n-way sampling duplicates)"),
         }
+        if self.engine.structured:
+            # structured generation only (absent with
+            # structured=False so the unconstrained registry view is
+            # untouched): constrained admissions and how much of the
+            # vocabulary the automaton masked — host integer adds
+            # per landing, never a device read
+            inst["structured"] = reg.counter(
+                "serving_structured_requests_total",
+                "constrained (response_format) requests admitted")
+            inst["structured_frac"] = reg.gauge(
+                "serving_structured_masked_frac",
+                "mean masked-vocabulary fraction over committed "
+                "constrained cursor rows this run")
         if self.engine.host_spill:
             # the host spill tier only (absent with host_spill=False
             # so the spill-less registry view is untouched): tier
@@ -987,7 +1058,8 @@ class ContinuousBatcher:
                 eos_id=req.eos_id, arrival=req.arrival,
                 priority=req.priority, deadline_ms=req.deadline_ms,
                 arrival_time=req.arrival_time,
-                request_id=f"{req.request_id}#{b}", seed=req.seed)
+                request_id=f"{req.request_id}#{b}", seed=req.seed,
+                response_format=req.response_format)
             child.parent = req
             child.branch = b
             child.admitted_at = req.admitted_at
@@ -1075,7 +1147,8 @@ class ContinuousBatcher:
                                      *s.live.values())]
                           if recompiled else ()),
                 tp=eng.tp,
-                branches=eng.branch_slot_count)
+                branches=eng.branch_slot_count,
+                structured=eng.structured_slot_count)
         return events
 
     def _step_body(self, s: _Session, st: dict,
@@ -1133,6 +1206,16 @@ class ContinuousBatcher:
             s.admit_order.append(slot)
             s.n_admissions += 1
             self._inst["admissions"].inc()
+            if self.engine.structured \
+                    and req.response_format is not None:
+                # bind the automaton cursor at seat time; a
+                # preemption victim's folded generated tokens
+                # (prompt past base_len) replay so the cursor
+                # resumes at the exact state it was evicted in
+                if self.engine.structured_begin(
+                        slot, req.response_format, req.eos_id,
+                        prefix_tokens=req.prompt[req.base_len:]):
+                    self._inst["structured"].inc()
             if self.tracer.enabled:
                 self.tracer.emit(
                     req.request_id, "seated", slot=slot,
@@ -1387,6 +1470,11 @@ class ContinuousBatcher:
         inst["spec_rate"].set(n_spec_acc / max(n_spec_prop, 1))
         inst["fork_pages"].inc(self.engine.fork_pages - s.fork_pages0)
         inst["cow_copies"].inc(self.engine.cow_copies - s.cow0)
+        if "structured" in inst:
+            rows = self.engine.structured_masked_rows - s.srows0
+            inst["structured_frac"].set(
+                (self.engine.structured_masked_sum - s.smasked0)
+                / max(rows, 1))
         if "spills" in inst:
             inst["spills"].inc(self.engine.spills - s.spills0)
             inst["promotions"].inc(
@@ -1481,6 +1569,15 @@ class ContinuousBatcher:
             "n_forks": self.engine.forks - s.forks0,
             "fork_pages": self.engine.fork_pages - s.fork_pages0,
             "n_cow_copies": self.engine.cow_copies - s.cow0,
+            # structured generation (all zero on an unconstrained
+            # engine): constrained cursor bindings and the mean
+            # masked-vocabulary fraction over their committed rows
+            "n_structured":
+                self.engine.structured_requests - s.structured0,
+            "structured_masked_frac": round(
+                (self.engine.structured_masked_sum - s.smasked0)
+                / max(self.engine.structured_masked_rows - s.srows0,
+                      1), 4),
             # SLO scheduler stats — stable keys on EVERY return path
             # (the established contract): zero/empty under FCFS,
             # populated per configured class under an SLO policy
@@ -1510,6 +1607,7 @@ class ContinuousBatcher:
                     "n_spec_accepted": 0, "spec_accept_rate": 0.0,
                     "spec_mean_accepted": 0.0,
                     "n_forks": 0, "fork_pages": 0, "n_cow_copies": 0,
+                    "n_structured": 0, "structured_masked_frac": 0.0,
                     "n_shed": 0, "n_cancelled": 0,
                     "deadline_hit_rate": 1.0, "classes": {
                         name: {"n_requests": 0, "n_completed": 0,

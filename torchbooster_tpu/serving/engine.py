@@ -71,6 +71,7 @@ from torchbooster_tpu.models.gpt import (
     _lm_head,
     _make_branch_pick,
     _make_pick,
+    _mask_logits,
     _quantize_kv,
     qkv_to_tp_major,
 )
@@ -95,6 +96,11 @@ from torchbooster_tpu.serving.speculative import (
     make_verify_fn,
     tree_accept_path,
     tree_masks,
+)
+from torchbooster_tpu.serving.structured import (
+    SlotCursors,
+    bytes_vocab,
+    compile_response_format,
 )
 
 
@@ -227,7 +233,9 @@ class PagedEngine:
                  spec_tree: bool = False,
                  tree_width: int = 2,
                  host_spill: bool = False,
-                 host_spill_mb: float = 64.0):
+                 host_spill_mb: float = 64.0,
+                 structured: bool = False,
+                 structured_vocab: Any = None):
         if cfg.seq_len % page_size:
             # a last partial page per slot would shift page_pos math;
             # geometry is static, so fail loudly at construction
@@ -276,6 +284,11 @@ class PagedEngine:
                 "tier demotes REGISTERED prefix pages at eviction — "
                 "without the prefix index there is nothing to demote "
                 "or promote")
+        if structured_vocab is not None and not structured:
+            raise ValueError(
+                "structured_vocab without structured=True does "
+                "nothing: the token-DFA compiler only runs on a "
+                "structured engine")
         if host_spill and tp > 1:
             raise ValueError(
                 f"host_spill with tp={tp} is not supported yet: the "
@@ -409,6 +422,37 @@ class PagedEngine:
         # fork/retire)
         self._fork_state: dict[int, dict] = {}
         self.step_logprobs: np.ndarray | None = None
+        # structured generation (serving/structured/): per-slot
+        # automaton cursors fused into ONE fixed-shape (max_slots,
+        # vocab) legality mask that rides the decode/verify steps as
+        # a trailing VALUE operand — schema churn changes mask BITS,
+        # never shapes, so the zero-recompile contract holds; off
+        # (the default) no mask operand crosses the jit boundary and
+        # every call signature is byte-identical to the pre-feature
+        # engine (the same collapse contract as the slot-key table)
+        self.structured = bool(structured)
+        self._cursors = None
+        self._svocab = None
+        self._sdfa_cache: dict[str, Any] = {}
+        self._smask_verify: np.ndarray | None = None
+        self.structured_requests = 0
+        if self.structured:
+            vocab = (list(structured_vocab)
+                     if structured_vocab is not None
+                     else bytes_vocab(cfg.vocab))
+            if len(vocab) != cfg.vocab:
+                raise ValueError(
+                    f"structured_vocab has {len(vocab)} entries but "
+                    f"the model's vocabulary is {cfg.vocab} — the "
+                    "token-DFA mask must cover every logit")
+            self._svocab = vocab
+            self._cursors = SlotCursors(max_slots, cfg.vocab)
+            if speculative:
+                # persistent verify-mask buffer: (max_slots,
+                # 1 + draft_len, vocab), reset to all-True each
+                # spec step and filled per constrained slot
+                self._smask_verify = np.ones(
+                    (max_slots, 1 + draft_len, cfg.vocab), bool)
         # the pool crosses the jit boundary EVERY call — donate it so
         # XLA updates the pages in place; an undonated pool would copy
         # pool-sized bytes per step, re-taxing exactly the HBM traffic
@@ -423,16 +467,19 @@ class PagedEngine:
         # (per-slot logprobs); the chunk returns (token, logprob,
         # final logits) instead of just the token
         n_par = 1 if self.parallel else 0
+        # structured mode threads one replicated legality-mask operand
+        # into the chunk, decode, and verify signatures
+        n_struct = 1 if self.structured else 0
         self._branch_pick = _make_branch_pick(
             temperature, top_k, top_p, jnp.int32)
         if self.tp > 1:
             pspecs = _tp_param_specs(self.params)
             self._chunk_jit = _shard_engine_fn(
-                self._chunk_fn, mesh, pspecs, 5,
+                self._chunk_fn, mesh, pspecs, 5 + n_struct,
                 3 if self.parallel else 1)
             self._decode_jit = _shard_engine_fn(
                 self._decode_fn, mesh, pspecs,
-                7 + n_extra + n_par, 1 + n_par)
+                7 + n_extra + n_struct + n_par, 1 + n_par)
         else:
             self._chunk_jit = jax.jit(self._chunk_fn,
                                       donate_argnums=(1, 2))
@@ -490,7 +537,8 @@ class PagedEngine:
             n_tree = 3 if self.spec_tree else 0
             if self.tp > 1:
                 self._verify_jit = _shard_engine_fn(
-                    verify_fn, mesh, pspecs, 7 + n_tree + n_extra, 2)
+                    verify_fn, mesh, pspecs,
+                    7 + n_tree + n_extra + n_struct, 2)
             else:
                 self._verify_jit = jax.jit(verify_fn,
                                            donate_argnums=(1, 2))
@@ -524,7 +572,7 @@ class PagedEngine:
 
     # ---- compiled pieces -----------------------------------------
     def _chunk_fn(self, params, pool_k, pool_v, ids, start, s0,
-                  table_row, rng):
+                  table_row, rng, *extra):
         """ONE prefill chunk: forward ``ids`` (1, chunk_tokens) at
         absolute positions ``start + [0, C)``, writing each layer's
         K/V into the slot's pages and attending prior context through
@@ -638,11 +686,19 @@ class PagedEngine:
         last = jax.lax.dynamic_slice_in_dim(
             x, jnp.clip(s0 - 1 - start, 0, C - 1), 1, axis=1)
         logits = _lm_head(params, last)[:, 0]
+        # structured mode: the trailing operand is the seating slot's
+        # (1, vocab) legality row (all-True when unconstrained — a
+        # bitwise no-op, so unconstrained traffic stays token-exact).
+        # The STASHED logits below stay unmasked: fork() masks them
+        # itself with the START-state row so every branch's first
+        # pick replays the independent-run distribution.
+        picked = _mask_logits(logits, extra[0]) if self.structured \
+            else logits
         if self.parallel:
             key = jax.random.fold_in(rng, s0)
-            tok, lp = self._branch_pick(key[None], logits)
+            tok, lp = self._branch_pick(key[None], picked)
             return tok, lp, logits, pool_k, pool_v
-        return self._pick(rng, logits), pool_k, pool_v
+        return self._pick(rng, picked), pool_k, pool_v
 
     def _decode_fn(self, params, pool_k, pool_v, tables, lengths,
                    refs, page_pos, active, last_ids, rng, *extra):
@@ -653,9 +709,13 @@ class PagedEngine:
         live-page walk from ``kernel_args()``), the slot-key table in
         parallel-sampling mode — so the default engine's jitted call
         signature is byte-identical to the pre-feature one."""
-        work_pages = work_refs = work_pos = slot_keys = None
+        work_pages = work_refs = work_pos = slot_keys = smask = None
         if self.decode_backend == "pallas":
             work_pages, work_refs, work_pos = extra[:3]
+            extra = extra[3:]
+        if self.structured:
+            smask = extra[0]            # (max_slots, vocab) legality
+            extra = extra[1:]
         if self.parallel:
             slot_keys = extra[-1]
         cfg, ps = self.cfg, self.page_size
@@ -779,6 +839,10 @@ class PagedEngine:
         x, (pool_k, pool_v) = jax.lax.scan(
             layer, x, (params["blocks"], pool_k, pool_v))
         logits = _lm_head(params, x)[:, 0]
+        # constrained slots' rows knock illegal tokens to finfo.min;
+        # unconstrained rows are all-True (bitwise no-op — greedy and
+        # seeded sampling stay token-identical with the feature on)
+        logits = _mask_logits(logits, smask)
         if self.parallel:
             # per-branch keys: fold each slot's branch key with its
             # context length (lengths + 1 — the pending token counts),
@@ -1115,6 +1179,13 @@ class PagedEngine:
         C = self.chunk_tokens
         ids = jnp.asarray(p["ids"][p["start"]:p["start"] + C])[None]
         table_row = jnp.asarray(self.tables.tables[p["slot"]])
+        sextra = ()
+        if self.structured:
+            # the seating slot's legality row masks the first-token
+            # pick in-chunk (all-True when the request is
+            # unconstrained — exact no-op)
+            sextra = (jnp.asarray(
+                self._cursors.mask[p["slot"]][None]),)
         # span: host wall time in the event log + the same label on a
         # captured device trace (observability/spans.py); no-op when
         # telemetry is disabled
@@ -1122,7 +1193,8 @@ class PagedEngine:
             outs = self._chunk_jit(
                 self.params, self.pool["k"], self.pool["v"], ids,
                 jnp.asarray(p["start"], jnp.int32),
-                jnp.asarray(p["s0"], jnp.int32), table_row, sub)
+                jnp.asarray(p["s0"], jnp.int32), table_row, sub,
+                *sextra)
         if self.parallel:
             tok, lp, logits, pool_k, pool_v = outs
         else:
@@ -1151,6 +1223,12 @@ class PagedEngine:
         self.tables.register_prefix(p["slot"], p["ids"][:p["s0"]])
         if self._drafter is not None:
             self._drafter.observe(p["slot"], [first])
+        if self.structured:
+            # same hook site as the drafter: the cursor advances on
+            # the accepted first token (fork() REBASES children, so
+            # a parent about to fork is already correct — branch 0's
+            # stream keeps this very token)
+            self._cursors.observe(p["slot"], [first])
         return p["slot"], first
 
     def admit(self, prompt_ids: np.ndarray, seed: int | None = None,
@@ -1167,6 +1245,64 @@ class PagedEngine:
             done = self.prefill_step()
             if done is not None and done[0] == slot:
                 return done
+
+    # ---- structured generation -----------------------------------
+    def structured_compile(self, spec: dict):
+        """``response_format`` spec -> token-level DFA over THIS
+        engine's vocabulary (None for ``{"type": "text"}``), through
+        the per-engine fingerprint cache — a mixed-schema trace
+        compiles each distinct schema exactly once, and the batcher
+        calls this at SUBMIT time so malformed specs reject before
+        queueing and seat-time binding is a dict hit. Raises
+        ``ValueError`` on a bad spec or a schema unsatisfiable under
+        the vocabulary."""
+        if not self.structured:
+            raise RuntimeError(
+                "structured_compile() needs "
+                "PagedEngine(structured=True)")
+        return compile_response_format(spec, self._svocab,
+                                       cache=self._sdfa_cache)
+
+    def structured_begin(self, slot: int, spec: dict, eos_id: int,
+                         prefix_tokens=()) -> bool:
+        """Bind a seated slot's automaton cursor (the batcher calls
+        this right after ``admit_begin`` succeeds, BEFORE the slot's
+        prefill chunks run, so the first-token pick is already
+        masked). ``prefix_tokens`` are a preempted request's folded
+        generated tokens — replaying them resumes the automaton
+        token-exactly. Returns whether the spec actually constrains
+        (``{"type": "text"}`` does not)."""
+        if not self.structured:
+            raise RuntimeError(
+                "structured_begin() needs "
+                "PagedEngine(structured=True)")
+        dfa = self.structured_compile(spec)
+        if dfa is None:
+            return False
+        self._cursors.begin(slot, dfa, eos_id,
+                            prefix_tokens=prefix_tokens)
+        self.structured_requests += 1
+        return True
+
+    @property
+    def structured_slot_count(self) -> int:
+        """Seated slots currently under an automaton constraint —
+        host integers only (the ``/debug/engine`` and
+        flight-recorder structured observable)."""
+        return (self._cursors.live_count
+                if self._cursors is not None else 0)
+
+    @property
+    def structured_masked_sum(self) -> float:
+        """Cumulative masked-vocabulary fraction over committed
+        cursor rows (numerator of the masked_frac gauge)."""
+        return (self._cursors.masked_sum
+                if self._cursors is not None else 0.0)
+
+    @property
+    def structured_masked_rows(self) -> int:
+        return (self._cursors.masked_rows
+                if self._cursors is not None else 0)
 
     def fork(self, parent_slot: int, n_branches: int
              ) -> list[tuple[int, int, float]]:
@@ -1238,6 +1374,17 @@ class PagedEngine:
         base = self._base_keys[parent_slot]
         s0 = st["s0"]
         logits = jnp.asarray(st["logits"])[None]
+        constrained = self.structured \
+            and self._cursors.active(parent_slot)
+        if constrained:
+            # the stash is UNMASKED prompt-final logits; mask with
+            # the automaton START-state row (every branch's first
+            # token re-derives from the start — the cursor rebases
+            # below), exactly what an independent constrained run's
+            # prefill chunk applies
+            logits = _mask_logits(
+                logits,
+                jnp.asarray(self._cursors.start_row(parent_slot)))
         out = [(parent_slot, int(self.tables.last_ids[parent_slot]),
                 st["logprob"])]
         for b, child in enumerate(children, start=1):
@@ -1249,6 +1396,13 @@ class PagedEngine:
             tok, lp = self._branch_pick(pick_key[None], logits)
             tok = int(np.asarray(tok)[0])
             self.tables.activate(child, tok)
+            if constrained:
+                # automaton state forks WITH the CoW pages: the
+                # child rebases to start and observes its own first
+                # token — token-exact vs an independent run with
+                # (seed, branch=b)
+                self._cursors.fork_child(parent_slot, child)
+                self._cursors.observe(child, [tok])
             out.append((child, tok, float(np.asarray(lp)[0])))
         return out
 
@@ -1301,6 +1455,10 @@ class PagedEngine:
         self._rng, sub = jax.random.split(self._rng)
         args = self.tables.device_args()
         extra = self._kernel_operands()
+        if self.structured:
+            # the fused legality mask rides as a VALUE operand —
+            # schema churn flips bits, never shapes
+            extra = extra + (jnp.asarray(self._cursors.mask),)
         if self.parallel:
             extra = extra + (jnp.asarray(self._slot_keys),)
         with span("decode_step"):
@@ -1324,6 +1482,8 @@ class PagedEngine:
             self.tables.advance(int(slot), int(tokens[slot]))
             if self._drafter is not None:
                 self._drafter.observe(int(slot), [int(tokens[slot])])
+            if self.structured:
+                self._cursors.observe(int(slot), [int(tokens[slot])])
         return tokens
 
     def spec_step(self) -> dict[int, list[int]]:
@@ -1359,6 +1519,10 @@ class PagedEngine:
         # the PR-5 chain through the same operands
         parents = np.tile(np.arange(k, dtype=np.int32),
                           (self.max_slots, 1))
+        vmask = None
+        if self.structured:
+            vmask = self._smask_verify
+            vmask[:] = True
         for slot in np.flatnonzero(active):
             slot = int(slot)
             if self.spec_tree:
@@ -1373,11 +1537,27 @@ class PagedEngine:
             room = int(self.cfg.seq_len - self.tables.lengths[slot]) - 1
             if room < k:
                 d[max(room, 0):] = -1
+            if self.structured and self._cursors.active(slot):
+                # draft pre-validation against the automaton: a chain
+                # truncates at its first illegal token, a tree prunes
+                # the illegal node and (transitively) its subtree —
+                # all to the -1 never-accept sentinel, so verify
+                # cannot spend an acceptance on an illegal branch;
+                # the per-position legality rows mask verify's
+                # fallback/bonus picks
+                if self.spec_tree:
+                    d, rows = self._cursors.tree_rows(
+                        slot, d, parents[slot])
+                else:
+                    d, rows = self._cursors.draft_rows(slot, d)
+                vmask[slot] = rows
             drafts[slot] = d
             self.spec_proposed += int((d >= 0).sum())
         self._rng, sub = jax.random.split(self._rng)
         args = self.tables.device_args()
         extra = self._kernel_operands()
+        if self.structured:
+            extra = extra + (jnp.asarray(vmask),)
         if self.spec_tree:
             depth, tvis = tree_masks(parents)
             extra = (jnp.asarray(parents), jnp.asarray(depth),
@@ -1437,6 +1617,10 @@ class PagedEngine:
             for t in emitted:
                 self.tables.advance(slot, t)
             self._drafter.observe(slot, emitted)
+            if self.structured:
+                # the cursor stops at EOS itself; tokens past it in
+                # the burst are the same tail the batcher drops
+                self._cursors.observe(slot, emitted)
         return out
 
     def retire(self, slot: int) -> None:
@@ -1455,6 +1639,8 @@ class PagedEngine:
                          if p["slot"] != slot]
         if self._drafter is not None:
             self._drafter.reset(slot)
+        if self.structured:
+            self._cursors.reset(slot)
         self._fork_state.pop(slot, None)
         if self.parallel:
             self._base_keys[slot] = 0
@@ -1505,6 +1691,10 @@ class PagedEngine:
             "fork_pages": self.fork_pages,
             "cow_copies": self.cow_copies,
             "branch_slots": self.branch_slot_count,
+            "structured": self.structured,
+            "structured_requests": self.structured_requests,
+            "structured_slots": self.structured_slot_count,
+            "structured_schemas": len(self._sdfa_cache),
             "compiles": {"decode": self.decode_compiles,
                          "prefill": self.prefill_compiles,
                          "verify": self.verify_compiles,
@@ -1539,6 +1729,8 @@ class PagedEngine:
         never on the decode hot path."""
         args = self.tables.device_args()
         extra = self._kernel_operands()
+        if self.structured:
+            extra = extra + (jnp.asarray(self._cursors.mask),)
         if self.parallel:
             extra = extra + (jnp.asarray(self._slot_keys),)
         lowered = self._decode_jit.lower(

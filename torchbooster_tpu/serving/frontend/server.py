@@ -17,7 +17,12 @@ asyncio HTTP server exposing
   copy-on-write branches, streamed chunks carry their branch's
   ``index``, ``best_of > n`` returns the n best by sequence logprob
   (unary only — the OpenAI rule), and ``usage`` aggregates every
-  decoded branch over the ONE prompt prefill;
+  decoded branch over the ONE prompt prefill; ``response_format``
+  structured generation on a ``serving.structured.enabled: true``
+  engine — ``json_object`` | ``json_schema`` | ``regex`` compile to
+  a token-DFA that masks every sampling step (malformed or
+  unsupported schemas, unknown types, and missing ``eos_id`` all
+  answer 400 naming the problem before any pages move);
 - ``GET /metrics`` — the telemetry registry's Prometheus exposition
   (the ``serving_*``/``serving_slo_*`` series, scrape-ready);
 - ``GET /healthz`` — liveness + pool occupancy; ``?full=1`` upgrades
@@ -619,6 +624,10 @@ class ServingFrontend:
                 n=payload.get("n", 1),
                 best_of=best_of,
                 seed=seed,
+                # validated by the Request (shape, eos requirement)
+                # and again at submit (schema compile) — both map to
+                # a 400 naming the offending value here
+                response_format=payload.get("response_format"),
             )
         except (TypeError, ValueError) as exc:
             raise HttpError(400, str(exc)) from None

@@ -149,7 +149,8 @@ def replay_inprocess(batcher, workload: Workload,
                     eos_id=rec.eos_id, priority=rec.priority,
                     deadline_ms=rec.deadline_ms,
                     request_id=rec.request_id,
-                    n=rec.n, best_of=rec.best_of)
+                    n=rec.n, best_of=rec.best_of,
+                    response_format=rec.response_format)
             for rec in workload.requests]
     arrivals = [rec.arrival_s / speed for rec in workload.requests]
     cancels = [(req, rec.cancel_after_tokens)
@@ -283,6 +284,8 @@ async def replay_http(port: int, workload: Workload,
                 # forbids streaming a best_of > n ranking)
                 payload["n"] = payload["best_of"] = (
                     rec.best_of if rec.best_of is not None else rec.n)
+            if rec.response_format is not None:
+                payload["response_format"] = rec.response_format
             body = json.dumps(payload).encode()
             writer.write(
                 b"POST /v1/completions HTTP/1.1\r\nHost: loadgen\r\n"

@@ -12,13 +12,16 @@ stress — and let synthetic generators emit the SAME format so one
 driver (loadgen/replay.py) serves both.
 
 One JSONL file per workload: a header line
-(``{"event": "workload_header", "version": 2, ...}``) then one
+(``{"event": "workload_header", "version": 3, ...}``) then one
 ``workload_request`` line per request — arrival offset (seconds from
 trace start), prompt token ids OR a ``seed``+``length`` recipe
 (privacy-scrubbed captures never persist prompt content), priority
 class, ``deadline_ms``, ``max_new_tokens``, ``eos_id``, optional
 parallel-sampling ``n``/``best_of`` (v2; absent fields mean ``n=1``
-and v1 files still load), and the client-behavior events:
+and v1 files still load), an optional structured-generation
+``response_format`` (v3; absent means unconstrained, and the
+fingerprint folds it in only when set so v1/v2 recorded fingerprints
+keep verifying), and the client-behavior events:
 ``cancel_after_tokens`` (the client disconnected after consuming N
 tokens — replay re-issues the disconnect at the same token offset)
 and ``disconnect_s`` (the recorded wall offset, informational).
@@ -67,14 +70,24 @@ from pathlib import Path
 
 import numpy as np
 
+from torchbooster_tpu.serving.structured.compiler import (
+    SCHEMA_LIBRARY,
+    library_response_format,
+    schema_budget,
+)
+
 __all__ = ["Workload", "WorkloadCapture", "WorkloadRequest",
            "SYNTHETIC_KINDS", "synthesize"]
 
 # v2 (PR 13): optional per-request ``n``/``best_of`` parallel-sampling
 # fields — v1 files still load (absent fields mean n = 1), new saves
-# stamp v2 and the content fingerprint covers the new fields
-FORMAT_VERSION = 2
-SUPPORTED_VERSIONS = (1, 2)
+# stamp v2 and the content fingerprint covers the new fields.
+# v3 (PR 18): optional per-request ``response_format`` (structured
+# generation) — absent means unconstrained, v1/v2 files still load,
+# and the fingerprint folds the spec in ONLY when set, so plain
+# traffic keeps verifying against its recorded v1/v2 fingerprints.
+FORMAT_VERSION = 3
+SUPPORTED_VERSIONS = (1, 2, 3)
 
 SYNTHETIC_KINDS = ("poisson", "bursty", "diurnal", "sharegpt")
 
@@ -104,6 +117,9 @@ class WorkloadRequest:
     # returned, best_of (None = n) branches decoded and ranked
     n: int = 1
     best_of: int | None = None
+    # structured generation (OpenAI response_format; needs a
+    # serving.structured engine on replay): None = unconstrained
+    response_format: dict | None = None
 
     def __post_init__(self):
         if self.prompt is not None:
@@ -141,6 +157,18 @@ class WorkloadRequest:
             raise ValueError(
                 f"best_of must be an int >= n ({self.n}), got "
                 f"{self.best_of!r}")
+        if self.response_format is not None:
+            if not isinstance(self.response_format, dict) \
+                    or not isinstance(
+                        self.response_format.get("type"), str):
+                raise ValueError(
+                    "response_format must be an object with a "
+                    f"string 'type', got {self.response_format!r}")
+            if self.response_format["type"] != "text" \
+                    and self.eos_id is None:
+                raise ValueError(
+                    "a constraining response_format requires eos_id "
+                    "(the automaton terminates by forcing EOS)")
 
     def prompt_ids(self, vocab: int) -> np.ndarray:
         """The prompt to serve: recorded ids, or the scrub recipe's
@@ -167,6 +195,13 @@ class WorkloadRequest:
             # must keep verifying) while any n/best_of fan-out is
             # provably covered by the hash
             key.append([int(self.n), self.best_of])
+        if self.response_format is not None:
+            # same only-when-set discipline as n/best_of (v1/v2
+            # fingerprints keep verifying); canonical JSON so key
+            # order in the spec dict cannot change the hash
+            key.append(["response_format", json.dumps(
+                self.response_format, sort_keys=True,
+                separators=(",", ":"))])
         return key
 
     def to_json(self) -> dict:
@@ -187,6 +222,7 @@ class WorkloadRequest:
                              if self.disconnect_s is not None else None),
             "n": int(self.n),
             "best_of": self.best_of,
+            "response_format": self.response_format,
         }
 
     @classmethod
@@ -205,9 +241,11 @@ class WorkloadRequest:
             cancel_after_tokens=d.get("cancel_after_tokens"),
             disconnect_s=d.get("disconnect_s"),
             # v1 files carry neither field: n = 1 (the loader's
-            # __post_init__ rejects malformed values loudly)
+            # __post_init__ rejects malformed values loudly); v1/v2
+            # files carry no response_format: unconstrained
             n=d.get("n", 1),
-            best_of=d.get("best_of"))
+            best_of=d.get("best_of"),
+            response_format=d.get("response_format"))
 
 
 @dataclass
@@ -416,7 +454,8 @@ class WorkloadCapture:
                 disconnect_s=(max(r.finished_at - t0, 0.0)
                               if r.cancelled
                               and r.finished_at is not None else None),
-                n=r.n, best_of=r.best_of))
+                n=r.n, best_of=r.best_of,
+                response_format=r.response_format))
         return Workload(
             requests=out, kind="capture", vocab=vocab or max_id,
             meta={"captured_at": round(self._captured_at, 3),
@@ -461,7 +500,8 @@ def synthesize(kind: str = "poisson", *, n_requests: int = 32,
                cancel_frac: float = 0.0, burst_on_s: float = 1.0,
                burst_off_s: float = 2.0, burst_mult: float = 4.0,
                period_s: float = 60.0, n_frac: float = 0.0,
-               n_max: int = 4, tenants: int = 0,
+               n_max: int = 4, structured_frac: float = 0.0,
+               tenants: int = 0,
                prefix_pages: int = 0,
                page_size: int = 64) -> Workload:
     """Synthetic workloads in the capture format, deterministic from
@@ -479,6 +519,17 @@ def synthesize(kind: str = "poisson", *, n_requests: int = 32,
     token offset; ``n_frac`` of requests carry parallel-sampling
     fan-out (``n = best_of`` drawn uniformly in ``[2, n_max]`` —
     replay them against a ``parallel_sampling: true`` engine).
+
+    ``structured_frac`` of requests carry an OpenAI
+    ``response_format`` drawn from the built-in schema library
+    (``structured.SCHEMA_LIBRARY`` — all bounded, byte-level
+    schemas), with ``eos_id = vocab - 1`` (outside every library
+    schema's ASCII alphabet; needs ``vocab > 128``) and their output
+    budget raised to the schema's worst-case completion length so
+    constrained requests can finish with ``stop`` — replay them
+    against a ``serving.structured.enabled: true`` engine. The draws
+    come from their own seed-derived stream, so ``structured_frac:
+    0`` traffic is byte-identical to pre-v3 workloads.
 
     ``tenants > 0`` (with ``prefix_pages >= 1``) models the
     many-tenant shared-system-prompt shape the spill tier (PR 16)
@@ -510,6 +561,15 @@ def synthesize(kind: str = "poisson", *, n_requests: int = 32,
         raise ValueError(
             f"n_max must be >= 2 (n_frac requests fan out), got "
             f"{n_max}")
+    if not 0.0 <= structured_frac <= 1.0:
+        raise ValueError(
+            f"structured_frac must be in [0, 1], got "
+            f"{structured_frac}")
+    if structured_frac > 0 and vocab <= 128:
+        raise ValueError(
+            f"structured_frac > 0 needs vocab > 128 (got {vocab}): "
+            "structured requests stop on eos_id = vocab - 1, which "
+            "must sit outside the library schemas' ASCII alphabet")
     if tenants < 0 or prefix_pages < 0:
         raise ValueError(
             f"tenants/prefix_pages must be >= 0, got "
@@ -574,6 +634,13 @@ def synthesize(kind: str = "poisson", *, n_requests: int = 32,
     rs_fan = np.random.RandomState((seed ^ 0x5EED5EED) & 0xFFFFFFFF)
     fanout = rs_fan.random_sample(n_requests) < n_frac
     fan_n = rs_fan.randint(2, n_max + 1, n_requests)
+    # structured draws from their OWN stream too: structured_frac=0
+    # traffic must stay byte-identical to pre-v3 workloads for a
+    # given seed
+    lib_ids = sorted(SCHEMA_LIBRARY)
+    rs_sch = np.random.RandomState((seed ^ 0x5C4E3A01) & 0xFFFFFFFF)
+    struct_on = rs_sch.random_sample(n_requests) < structured_frac
+    sch_pick = rs_sch.randint(0, len(lib_ids), n_requests)
     # tenant prefixes likewise draw from their OWN stream (same
     # reasoning as the fan-out draws: tenants=0 traffic must stay
     # byte-identical to pre-knob workloads for a given seed)
@@ -598,14 +665,25 @@ def synthesize(kind: str = "poisson", *, n_requests: int = 32,
         if tenants > 0:
             prompt = np.concatenate(
                 [tenant_prefixes[int(tenant_idx[i])], prompt])
+        rf_i, eos_i = None, None
+        if struct_on[i]:
+            sid = lib_ids[int(sch_pick[i])]
+            rf_i = library_response_format(sid)
+            eos_i = vocab - 1
+            # the output budget must cover the schema's worst-case
+            # completion (+ EOS) or a constrained request could only
+            # ever finish by length, mid-schema
+            out_budget = max(out_budget, schema_budget(sid))
         requests.append(WorkloadRequest(
             arrival_s=float(arrivals[i]),
             max_new_tokens=out_budget,
             prompt=prompt,
+            eos_id=eos_i,
             priority=names[int(cls_idx[i])],
             request_id=f"w{seed}-{i:05d}",
             cancel_after_tokens=cancel,
-            n=n_i))
+            n=n_i,
+            response_format=rf_i))
     meta = {"seed": int(seed), "rate": float(rate)}
     if tenants > 0:
         meta["tenants"] = int(tenants)

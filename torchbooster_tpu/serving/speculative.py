@@ -64,6 +64,7 @@ from torchbooster_tpu.models.gpt import (
     _grouped_cache_attention,
     _lm_head,
     _make_spec_pick,
+    _mask_logits,
     _quantize_kv,
 )
 from torchbooster_tpu.ops.paged_attention import paged_attention
@@ -302,7 +303,9 @@ def make_verify_fn(engine):
     ``fn(params, pool_k, pool_v, tables, lengths, refs, page_pos,
     active, in_ids, rng) -> (accept, token, pool_k, pool_v)`` (the
     pallas backend appends the ``work_*`` live-page-walk operands —
-    see ``PagedEngine._kernel_operands``) where
+    see ``PagedEngine._kernel_operands`` — and a structured engine
+    appends the per-position legality mask LAST; tree operands, when
+    present, ride at the front of ``extra``) where
     ``in_ids`` is ``(max_slots, 1 + draft_len)``: column 0 each slot's
     pending token, columns 1.. the draft (``NO_DRAFT``-padded). Shapes
     depend ONLY on pool geometry, the model config, and the
@@ -356,12 +359,18 @@ def make_verify_fn(engine):
         # ever escaped its mode guard must fail as a loud None error,
         # not a NameError-at-trace trap for the next refactor
         t_parent = t_depth = t_vis = None
-        work_pages = work_refs = work_pos = None
+        work_pages = work_refs = work_pos = smask = None
         if tree:
             t_parent, t_depth, t_vis = extra[:3]
             extra = extra[3:]
         if engine.decode_backend == "pallas":
-            work_pages, work_refs, work_pos = extra
+            work_pages, work_refs, work_pos = extra[:3]
+            extra = extra[3:]
+        if engine.structured:
+            # (max_slots, S, vocab) per-position legality rows from
+            # the slot cursors' draft pre-validation (all-True for
+            # unconstrained slots — bitwise no-op)
+            smask = extra[0]
         n_slots = in_ids.shape[0]
         mp = tables.shape[1]
         # STORAGE positions (write targets): node j owns row
@@ -517,6 +526,11 @@ def make_verify_fn(engine):
         x, (pool_k, pool_v) = jax.lax.scan(
             layer, x, (params["blocks"], pool_k, pool_v))
         logits = _lm_head(params, x)            # (n_slots, S, vocab)
+        # structured: mask every position's logits with its automaton
+        # row BEFORE the pick/accept rule, so fallback and bonus
+        # picks are legal by construction (drafts were pre-validated
+        # host-side; the -1 sentinel never accepts)
+        logits = _mask_logits(logits, smask)
         accept, token = spec_pick(rng, logits, in_ids[:, 1:],
                                   parent=t_parent if tree else None)
         return accept, token, pool_k, pool_v
