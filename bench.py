@@ -2800,6 +2800,263 @@ def bench_serve_structured() -> dict:
     return out
 
 
+def bench_serve_wq() -> dict:
+    """Quantized-weight serving A/B (the PR-19 tentpole, weight half):
+    the SAME greedy trace decoded through a bf16 dense-weight control
+    engine and a quantized one (``BENCH_WQ_DTYPE``: ``int8``
+    per-output-channel absmax, or ``int4`` packed with per-group
+    scales over ``BENCH_WQ_GROUP`` input rows), on identical paged
+    geometry — the dequant happens inside the matmul read of the same
+    compiled steps, dispatched off the params-tree structure.
+
+    Gates: the int8 arm must be BITWISE token-identical to the
+    control (per-channel absmax error must not flip a decisive greedy
+    argmax); int4's grouped error is bounded-but-real, so its parity
+    is REPORTED (match fraction), not gated. Both arms must show
+    exactly ONE decode compile (dequant rides the existing step — no
+    new specialization), and the MODELED weight-stream ratio — bf16
+    bytes/step over quantized bytes/step via ``weight_stream_bytes``
+    — must clear ``BENCH_WQ_MIN_RATIO`` (default 1.9; needs
+    ``BENCH_WQ_DMODEL`` >= 128 — at tiny widths the fp32 scale
+    vector eats the win). Measured tokens/s run
+    best-of-``BENCH_WQ_REPEATS`` and ride along unmatched: on CPU
+    the matmuls are compute-bound, so the modeled bytes are the
+    claim and the measured columns are the evidence trail run_ab
+    carries to an HBM-bound chip.
+    """
+    from torchbooster_tpu.models.gpt import GPT, GPTConfig
+    from torchbooster_tpu.models.quant import (quantize_params,
+                                               weight_stream_bytes)
+    from torchbooster_tpu.serving import (ContinuousBatcher,
+                                          PagedEngine, Request)
+
+    dtype = os.environ.get("BENCH_WQ_DTYPE", "int8")
+    if dtype not in ("int8", "int4"):
+        raise ValueError(
+            f"BENCH_WQ_DTYPE must be int8 or int4, got {dtype!r}")
+    n_req = int(os.environ.get("BENCH_WQ_REQUESTS", 8))
+    slots = int(os.environ.get("BENCH_WQ_SLOTS", 8))
+    page = int(os.environ.get("BENCH_WQ_PAGE", 32))
+    n_pages = int(os.environ.get("BENCH_WQ_PAGES", 64))
+    seq = int(os.environ.get("BENCH_WQ_SEQ", 512))
+    d_model = int(os.environ.get("BENCH_WQ_DMODEL", 128))
+    n_layers = int(os.environ.get("BENCH_WQ_LAYERS", 4))
+    vocab = int(os.environ.get("BENCH_WQ_VOCAB", 512))
+    group = int(os.environ.get("BENCH_WQ_GROUP", 64))
+    repeats = int(os.environ.get("BENCH_WQ_REPEATS", 3))
+    min_ratio = float(os.environ.get("BENCH_WQ_MIN_RATIO", 1.9))
+
+    cfg = GPTConfig(vocab=vocab, n_layers=n_layers, d_model=d_model,
+                    n_heads=4, n_kv_heads=2, seq_len=seq)
+    params = GPT.init(jax.random.PRNGKey(0), cfg)
+    # scale the embedding so greedy argmax is decisive — int8 parity
+    # must survive quantization noise, not numerical ties
+    params = {**params,
+              "wte": {"table": params["wte"]["table"] * 4.0}}
+    bf16 = jax.tree.map(
+        lambda x: x.astype(jnp.bfloat16)
+        if jnp.issubdtype(x.dtype, jnp.floating) else x, params)
+    qparams = quantize_params(bf16, dtype=dtype, group_size=group)
+
+    rs = np.random.RandomState(0)
+    prompts = [rs.randint(0, vocab, 2 * page, dtype=np.int32)
+               for _ in range(n_req)]
+    out_lens = rs.randint(16, 33, n_req)
+
+    def trace():
+        return [Request(prompt=p, max_new_tokens=int(o))
+                for p, o in zip(prompts, out_lens)]
+
+    out: dict = {"serve_wq_dtype": dtype, "serve_wq_d_model": d_model,
+                 "serve_wq_requests": n_req,
+                 "serve_wq_group_size": group}
+    tokens_by_arm: dict[str, list] = {}
+    for arm, tree in (("bf16", bf16), ("quant", qparams)):
+        engine = PagedEngine(tree, cfg, page_size=page,
+                             n_pages=n_pages, max_slots=slots)
+        batcher = ContinuousBatcher(engine)
+        batcher.run([Request(prompt=prompts[0][:page],
+                             max_new_tokens=4)])
+        best = 0.0
+        for _ in range(max(1, repeats)):
+            reqs = trace()
+            m = batcher.run(reqs)
+            best = max(best, m["decode_tok_s"])
+            tokens_by_arm[arm] = [list(r.tokens) for r in reqs]
+        out[f"serve_wq_tok_s_{arm}"] = best
+        out[f"serve_wq_decode_compiles_{arm}"] = engine.decode_compiles
+
+    base_bytes = weight_stream_bytes(bf16)
+    q_bytes = weight_stream_bytes(qparams)
+    ratio = base_bytes / max(q_bytes, 1)
+    n_match = sum(a == b for a, b in zip(tokens_by_arm["bf16"],
+                                         tokens_by_arm["quant"]))
+    parity = n_match == n_req
+    compiles_ok = (out["serve_wq_decode_compiles_bf16"] == 1
+                   and out["serve_wq_decode_compiles_quant"] == 1)
+    ok = (compiles_ok and ratio >= min_ratio
+          and (parity if dtype == "int8" else True))
+    if not ok:
+        print(f"bench serve_wq[{dtype}]: parity={parity} "
+              f"({n_match}/{n_req}) compiles_ok={compiles_ok} "
+              f"ratio={ratio:.3f} (min {min_ratio})", file=sys.stderr)
+    out.update({
+        "serve_wq_modeled_bytes_bf16": base_bytes,
+        "serve_wq_modeled_bytes_quant": q_bytes,
+        "serve_wq_modeled_ratio": round(ratio, 3),
+        "serve_wq_measured_ratio": round(
+            out["serve_wq_tok_s_quant"]
+            / max(out["serve_wq_tok_s_bf16"], 1e-9), 3),
+        "serve_wq_token_parity": parity,
+        "serve_wq_match_frac": round(n_match / max(n_req, 1), 4),
+        "serve_wq_one_compile": compiles_ok,
+        "serve_wq_ok": ok,
+    })
+    return out
+
+
+def bench_serve_lora() -> dict:
+    """Batched multi-LoRA decode (the PR-19 tentpole, adapter half):
+    one engine, one page pool, adapter traffic mixed per-slot in the
+    SAME decode sweep. Three claims, all gated:
+
+    - **base parity**: adapter-less requests through the LoRA-enabled
+      engine (lane 0 — the all-zero base lane) are token-identical to
+      a lora-off control engine, even while adapter riders share the
+      batch: the ranked delta matmuls are a numeric no-op for slots
+      on lane 0;
+    - **batched mix**: one batch carries >= 2 DISTINCT adapters plus
+      base riders concurrently — the per-adapter billing table from
+      the run metrics proves who decoded;
+    - **zero recompiles**: ``BENCH_LORA_ADAPTERS`` (default 4)
+      adapters churn through ``BENCH_LORA_MAX_LIVE`` (default 2)
+      lanes — hot-loads and LRU evictions — while ``decode_compiles``
+      and ``lora_load_compiles`` each stay exactly 1 (lane ids are
+      traced values; every lane write reuses one fixed-shape jitted
+      store).
+
+    Mixed-arm tokens/s runs best-of-``BENCH_LORA_REPEATS`` against
+    the control arm's, reported as overhead.
+    """
+    from torchbooster_tpu.models.gpt import GPT, GPTConfig
+    from torchbooster_tpu.serving import (ContinuousBatcher,
+                                          PagedEngine, Request)
+    from torchbooster_tpu.serving.adapters import random_adapter
+
+    n_req = int(os.environ.get("BENCH_LORA_REQUESTS", 8))
+    slots = int(os.environ.get("BENCH_LORA_SLOTS", 8))
+    page = int(os.environ.get("BENCH_LORA_PAGE", 32))
+    n_pages = int(os.environ.get("BENCH_LORA_PAGES", 64))
+    seq = int(os.environ.get("BENCH_LORA_SEQ", 512))
+    d_model = int(os.environ.get("BENCH_LORA_DMODEL", 128))
+    n_layers = int(os.environ.get("BENCH_LORA_LAYERS", 4))
+    vocab = int(os.environ.get("BENCH_LORA_VOCAB", 512))
+    rank = int(os.environ.get("BENCH_LORA_RANK", 8))
+    max_live = int(os.environ.get("BENCH_LORA_MAX_LIVE", 2))
+    n_adapters = int(os.environ.get("BENCH_LORA_ADAPTERS", 4))
+    repeats = int(os.environ.get("BENCH_LORA_REPEATS", 3))
+    # adapter magnitude: conventionally-initialized (std=0.02) deltas
+    # are too weak to flip this tiny model's decisive greedy argmax,
+    # which would make adapters_differ vacuous — bench traffic wants
+    # adapters that visibly steer
+    std = float(os.environ.get("BENCH_LORA_STD", 1.0))
+    if n_adapters <= max_live:
+        raise ValueError(
+            f"BENCH_LORA_ADAPTERS ({n_adapters}) must exceed "
+            f"BENCH_LORA_MAX_LIVE ({max_live}): the churn phase "
+            "exists to force evictions")
+
+    cfg = GPTConfig(vocab=vocab, n_layers=n_layers, d_model=d_model,
+                    n_heads=4, n_kv_heads=2, seq_len=seq)
+    params = GPT.init(jax.random.PRNGKey(0), cfg)
+    params = {**params,
+              "wte": {"table": params["wte"]["table"] * 4.0}}
+
+    rs = np.random.RandomState(1)
+    prompts = [rs.randint(0, vocab, 2 * page, dtype=np.int32)
+               for _ in range(n_req)]
+    out_lens = rs.randint(16, 33, n_req)
+    # the mixed batch: base riders between two live adapters —
+    # max_live distinct adapters is the most one batch can seat
+    names = ["a0", "a1"]
+    mix = ["" if i % 4 in (0, 3) else names[i % 4 - 1]
+           for i in range(n_req)]
+
+    def trace(adapters):
+        return [Request(prompt=p, max_new_tokens=int(o), adapter=a)
+                for p, o, a in zip(prompts, out_lens, adapters)]
+
+    # control arm: no LoRA lanes at all — the base-parity comparand
+    control = PagedEngine(params, cfg, page_size=page,
+                          n_pages=n_pages, max_slots=slots)
+    cb = ContinuousBatcher(control)
+    cb.run([Request(prompt=prompts[0][:page], max_new_tokens=4)])
+    base_tok_s = 0.0
+    for _ in range(max(1, repeats)):
+        reqs = trace([""] * n_req)
+        m = cb.run(reqs)
+        base_tok_s = max(base_tok_s, m["decode_tok_s"])
+        control_tokens = [list(r.tokens) for r in reqs]
+
+    engine = PagedEngine(params, cfg, page_size=page,
+                         n_pages=n_pages, max_slots=slots,
+                         lora_rank=rank, lora_max_live=max_live)
+    for i in range(n_adapters):
+        engine.adapters.register(
+            f"a{i}", random_adapter(i + 1, cfg, rank, std=std))
+    batcher = ContinuousBatcher(engine)
+    batcher.run([Request(prompt=prompts[0][:page], max_new_tokens=4)])
+    mix_tok_s = 0.0
+    for _ in range(max(1, repeats)):
+        reqs = trace(mix)
+        m = batcher.run(reqs)
+        mix_tok_s = max(mix_tok_s, m["decode_tok_s"])
+        mix_tokens = [list(r.tokens) for r in reqs]
+    distinct = sorted(k for k in m["adapters"] if k)
+
+    # churn phase: cycle every adapter through the two lanes — each
+    # cold name displaces a cached lane (LRU), and nothing recompiles
+    for i in range(n_adapters):
+        batcher.run(trace([f"a{i}"] * 2))
+
+    base_parity = all(
+        mix_tokens[i] == control_tokens[i]
+        for i in range(n_req) if mix[i] == "")
+    adapters_differ = all(
+        mix_tokens[i] != control_tokens[i]
+        for i in range(n_req) if mix[i] != "")
+    compiles_ok = (engine.decode_compiles == 1
+                   and engine.lora_load_compiles == 1)
+    reg = engine.adapters
+    ok = (base_parity and adapters_differ and len(distinct) >= 2
+          and compiles_ok and reg.evictions > 0)
+    if not ok:
+        print(f"bench serve_lora: base_parity={base_parity} "
+              f"adapters_differ={adapters_differ} "
+              f"distinct={distinct} compiles_ok={compiles_ok} "
+              f"evictions={reg.evictions}", file=sys.stderr)
+    overhead_pct = 100.0 * (1.0 - mix_tok_s / max(base_tok_s, 1e-9))
+    return {
+        "serve_lora_requests": n_req,
+        "serve_lora_rank": rank,
+        "serve_lora_max_live": max_live,
+        "serve_lora_n_adapters": n_adapters,
+        "serve_lora_tok_s_base": base_tok_s,
+        "serve_lora_tok_s_mix": mix_tok_s,
+        "serve_lora_overhead_pct": round(overhead_pct, 2),
+        "serve_lora_distinct_in_batch": len(distinct),
+        "serve_lora_base_parity": base_parity,
+        "serve_lora_adapters_differ": adapters_differ,
+        "serve_lora_loads": reg.loads,
+        "serve_lora_evictions": reg.evictions,
+        "serve_lora_hits": reg.hits,
+        "serve_lora_decode_compiles": engine.decode_compiles,
+        "serve_lora_load_compiles": engine.lora_load_compiles,
+        "serve_lora_one_compile": compiles_ok,
+        "serve_lora_ok": ok,
+    }
+
+
 def bench_obs(steps: int) -> dict:
     """Telemetry overhead A/B: the SAME GPT bench step (bench_gpt
     geometry + knobs) timed with observability disabled, then enabled
@@ -3627,6 +3884,10 @@ def _sub_main(name: str) -> None:
         print(json.dumps(bench_serve_spill()))
     elif name == "serve_structured":
         print(json.dumps(bench_serve_structured()))
+    elif name == "serve_wq":
+        print(json.dumps(bench_serve_wq()))
+    elif name == "serve_lora":
+        print(json.dumps(bench_serve_lora()))
     elif name == "obs_fleet":
         print(json.dumps(bench_obs_fleet()))
     elif name == "obs":
@@ -3861,6 +4122,13 @@ _SECONDARY_BENCHES = (("gpt", 900), ("gpt_long", 1500), ("loader", 900),
                       # zero-recompile schema-mix gate; shares its
                       # run_ab QUEUE deadline (two-drivers-must-agree)
                       ("serve_structured", 1800),
+                      # the quantized-weight and multi-LoRA rows
+                      # (PR 19): weight-stream ratio + parity gates,
+                      # and the mixed-adapter zero-recompile churn
+                      # gates; they share their run_ab QUEUE
+                      # deadlines (two-drivers-must-agree)
+                      ("serve_wq", 1800),
+                      ("serve_lora", 1800),
                       # the fleet signal-plane row (PR 17): plane
                       # on/off overhead + routing byte-identity + the
                       # replay_diff --routing round trip; shares its

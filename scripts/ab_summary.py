@@ -131,7 +131,8 @@ def main() -> None:
                  "serve_parallel", "serve_tree",
                  "obs_trace", "replay", "replay_http",
                  "serve_fleet", "serve_fleet_affinity",
-                 "serve_spill", "serve_structured", "obs_fleet")
+                 "serve_spill", "serve_structured", "obs_fleet",
+                 "serve_wq", "serve_wq_int4", "serve_lora")
     for name in sorted(attempts):
         if name in METRICS or (name in multi_key and name in latest):
             continue  # multi-key ok rows print below; failures fall through
@@ -512,6 +513,62 @@ def main() -> None:
         print(f"| on, constrained "
               f"| {r.get('serve_structured_tok_s_on', '—')} "
               f"| {r.get('serve_structured_masked_frac', '—')} |")
+
+    # serve_wq rows: quantized-weight serving, one sub-table row per
+    # measured dtype (the serve_wq / serve_wq_int4 QUEUE rows) — the
+    # measured-vs-modeled headline is the whole point: modeled is the
+    # weight-stream byte ratio (the gate, >= 1.9), measured is what
+    # this chip's decode actually did with it (compute-bound CPU
+    # smokes sit near 1.0x; an HBM-bound chip should track modeled)
+    wq_rows = [(n, latest[n].get("result") or {})
+               for n in ("serve_wq", "serve_wq_int4") if n in latest]
+    if wq_rows:
+        gates = ", ".join(
+            f"{r.get('serve_wq_dtype', '?')}: parity "
+            f"{r.get('serve_wq_token_parity', '?')} one compile "
+            f"{r.get('serve_wq_one_compile', '?')} "
+            f"ok={r.get('serve_wq_ok', '?')}" for _, r in wq_rows)
+        d0 = wq_rows[0][1]
+        print(f"\nserve_wq (d_model {d0.get('serve_wq_d_model', '?')}"
+              f", group {d0.get('serve_wq_group_size', '?')}, modeled"
+              " ratio gate >= 1.9; " + gates + "):")
+        print("| dtype | bf16 tok/s | quant tok/s | measured ratio "
+              "| modeled ratio | match frac |")
+        print("|---|---|---|---|---|---|")
+        for _, r in wq_rows:
+            print(f"| {r.get('serve_wq_dtype', '—')} "
+                  f"| {r.get('serve_wq_tok_s_bf16', '—')} "
+                  f"| {r.get('serve_wq_tok_s_quant', '—')} "
+                  f"| {r.get('serve_wq_measured_ratio', '—')}x "
+                  f"| {r.get('serve_wq_modeled_ratio', '—')}x "
+                  f"| {r.get('serve_wq_match_frac', '—')} |")
+
+    # serve_lora row: batched multi-LoRA decode — the mixed-adapter
+    # batch vs the lora-off control, with the base-parity /
+    # distinct-adapters / zero-recompile-churn gates in the header
+    e = latest.get("serve_lora")
+    if e is not None:
+        r = e.get("result") or {}
+        print(f"\nserve_lora ({r.get('serve_lora_n_adapters', '?')} "
+              f"adapters rank {r.get('serve_lora_rank', '?')} through "
+              f"{r.get('serve_lora_max_live', '?')} lanes, "
+              f"{r.get('serve_lora_distinct_in_batch', '?')} distinct "
+              "in one batch (gate >= 2), base parity "
+              f"{r.get('serve_lora_base_parity', '?')}, adapters "
+              f"steer {r.get('serve_lora_adapters_differ', '?')}, "
+              "decode/load compiles "
+              f"{r.get('serve_lora_decode_compiles', '?')}/"
+              f"{r.get('serve_lora_load_compiles', '?')} across "
+              f"{r.get('serve_lora_loads', '?')} loads + "
+              f"{r.get('serve_lora_evictions', '?')} evictions, "
+              f"verdict ok={r.get('serve_lora_ok', '?')}):")
+        print("| arm | decode tok/s |")
+        print("|---|---|")
+        print(f"| base (lora off) "
+              f"| {r.get('serve_lora_tok_s_base', '—')} |")
+        print(f"| mixed adapters "
+              f"| {r.get('serve_lora_tok_s_mix', '—')} "
+              f"({r.get('serve_lora_overhead_pct', '—')}% overhead) |")
 
     # obs_fleet row: the fleet signal-plane A/B — plane off vs on
     # decode tok/s with the <3% headline, the routing byte-identity +

@@ -62,6 +62,8 @@ BLOCKS = {
     "health": "RouterHealthConfig",
     "slo": "SLOBurnConfig",
     "structured": "StructuredConfig",
+    "weights": "WeightsConfig",
+    "adapters": "AdaptersConfig",
 }
 
 _FENCE = re.compile(r"^```yaml\s*$")

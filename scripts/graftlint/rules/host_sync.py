@@ -57,13 +57,23 @@ HOT_PATHS = (
     # advance + mask refresh run between every decode/verify dispatch
     # — deliberate host numpy bookkeeping, plain-int arithmetic only,
     # so a stray .item()/float(<call>) there stalls the decode loop
-    # like one in the engine itself
+    # like one in the engine itself. It likewise covers
+    # serving/adapters.py (PR 19): the registry's acquire/release lane
+    # bookkeeping runs at every admit/retire and the one compiled
+    # lane-write at every hot-load — pure host dict/LRU arithmetic by
+    # design, and a sync there would serialize adapter churn against
+    # the decode stream
     "torchbooster_tpu/serving/",
     # the paged flash-decode kernel wrapper sits INSIDE the compiled
     # decode/verify steps (serving/engine.py calls it per layer per
     # step) — a host sync in its wrapper-level plumbing would stall
     # every decode dispatch exactly like one in the engine itself
     "torchbooster_tpu/ops/paged_attention.py",
+    # the in-kernel dequant wrappers (PR 19) run INSIDE every compiled
+    # matmul — dense generate, paged chunk/decode/verify, and the tp
+    # shard_map body all call qmatmul per layer per step, so a host
+    # sync in models/quant.py stalls every one of those dispatches
+    "torchbooster_tpu/models/quant.py",
     "torchbooster_tpu/observability/",
     "torchbooster_tpu/data/pipeline.py",
     # the gradient-sync hook runs INSIDE the compiled step and its

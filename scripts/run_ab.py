@@ -205,6 +205,24 @@ QUEUE: list[tuple[str, str, dict, int]] = [
     # (bench.bench_serve_structured; serve_structured_ok is the
     # verdict bit)
     ("serve_structured", "serve_structured", {}, 1800),
+    # quantized-weight serving (the PR-19 tentpole, weight half): bf16
+    # dense control vs in-kernel-dequant arm on identical paged
+    # geometry — int8 bitwise token parity, exactly one decode
+    # compile per arm, and the modeled weight-stream ratio
+    # (weight_stream_bytes bf16/quant) >= 1.9; the int4 row swaps the
+    # packed grouped format in (ratio ~3.3, parity reported not
+    # gated). (bench.bench_serve_wq; serve_wq_ok is the verdict bit)
+    ("serve_wq", "serve_wq", {}, 1800),
+    ("serve_wq_int4", "serve_wq", {"BENCH_WQ_DTYPE": "int4"}, 1800),
+    # batched multi-LoRA decode (the PR-19 tentpole, adapter half):
+    # lora-off control vs a mixed batch carrying >= 2 distinct
+    # adapters + base riders on one page pool — lane-0 base token
+    # parity, adapter streams visibly steered, and the zero-recompile
+    # churn gate (4 adapters through 2 lanes: decode_compiles and
+    # lora_load_compiles both exactly 1 across hot-loads + LRU
+    # evictions). (bench.bench_serve_lora; serve_lora_ok is the
+    # verdict bit)
+    ("serve_lora", "serve_lora", {}, 1800),
     # fleet signal plane (the PR-17 tentpole): plane-off vs plane-on
     # (audit ring + health scorer + SLO burn engine, health_aware OFF)
     # over the serve_fleet workload — < 3% decode tok/s overhead, zero

@@ -564,7 +564,9 @@ def test_audit_records_carry_the_load_picture():
     assert [r["seq"] for r in recs] == list(range(len(recs)))
     for rec in recs:
         assert set(rec) == {"seq", "request_id", "arrival", "replica",
-                            "reason", "key", "candidates", "health"}
+                            "reason", "key", "candidates", "health",
+                            "adapter"}
+        assert rec["adapter"] == ""      # base traffic records ""
         assert rec["reason"] in {"affinity", "bind", "spill",
                                  "least_loaded", "directory"}
         for cand in rec["candidates"]:

@@ -642,3 +642,78 @@ def test_frontend_yaml_block_builds_policy_and_server(tmp_path):
     assert isinstance(FrontendConfig().make_policy(), FCFSPolicy)
     with pytest.raises(ValueError, match="fcfs.*or.*slo"):
         FrontendConfig(policy="lifo").make_policy()
+
+
+# ---- multi-LoRA model field (PR 19) ---------------------------------
+
+def test_model_field_selects_adapter_and_rejects_unknown():
+    """The OpenAI ``model`` field doubles as the adapter selector:
+    absent / the served name -> base (response echoes the base
+    name), a registered adapter name -> its lane (response echoes
+    the adapter, stream visibly steered), an unknown name -> 400 at
+    submit, any adapter on a lora-less engine -> 400, and a
+    non-string model -> 400 — all before a page moves. The adapter
+    billing counters land in /metrics."""
+    from torchbooster_tpu.serving import ContinuousBatcher
+    from torchbooster_tpu.serving.adapters import random_adapter
+    from torchbooster_tpu.serving.frontend import ServingFrontend
+
+    params, cfg = _decisive_model()
+    engine = _engine(params, cfg, max_slots=4, n_pages=32,
+                     lora_rank=4, lora_max_live=2)
+    engine.adapters.register("a0", random_adapter(1, cfg, 4, std=1.0))
+    fe = ServingFrontend(ContinuousBatcher(engine))
+    prompt = [int(t) for t in np.asarray(jax.random.randint(
+        jax.random.PRNGKey(3), (5,), 0, cfg.vocab))]
+
+    async def scenario():
+        await fe.start()
+        p = {"prompt": prompt, "max_tokens": 6}
+        s_base, _, base = await _unary(fe.port, "/v1/completions", p)
+        s_named, _, named = await _unary(
+            fe.port, "/v1/completions",
+            {**p, "model": "torchbooster-tpu"})
+        s_lora, _, lora = await _unary(
+            fe.port, "/v1/completions", {**p, "model": "a0"})
+        s_unk, _, unk = await _unary(
+            fe.port, "/v1/completions", {**p, "model": "ghost"})
+        s_bad, _, bad = await _unary(
+            fe.port, "/v1/completions", {**p, "model": 7})
+        _, prom = await _get(fe.port, "/metrics")
+        metrics = await fe.stop()
+        return (s_base, base, s_named, named, s_lora, lora,
+                s_unk, unk, s_bad, bad, prom.decode(), metrics)
+
+    (s_base, base, s_named, named, s_lora, lora, s_unk, unk,
+     s_bad, bad, prom, metrics) = asyncio.run(scenario())
+    assert s_base == s_named == s_lora == 200
+    assert base["model"] == named["model"] == "torchbooster-tpu"
+    assert lora["model"] == "a0"
+    toks = lambda b: b["choices"][0]["token_ids"]
+    assert toks(base) == toks(named)        # served-name == base
+    assert toks(lora) != toks(base)         # the adapter steers
+    assert s_unk == 400 and "unknown adapter" in \
+        unk["error"]["message"]
+    assert s_bad == 400 and "must be a string" in \
+        bad["error"]["message"]
+    assert "serving_adapter_tokens_total" in prom
+    assert metrics["adapters"]["a0"] == {"n_requests": 1,
+                                         "new_tokens": 6}
+    assert metrics["n_adapter_loads"] == 1
+    assert engine.adapters.pinned_count == 0
+    engine.tables.check()
+
+    # a lora-less engine rejects ANY adapter name with a 400
+    plain = _engine(params, cfg)
+    fe2 = ServingFrontend(ContinuousBatcher(plain))
+
+    async def scenario2():
+        await fe2.start()
+        s, _, body = await _unary(
+            fe2.port, "/v1/completions",
+            {"prompt": prompt, "max_tokens": 2, "model": "a0"})
+        await fe2.stop()
+        return s, body
+
+    s, body = asyncio.run(scenario2())
+    assert s == 400 and "no LoRA lanes" in body["error"]["message"]

@@ -1154,6 +1154,50 @@ def test_config_doc_drift_live_rule_is_anchored_to_real_files():
     assert (REPO / rule.doc_rel).exists()
 
 
+def test_config_doc_drift_blocks_cover_weights_and_adapters(tmp_path):
+    """The PR 19 sub-blocks are in the BLOCKS map (the reverse check
+    only sees mapped blocks — an unmapped fence is invisible drift)
+    AND both directions fire on a weights/adapters fixture."""
+    from scripts.graftlint.rules.config_doc_drift import BLOCKS
+
+    assert BLOCKS["weights"] == "WeightsConfig"
+    assert BLOCKS["adapters"] == "AdaptersConfig"
+    rule = _write_drift_fixture(tmp_path, """\
+        from dataclasses import dataclass
+
+        @dataclass
+        class WeightsConfig:
+            dtype: str = "bf16"
+            group_size: int = 64
+
+        @dataclass
+        class AdaptersConfig:
+            rank: int = 0
+            max_live: int = 4
+        """, """\
+        `dtype` and `rank` are documented; `group_size` and
+        `max_live` are not (the backticks above only count inside a
+        segment attributable to each class — there is none here).
+
+        ```yaml
+        weights:
+          dtype: int8
+          bits: 8
+        adapters:
+          rank: 4
+        ```
+        """)
+    messages = [f.message for f in rule.check_repo(tmp_path)]
+    # forward: the fenceless fields of BOTH new classes are flagged
+    assert any("WeightsConfig.group_size" in m for m in messages)
+    assert any("AdaptersConfig.max_live" in m for m in messages)
+    # reverse: a dead key under `weights:` is drift like any block's
+    assert any("`weights.bits`" in m and "no such field" in m
+               for m in messages)
+    # fence keys document their class: dtype/rank draw no finding
+    assert not any(".dtype" in m or ".rank" in m for m in messages)
+
+
 # =========================================================================
 # metric-doc-drift
 # =========================================================================

@@ -149,6 +149,56 @@ def test_synthetic_generators_deterministic_same_format():
         assert {r.priority for r in a.requests} <= {"rt", "batch"}
 
 
+def test_workload_v4_adapter_roundtrip(tmp_path):
+    """v4 (multi-LoRA): ``synthesize(adapter_mix=)`` assigns adapters
+    by weighted draw from its OWN stream — the base request content
+    stays byte-identical to the mix-less workload — the field rides
+    save/load and the fingerprint only when set, and a v3-headered
+    file (no adapter keys) still loads, with every adapter ''."""
+    import json as _json
+
+    from torchbooster_tpu.serving.loadgen import Workload, synthesize
+
+    kw = dict(n_requests=16, seed=3, vocab=97, prompt_len=(4, 8),
+              max_new_tokens=(3, 6), rate=50.0)
+    plain = synthesize("poisson", **kw)
+    mixed = synthesize("poisson", adapter_mix="base:2,fr:1,de:1", **kw)
+    names = {r.adapter for r in mixed.requests}
+    assert names & {"fr", "de"} and "" in names    # the draw mixes
+    assert "base" not in names                      # 'base' -> ''
+    # the adapter draw must not perturb base content
+    for a, b in zip(plain.requests, mixed.requests):
+        assert np.array_equal(a.prompt, b.prompt)
+        assert (a.arrival_s, a.max_new_tokens) == \
+            (b.arrival_s, b.max_new_tokens)
+    assert plain.fingerprint() != mixed.fingerprint()
+    # round trip: adapters + fingerprint survive save/load
+    back = Workload.load(mixed.save(tmp_path / "v4.jsonl"))
+    assert [r.adapter for r in back.requests] == \
+        [r.adapter for r in mixed.requests]
+    assert back.fingerprint() == mixed.fingerprint()
+    # adapter-less workloads keep the pre-v4 fingerprint (the field
+    # enters the content key ONLY when set), so a v3-headered file
+    # loads clean with the same recorded fingerprint
+    path = plain.save(tmp_path / "v3.jsonl")
+    lines = path.read_text().splitlines()
+    hdr = _json.loads(lines[0])
+    assert hdr["version"] == 4
+    hdr["version"] = 3
+    lines[0] = _json.dumps(hdr)
+    path.write_text("\n".join(lines) + "\n")
+    old = Workload.load(path)
+    assert all(r.adapter == "" for r in old.requests)
+    assert old.fingerprint() == plain.fingerprint()
+    # determinism + validation
+    again = synthesize("poisson", adapter_mix="base:2,fr:1,de:1", **kw)
+    assert again.fingerprint() == mixed.fingerprint()
+    with pytest.raises(ValueError, match="adapter"):
+        from torchbooster_tpu.serving.loadgen import WorkloadRequest
+        WorkloadRequest(arrival_s=0.0, max_new_tokens=2,
+                        prompt=np.arange(1, 4), adapter=7)
+
+
 # ---- replay determinism (ISSUE satellite) ----------------------------
 
 def _decisions(tracer):

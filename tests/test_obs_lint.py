@@ -108,7 +108,13 @@ def test_hot_paths_cover_step_cadence_serving_files():
                 "torchbooster_tpu/observability/slo.py",
                 # the paged flash-decode kernel wrapper runs inside
                 # the compiled decode/verify steps (PR 8)
-                "torchbooster_tpu/ops/paged_attention.py"):
+                "torchbooster_tpu/ops/paged_attention.py",
+                # PR 19: the adapter registry's lane bookkeeping runs
+                # at every admit/retire, and the in-kernel dequant
+                # wrappers run inside every compiled matmul — both
+                # step-cadence
+                "torchbooster_tpu/serving/adapters.py",
+                "torchbooster_tpu/models/quant.py"):
         assert (REPO / rel).exists(), f"{rel} moved without this test"
         assert any(rel.startswith(h) for h in lint.HOT_PATHS), (
             f"{rel} fell out of obs_lint HOT_PATHS")

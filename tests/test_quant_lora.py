@@ -1,0 +1,490 @@
+"""Quantized weight serving + batched multi-LoRA (PR 19):
+
+- ``quantize_params`` format invariants: int8 per-output-channel and
+  packed int4 per-group layouts, the per-row int8 embedding table,
+  loud re-quantization rejection, and the group-size divisibility
+  errors;
+- ``qmatmul`` == ``x @ dequant_kernel`` for both formats, and the
+  dequant reconstruction error stays inside the rounding bound;
+- the acceptance parity: int8-weight paged decode is token-for-token
+  identical to the full-precision engine AND the dense
+  ``jit_generate`` path on the SAME quantized tree (the in-matmul
+  dequant dispatches off tree structure everywhere);
+- ``weight_stream_bytes``: the modeled bf16/int8 ratio clears the
+  1.9x serve_wq gate at d_model 128 (and visibly does NOT at tiny
+  widths — the fp32 scale vector is why the bench pins its model);
+- the adapter registry: refcounted pinned/cached/free lane lifetime,
+  LRU eviction, all-pinned backpressure, rank zero-padding, and the
+  registration error surface;
+- engine + batcher LoRA: lane-0 bitwise no-op parity, >= 2 distinct
+  adapters steering one batch, zero decode/load recompiles across
+  hot-load/evict churn, fork pin inheritance, per-adapter billing
+  keys (stable on the lora-less path too), and the submit-time
+  rejection of unknown/unservable adapter names;
+- the composition pair (satellite): int8 weights x int8 KV pages x
+  tp=2 x speculative verify emits the tp=1 stream token-for-token
+  (heavier combos ride the slow suite);
+- the YAML surface: ``serving.weights``/``serving.adapters`` blocks
+  quantize the tree and light the lanes from config alone, and an
+  unknown dtype dies in validation.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torchbooster_tpu.models.gpt import GPT, GPTConfig
+from torchbooster_tpu.models.quant import (dequant_kernel, is_quantized,
+                                           qmatmul, quantize_params,
+                                           weight_stream_bytes,
+                                           weights_dtype)
+from tests.test_serving import (_decisive_model, _paged_tokens,
+                                _repetitive_prompt, _spec_tokens,
+                                _tp_mesh)
+
+
+def _bf16(params):
+    return jax.tree.map(
+        lambda x: x.astype(jnp.bfloat16)
+        if jnp.issubdtype(x.dtype, jnp.floating) else x, params)
+
+
+# ---- quantized formats -------------------------------------------
+
+
+def test_quantize_int8_layout():
+    params, cfg = _decisive_model()
+    q = quantize_params(params, dtype="int8")
+    qkv = q["blocks"]["attn_qkv"]
+    ker = params["blocks"]["attn_qkv"]["kernel"]
+    assert "kernel" not in qkv
+    assert qkv["qkernel"].dtype == jnp.int8
+    assert qkv["qkernel"].shape == ker.shape
+    assert qkv["qscale"].shape == ker.shape[:-2] + (1, ker.shape[-1])
+    assert qkv["qscale"].dtype == jnp.float32
+    # per-row int8 embedding: gather-addressable rows, (vocab, 1) scale
+    assert q["wte"]["qtable"].dtype == jnp.int8
+    assert q["wte"]["qscale"].shape == (cfg.vocab, 1)
+    assert is_quantized(q) and not is_quantized(params)
+    assert weights_dtype(q) == "int8"
+    assert weights_dtype(params) == "bf16"
+
+
+def test_quantize_int4_layout_and_group_errors():
+    params, cfg = _decisive_model()
+    q = quantize_params(params, dtype="int4", group_size=16)
+    qkv = q["blocks"]["attn_qkv"]
+    ker = params["blocks"]["attn_qkv"]["kernel"]
+    din, dout = ker.shape[-2], ker.shape[-1]
+    assert qkv["qkernel"].dtype == jnp.uint8         # the int4 witness
+    assert qkv["qkernel"].shape[-2:] == (din // 2, dout)
+    assert qkv["qscale"].shape[-2:] == (din // 16, dout)
+    assert weights_dtype(q) == "int4"
+    with pytest.raises(ValueError, match="does not divide"):
+        quantize_params(params, dtype="int4", group_size=24)
+    with pytest.raises(ValueError, match="group_size"):
+        quantize_params(params, dtype="int4", group_size=3)
+    with pytest.raises(ValueError, match="int8.*int4|'int8' or 'int4'"):
+        quantize_params(params, dtype="fp8")
+
+
+def test_requantize_rejected():
+    params, _ = _decisive_model()
+    q = quantize_params(params, dtype="int8")
+    with pytest.raises(ValueError, match="already weight-quantized"):
+        quantize_params(q, dtype="int8")
+
+
+@pytest.mark.parametrize("dtype,levels", [("int8", 127.0),
+                                          ("int4", 7.0)])
+def test_dequant_error_bounded_and_qmatmul_consistent(dtype, levels):
+    """dequant reconstruction stays inside half a quantization step
+    per element, and ``qmatmul`` computes exactly
+    ``x @ dequant_kernel`` (the two code paths must agree — parity
+    tests lean on dequant_kernel as the offline reference)."""
+    params, cfg = _decisive_model()
+    q = quantize_params(params, dtype=dtype, group_size=16)
+    # block kernels stack layers on the lead axis — slice one layer
+    ker = np.asarray(params["blocks"]["mlp_fc1"]["kernel"][0],
+                     np.float32)
+    qd = {"qkernel": q["blocks"]["mlp_fc1"]["qkernel"][0],
+          "qscale": q["blocks"]["mlp_fc1"]["qscale"][0]}
+    rec = np.asarray(dequant_kernel(qd))
+    # half-step bound: |err| <= scale/2 = absmax / (2*levels); the
+    # int8 scale is per output column, int4 per (group, column) — the
+    # global absmax bounds both
+    assert np.max(np.abs(rec - ker)) <= np.max(np.abs(ker)) / levels
+    x = np.asarray(
+        jax.random.normal(jax.random.PRNGKey(3), (2, ker.shape[0])),
+        np.float32)
+    np.testing.assert_allclose(np.asarray(qmatmul(qd, jnp.asarray(x))),
+                               x @ rec, rtol=1e-5, atol=1e-5)
+
+
+def test_int8_paged_matches_fullprec_and_dense():
+    """The serve_wq acceptance parity at unit scale: the int8-weight
+    paged engine decodes the FULL-PRECISION engine's exact greedy
+    stream, and the dense ``jit_generate`` path over the same
+    quantized tree agrees — one format, three execution paths."""
+    from torchbooster_tpu.serving import PagedEngine
+
+    params, cfg = _decisive_model()
+    q = quantize_params(params, dtype="int8")
+    ids = jax.random.randint(jax.random.PRNGKey(1), (1, 5), 0,
+                             cfg.vocab)
+    n_new = 8
+    want = _paged_tokens(
+        PagedEngine(params, cfg, page_size=4, n_pages=16, max_slots=2,
+                    compute_dtype=jnp.float32),
+        np.asarray(ids[0]), n_new)
+    eng = PagedEngine(q, cfg, page_size=4, n_pages=16, max_slots=2,
+                      compute_dtype=jnp.float32)
+    got = _paged_tokens(eng, np.asarray(ids[0]), n_new)
+    assert got == want
+    assert eng.decode_compiles == 1
+    dense = GPT.generate(q, ids, cfg, n_new=n_new, temperature=0.0,
+                         compute_dtype=jnp.float32)
+    np.testing.assert_array_equal(np.asarray(dense[0, 5:]), got)
+
+
+def test_weight_stream_ratio_needs_width():
+    """The modeled bf16/quant byte ratio: >= 1.9 at the bench's
+    d_model=128 floor, and measurably BELOW it at d_model=64 — the
+    fp32 per-channel scale vector amortizes with width, which is why
+    serve_wq pins its model geometry."""
+    for d, expect_ok in ((128, True), (64, False)):
+        cfg = GPTConfig(vocab=256, n_layers=1, d_model=d, n_heads=4,
+                        seq_len=32, n_kv_heads=2)
+        params = GPT.init(jax.random.PRNGKey(0), cfg)
+        bf = _bf16(params)
+        ratio = (weight_stream_bytes(bf)
+                 / weight_stream_bytes(quantize_params(bf, "int8")))
+        assert (ratio >= 1.9) == expect_ok, (d, ratio)
+    # int4 halves the kernel stream again
+    cfg128 = GPTConfig(vocab=256, n_layers=1, d_model=128, n_heads=4,
+                       seq_len=32, n_kv_heads=2)
+    bf = _bf16(GPT.init(jax.random.PRNGKey(0), cfg128))
+    r4 = (weight_stream_bytes(bf)
+          / weight_stream_bytes(
+              quantize_params(bf, "int4", group_size=64)))
+    assert r4 > 3.0
+
+
+# ---- adapter registry --------------------------------------------
+
+
+def _lora_engine(params, cfg, rank=4, max_live=2, **kw):
+    from torchbooster_tpu.serving import PagedEngine
+
+    kw.setdefault("page_size", 4)
+    kw.setdefault("n_pages", 32)
+    kw.setdefault("max_slots", 4)
+    kw.setdefault("compute_dtype", jnp.float32)
+    return PagedEngine(params, cfg, lora_rank=rank,
+                       lora_max_live=max_live, **kw)
+
+
+def test_registry_lane_lifetime():
+    """pinned / cached / free lane states: acquire pins, release
+    caches (stays resident for the next hit), LRU eviction displaces
+    the stalest cached lane, and all-pinned acquire returns None —
+    the admit_begin backpressure contract."""
+    from torchbooster_tpu.serving.adapters import random_adapter
+
+    params, cfg = _decisive_model()
+    eng = _lora_engine(params, cfg, rank=4, max_live=2)
+    reg = eng.adapters
+    for i in range(3):
+        reg.register(f"a{i}", random_adapter(i + 1, cfg, 4))
+    assert reg.acquire("") == 0              # base: lane 0, no pin
+    l0, l1 = reg.acquire("a0"), reg.acquire("a1")
+    assert sorted((l0, l1)) == [1, 2] and reg.loads == 2
+    assert reg.acquire("a2") is None         # every lane pinned
+    assert reg.acquire("a0") == l0           # resident: a hit
+    assert reg.hits == 1 and reg.pinned_count == 2
+    reg.release("a0"); reg.release("a0"); reg.release("a1")
+    assert reg.pinned_count == 0 and reg.resident_count == 2
+    assert reg.acquire("a0") == l0 and reg.hits == 2   # cached hit
+    reg.release("a0")
+    # a2 must evict the LRU cached lane (a1 — a0 was touched later)
+    assert reg.acquire("a2") == l1
+    assert reg.evictions == 1 and reg.loads == 3
+    with pytest.raises(KeyError, match="unknown adapter"):
+        reg.acquire("nope")
+    with pytest.raises(RuntimeError, match="without a matching"):
+        reg.release("a1")
+    assert reg.known("") and reg.known("a0") and not reg.known("x")
+
+
+def test_registry_rank_padding_and_register_errors():
+    from torchbooster_tpu.serving.adapters import random_adapter
+
+    params, cfg = _decisive_model()
+    eng = _lora_engine(params, cfg, rank=4, max_live=2)
+    reg = eng.adapters
+    reg.register("small", random_adapter(1, cfg, 2))   # rank 2 -> pad 4
+    assert reg._host["small"]["a_qkv"].shape[-1] == 4
+    assert reg._host["small"]["b_proj"].shape[-2] == 4
+    assert reg.acquire("small") == 1
+    with pytest.raises(ValueError, match="rank 6 > the engine"):
+        reg.register("big", random_adapter(2, cfg, 6))
+    bad = random_adapter(3, cfg, 4)
+    bad["b_qkv"] = bad["b_qkv"][:, :2, :]
+    with pytest.raises(ValueError, match="mixes ranks"):
+        reg.register("mixed", bad)
+    with pytest.raises(ValueError, match="missing"):
+        reg.register("partial", {"a_qkv": bad["a_qkv"]})
+    with pytest.raises(ValueError, match="non-empty"):
+        reg.register("", random_adapter(4, cfg, 4))
+    # re-registering a RESIDENT adapter refreshes its lane in place
+    loads0 = reg.loads
+    reg.register("small", random_adapter(5, cfg, 4))
+    assert reg.loads == loads0 + 1
+    assert reg._lane_of["small"] == 1
+
+
+# ---- engine + batcher LoRA ---------------------------------------
+
+
+def test_lane0_noop_parity():
+    """A LoRA-enabled engine serving only base traffic emits the
+    lora-less engine's BITWISE stream: lane 0's all-zero stacks make
+    the delta matmuls an exact no-op."""
+    from torchbooster_tpu.serving import PagedEngine
+
+    params, cfg = _decisive_model()
+    ids = np.asarray(jax.random.randint(jax.random.PRNGKey(2), (6,),
+                                        0, cfg.vocab))
+    want = _paged_tokens(
+        PagedEngine(params, cfg, page_size=4, n_pages=32, max_slots=4,
+                    compute_dtype=jnp.float32), ids, 8)
+    eng = _lora_engine(params, cfg)
+    assert _paged_tokens(eng, ids, 8) == want
+    assert eng.decode_compiles == 1
+
+
+def test_multi_adapter_batch_steers_zero_recompiles():
+    """The tentpole batch shape: base riders + two DISTINCT adapters
+    decode in ONE sweep — base streams bitwise-match the lora-off
+    control, adapter streams visibly differ, and hot-load/evict churn
+    across more adapters than lanes leaves decode_compiles and
+    lora_load_compiles at exactly 1. Per-adapter billing lands in the
+    run metrics under stable keys."""
+    from torchbooster_tpu.serving import (ContinuousBatcher,
+                                          PagedEngine, Request)
+    from torchbooster_tpu.serving.adapters import random_adapter
+
+    params, cfg = _decisive_model()
+    rs = np.random.RandomState(0)
+    prompts = [rs.randint(0, 97, 6).astype(np.int32) for _ in range(4)]
+    mix = ["", "a0", "a1", ""]
+
+    def trace(adapters):
+        return [Request(prompt=p, max_new_tokens=6, adapter=a)
+                for p, a in zip(prompts, adapters)]
+
+    control = PagedEngine(params, cfg, page_size=4, n_pages=32,
+                          max_slots=4, compute_dtype=jnp.float32)
+    creqs = trace([""] * 4)
+    mc = ContinuousBatcher(control).run(creqs)
+    # lora-less runs keep the adapter metric keys, zeroed/empty
+    assert mc["n_adapter_loads"] == 0 and mc["adapters"] == {}
+
+    eng = _lora_engine(params, cfg, rank=4, max_live=2)
+    for i in range(3):
+        eng.adapters.register(f"a{i}",
+                              random_adapter(i + 1, cfg, 4, std=1.0))
+    batcher = ContinuousBatcher(eng)
+    reqs = trace(mix)
+    m = batcher.run(reqs)
+    for i in (0, 3):                          # base riders: bitwise
+        assert reqs[i].tokens == creqs[i].tokens
+    for i in (1, 2):                          # adapters must steer
+        assert reqs[i].tokens != creqs[i].tokens
+    assert sorted(k for k in m["adapters"] if k) == ["a0", "a1"]
+    assert m["adapters"]["a0"] == {"n_requests": 1, "new_tokens": 6}
+    assert m["n_adapter_loads"] == 2
+    # churn: cycle 3 adapters through 2 lanes — loads + evictions,
+    # zero recompiles, and every pin returns
+    for i in range(3):
+        batcher.run(trace([f"a{i}"] * 2))
+    assert eng.adapters.evictions > 0
+    assert eng.adapters.pinned_count == 0
+    assert eng.decode_compiles == 1
+    assert eng.lora_load_compiles == 1
+    eng.tables.check()
+
+
+@pytest.mark.slow    # lifecycle edge; the steering test covers tier-1
+def test_fork_inherits_adapter_pin():
+    """Parallel-sampling forks: every sibling branch takes its OWN
+    pin on the parent's adapter at fork time, and every retire path
+    returns it — after the family finishes nothing stays pinned, and
+    the family bills its adapter once per branch token."""
+    from torchbooster_tpu.serving import ContinuousBatcher, Request
+    from torchbooster_tpu.serving.adapters import random_adapter
+
+    params, cfg = _decisive_model(seq_len=32)
+    eng = _lora_engine(params, cfg, rank=4, max_live=2,
+                       parallel_sampling=True, max_slots=6)
+    eng.adapters.register("a0", random_adapter(1, cfg, 4, std=1.0))
+    prompt = np.asarray(jax.random.randint(jax.random.PRNGKey(2),
+                                           (5,), 0, cfg.vocab))
+    fam = Request(prompt=prompt, max_new_tokens=4, n=2, seed=5,
+                  adapter="a0")
+    m = ContinuousBatcher(eng).run([fam])
+    assert m["n_forks"] == 1
+    assert all(len(b.tokens) == 4 for b in fam.branches)
+    assert eng.adapters.pinned_count == 0
+    assert eng.adapters.resident_count == 1    # cached, not evicted
+    assert m["adapters"]["a0"]["new_tokens"] == 8
+    eng.tables.check()
+
+
+def test_unknown_or_unservable_adapter_rejected():
+    """Submit-time rejection (the frontend's 400 surface): an
+    unregistered adapter name, and ANY adapter on an engine without
+    LoRA lanes, both fail loudly before touching the pool."""
+    from torchbooster_tpu.serving import (ContinuousBatcher,
+                                          PagedEngine, Request)
+
+    params, cfg = _decisive_model()
+    req = Request(prompt=np.arange(1, 5, dtype=np.int32),
+                  max_new_tokens=2, adapter="ghost")
+    eng = _lora_engine(params, cfg)
+    with pytest.raises(ValueError, match="unknown adapter"):
+        ContinuousBatcher(eng).run([req])
+    plain = PagedEngine(params, cfg, page_size=4, n_pages=16,
+                        max_slots=2, compute_dtype=jnp.float32)
+    with pytest.raises(ValueError, match="no LoRA lanes"):
+        ContinuousBatcher(plain).run([req])
+    with pytest.raises(TypeError, match="adapter"):
+        Request(prompt=np.arange(1, 5, dtype=np.int32), adapter=3)
+
+
+@pytest.mark.slow    # lifecycle edge; the registry unit test pins it
+def test_adapter_backpressure_all_lanes_pinned():
+    """More distinct adapters than lanes in one wave: the overflow
+    request stays QUEUED (acquire -> None) until a lane unpins, then
+    completes — the adapter analogue of pool-exhaustion
+    backpressure."""
+    from torchbooster_tpu.serving import ContinuousBatcher, Request
+    from torchbooster_tpu.serving.adapters import random_adapter
+
+    params, cfg = _decisive_model()
+    eng = _lora_engine(params, cfg, rank=4, max_live=1, max_slots=4)
+    for i in range(2):
+        eng.adapters.register(f"a{i}", random_adapter(i + 1, cfg, 4))
+    rs = np.random.RandomState(1)
+    reqs = [Request(prompt=rs.randint(0, 97, 5).astype(np.int32),
+                    max_new_tokens=6, adapter=f"a{i % 2}")
+            for i in range(3)]
+    m = ContinuousBatcher(eng).run(reqs)
+    assert all(len(r.tokens) == 6 for r in reqs)
+    assert m["adapters"]["a0"]["n_requests"] == 2
+    assert m["adapters"]["a1"]["n_requests"] == 1
+    assert eng.adapters.pinned_count == 0
+    assert eng.decode_compiles == 1
+    eng.tables.check()
+
+
+# ---- composition (satellite): quant x kv x tp x spec -------------
+
+
+@pytest.mark.parametrize("wq_dtype", [
+    "int8",
+    pytest.param("int4", marks=pytest.mark.slow),
+])
+def test_quant_int8kv_tp2_spec_composition(wq_dtype):
+    """The composition acceptance pair: quantized weights x int8 KV
+    pages x tp=2 x speculative verify emits the tp=1 engine's greedy
+    stream token-for-token through ONE verify compile — every PR-19
+    layer rides the same compiled step the earlier tentpoles share.
+    (Same quantized tree on both sides, so the parity is exact by
+    construction; what it proves is the tp shard_map path reads the
+    sharded qkernel/qscale identically to the single-chip one.)"""
+    from torchbooster_tpu.serving import PagedEngine
+
+    params, cfg = _decisive_model()
+    q = quantize_params(params, dtype=wq_dtype, group_size=16)
+    prompt = _repetitive_prompt(np.random.RandomState(5))
+    n_new = 10
+
+    def serve(**kw):
+        eng = PagedEngine(q, cfg, page_size=8, n_pages=16,
+                          max_slots=2, cache_dtype="int8",
+                          speculative=True, draft_len=3, **kw)
+        return _spec_tokens(eng, prompt, n_new), eng
+
+    want, _ = serve()
+    got, eng = serve(tp=2, mesh=_tp_mesh(2))
+    assert got == want
+    assert eng.verify_compiles == 1
+    eng.tables.check()
+
+
+@pytest.mark.slow
+def test_quant_lora_tp2_composition():
+    """int8 weights + LoRA adapters + int8 KV at tp=2: the full
+    PR-19 stack composed, token-exact against tp=1 (validated layout:
+    the rank-major b_qkv permutation lines the replicated adapter
+    stacks up with each rank's column shard)."""
+    from torchbooster_tpu.serving import (ContinuousBatcher, Request)
+    from torchbooster_tpu.serving.adapters import random_adapter
+
+    params, cfg = _decisive_model()
+    q = quantize_params(params, dtype="int8")
+    rs = np.random.RandomState(2)
+    prompts = [rs.randint(0, 97, 6).astype(np.int32) for _ in range(3)]
+    mix = ["", "a0", "a1"]
+
+    def serve(**kw):
+        eng = _lora_engine(q, cfg, rank=4, max_live=2,
+                           cache_dtype="int8", **kw)
+        for i in range(2):
+            eng.adapters.register(
+                f"a{i}", random_adapter(i + 1, cfg, 4, std=1.0))
+        reqs = [Request(prompt=p, max_new_tokens=6, adapter=a)
+                for p, a in zip(prompts, mix)]
+        ContinuousBatcher(eng).run(reqs)
+        return [r.tokens for r in reqs], eng
+
+    want, _ = serve()
+    got, eng = serve(tp=2, mesh=_tp_mesh(2))
+    assert got == want
+    assert eng.decode_compiles == 1 and eng.lora_load_compiles == 1
+
+
+# ---- the YAML surface ----------------------------------------------
+
+def test_weights_adapters_yaml_blocks(tmp_path):
+    """``serving.weights``/``serving.adapters`` build a quantized,
+    LoRA-capable engine from config alone; bad dtypes fail loudly."""
+    from torchbooster_tpu.config import ServingConfig, WeightsConfig
+
+    params, cfg = _decisive_model()
+    yml = tmp_path / "s.yml"
+    yml.write_text("page_size: 4\nn_pages: 32\nmax_slots: 2\n"
+                   "weights:\n  dtype: int8\n"
+                   "adapters:\n  rank: 4\n  max_live: 2\n")
+    sc = ServingConfig.load(yml)
+    assert sc.weights.dtype == "int8" and sc.adapters.rank == 4
+    batcher = sc.make(params, cfg, compute_dtype=jnp.float32)
+    eng = batcher.engine
+    # make() quantized the tree before the engine captured it ...
+    assert is_quantized(eng.params)
+    assert weights_dtype(eng.params) == "int8"
+    # ... and wired the adapter lanes alongside it
+    assert eng.lora and eng.lora_rank == 4 and eng.adapters is not None
+    # the configured engine still decodes: parity vs a hand-built one
+    prompt = _repetitive_prompt(np.random.RandomState(7))
+    want = _paged_tokens(_lora_engine(quantize_params(params), cfg),
+                         prompt, 6)
+    assert _paged_tokens(eng, prompt, 6) == want
+    # defaults: bf16 is the identity, rank 0 leaves LoRA dark
+    off = ServingConfig(page_size=4, n_pages=32, max_slots=2)
+    assert off.weights.quantize(params) is params
+    assert off.make(params, cfg).engine.lora is False
+    # an unknown dtype dies in validation, not deep in the kernel
+    with pytest.raises(ValueError, match="dtype"):
+        WeightsConfig(dtype="fp8").quantize(params)

@@ -456,3 +456,40 @@ def test_fleet_validation_loud():
         fleet.submit(Request(prompt=np.arange(1, 60),
                              max_new_tokens=60))
     fleet.finish_session()
+
+
+# ---- adapter affinity dimension (PR 19) ------------------------------
+
+def test_prefix_affinity_key_adapter_dimension():
+    """The adapter is a SECOND affinity dimension folded over the
+    page-aligned prefix key: adapter-less keys stay byte-identical to
+    the pre-adapter router, same prefix + different adapters key
+    apart (each adapter's lane stays warm on its own replica), and a
+    sub-page prompt WITH an adapter still keys — by the adapter
+    alone."""
+    import zlib
+
+    from torchbooster_tpu.serving.router.routing import (
+        prefix_affinity_key)
+
+    rs = np.random.RandomState(0)
+    prompt = rs.randint(0, 97, 11).astype(np.int32)   # 2 full pages
+    base = prefix_affinity_key(prompt, 4, 2)
+    # adapter-less: exactly the pre-adapter crc over the page prefix
+    assert base == zlib.crc32(
+        np.ascontiguousarray(prompt[:8]).tobytes()) & 0xFFFFFFFF
+    assert prefix_affinity_key(prompt, 4, 2, adapter="") == base
+    ka = prefix_affinity_key(prompt, 4, 2, adapter="fr")
+    kb = prefix_affinity_key(prompt, 4, 2, adapter="de")
+    assert len({base, ka, kb}) == 3           # adapters key apart
+    # same (prefix, adapter) on a different tail: same key
+    other = np.concatenate([prompt[:8],
+                            rs.randint(0, 97, 3).astype(np.int32)])
+    assert prefix_affinity_key(other, 4, 2, adapter="fr") == ka
+    # sub-page prompts: keyless without an adapter, keyed WITH one
+    short = prompt[:3]
+    assert prefix_affinity_key(short, 4, 2) is None
+    ks = prefix_affinity_key(short, 4, 2, adapter="fr")
+    assert ks is not None
+    assert ks == prefix_affinity_key(prompt[:2], 4, 2, adapter="fr")
+    assert ks != ka

@@ -524,7 +524,7 @@ def test_workload_v3_response_format_roundtrip_and_v2(tmp_path):
     assert wl(rf, EOS).fingerprint() == constrained.fingerprint()
     path = constrained.save(tmp_path / "w.jsonl")
     header = json.loads(path.read_text().splitlines()[0])
-    assert header["version"] == 3
+    assert header["version"] == 4    # the PR 19 adapter field's bump
     loaded = Workload.load(path)
     assert loaded.requests[0].response_format == rf
     assert loaded.fingerprint() == constrained.fingerprint()

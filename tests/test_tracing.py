@@ -330,6 +330,8 @@ _STABLE_KEYS = {
     "n_structured", "structured_masked_frac",
     "n_shed", "n_cancelled",
     "deadline_hit_rate", "classes",
+    "n_adapter_loads", "n_adapter_evictions", "n_adapter_hits",
+    "adapters",
 }
 
 

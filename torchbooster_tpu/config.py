@@ -763,6 +763,82 @@ class StructuredConfig(BaseConfig):
 
 
 @dataclass
+class WeightsConfig(BaseConfig):
+    """Quantized weight serving (models/quant.py), nested under
+    ``serving:`` as its ``weights:`` sub-block. No reference analogue
+    — this narrows the decode roofline's WEIGHT stream the way
+    ``cache_dtype: int8`` narrowed the KV stream.
+
+    YAML block::
+
+        serving:
+          weights:
+            dtype: int8        # bf16 (off) | int8 | int4
+            group_size: 64     # int4 input-axis scale group
+
+    ``dtype: int8`` quantizes every block dense kernel per-output-
+    channel (symmetric absmax, scales factored out of the dot) and
+    the embedding table per-row at engine build time — ONE host-side
+    pass, then every compiled step streams 1 byte per weight and
+    widens inside the matmul's operand read; greedy decode stays
+    token-identical in practice (the serve_wq bench gates int8 on
+    exact parity). ``dtype: int4`` packs two values per byte with
+    per-``group_size``-input-rows scales — 0.5 byte/elem at a real
+    (bounded, documented) rounding cost; ``group_size`` must be even
+    and divide every kernel's input dim. ``bf16`` (the default) is a
+    no-op: params pass through untouched and every compiled artifact
+    is byte-identical to the pre-feature engine. Composes with int8
+    KV, tp sharding (scales shard beside their kernels), speculative
+    verify, and the pallas backend — docs/performance.md "Quantized-
+    weight roofline" has the bytes/step model and crossover.
+    """
+
+    dtype: str = "bf16"                # bf16 (off) | int8 | int4
+    group_size: int = 64               # int4 scale group (input rows)
+
+    def quantize(self, params: Any) -> Any:
+        """Apply this block to a params tree (identity at bf16)."""
+        if self.dtype in ("", "bf16"):
+            return params
+        from torchbooster_tpu.models.quant import quantize_params
+
+        return quantize_params(params, self.dtype,
+                               group_size=self.group_size)
+
+
+@dataclass
+class AdaptersConfig(BaseConfig):
+    """Batched multi-LoRA serving (serving/adapters.py), nested under
+    ``serving:`` as its ``adapters:`` sub-block. No reference
+    analogue — this is the many-tenants-one-pool surface.
+
+    YAML block::
+
+        serving:
+          adapters:
+            rank: 8            # 0 = off; the trace-fixed LoRA rank
+            max_live: 4        # device lanes (concurrent adapters)
+
+    ``rank > 0`` builds the engine with ``max_live + 1`` device
+    adapter LANES (lane 0 = the all-zero base adapter) on the
+    attention projections: requests naming an adapter (the API
+    ``model`` field) decode with its ranked delta gathered per slot
+    each step, so one batch serves many adapters with ZERO
+    recompiles across hot-load/evict churn (lane ids are traced
+    values; the one fixed-shape lane writer compiles once). Register
+    adapter weights at runtime through
+    ``batcher.engine.adapters.register(name, weights)``; unknown
+    names are rejected at submit (HTTP 400). Smaller-rank adapters
+    zero-pad to ``rank``. Off (the default), no lora operand crosses
+    the jit boundary and every compiled artifact is byte-identical
+    to the pre-feature engine.
+    """
+
+    rank: int = 0                      # 0 = off; trace-fixed rank
+    max_live: int = 4                  # device adapter lanes
+
+
+@dataclass
 class RouterHealthConfig(BaseConfig):
     """Per-replica health scoring (serving/router/health.py), nested
     under ``router:`` as its ``health:`` sub-block. No reference
@@ -986,6 +1062,16 @@ class ServingConfig(BaseConfig):
     steps as a trailing value operand (zero recompiles), composing
     with speculative decoding and parallel sampling.
 
+    ``weights:`` (see :class:`WeightsConfig`) serves int8/int4
+    quantized weights: one host-side pass at build time, dequant
+    fused into every compiled matmul's operand read, so the decode
+    roofline's weight stream drops to 1 (or 0.5) byte per element.
+
+    ``adapters:`` (see :class:`AdaptersConfig`) enables batched
+    multi-LoRA decode: concurrently-live adapters stacked on device
+    lanes, gathered per slot by traced lane ids — many tenants on
+    one page pool with zero recompiles across adapter churn.
+
     ``decode_backend: pallas`` swaps the decode/verify pool READ for
     the paged flash-decode kernel (ops/paged_attention.py): block
     tables walked in-kernel, so bytes/step are the live context
@@ -1030,6 +1116,10 @@ class ServingConfig(BaseConfig):
         default_factory=HostSpillConfig)  # host-RAM page spill tier
     structured: StructuredConfig = dataclasses.field(
         default_factory=StructuredConfig)  # constrained decoding
+    weights: WeightsConfig = dataclasses.field(
+        default_factory=WeightsConfig)  # int8/int4 weight serving
+    adapters: AdaptersConfig = dataclasses.field(
+        default_factory=AdaptersConfig)  # batched multi-LoRA lanes
 
     def make(self, params: Any, model_cfg: Any,
              compute_dtype: Any = None,
@@ -1064,6 +1154,11 @@ class ServingConfig(BaseConfig):
         # arrives without a committed mesh must fail HERE, with the
         # numbers, not as a shard_map shape error mid-build
         check_tp(self.tp, model_cfg, mesh)
+        # ONE host-side quantization pass, BEFORE any engine is built
+        # (and therefore before the engine's tp-major permute — the
+        # permute moves qkernel/qscale columns like any other layout
+        # fact); every replica shares the quantized tree
+        params = self.weights.quantize(params)
         n_replicas = self.router.n_replicas
         if n_replicas < 1:
             raise ValueError(
@@ -1097,6 +1192,9 @@ class ServingConfig(BaseConfig):
                 host_spill=self.host_spill.enabled,
                 host_spill_mb=self.host_spill.budget_mb,
                 structured=self.structured.enabled,
+                lora_rank=self.adapters.rank,
+                lora_max_live=(self.adapters.max_live
+                               if self.adapters.rank > 0 else 0),
                 tp=self.tp, mesh=mesh)
 
         # ONE policy object serves every replica AND the fleet-level

@@ -27,6 +27,10 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from torchbooster_tpu.models import layers as L
+from torchbooster_tpu.models.quant import (
+    dequant_kernel as _dequant_kernel,
+    qmatmul as _qmatmul,
+)
 from torchbooster_tpu.models.torch_interop import to_numpy as _np
 from torchbooster_tpu.ops.attention import attention
 
@@ -337,10 +341,19 @@ class GPT:
     def head_table(params: dict) -> jax.Array:
         """(vocab, d) output-projection table — the ``table`` argument
         of :func:`~torchbooster_tpu.ops.losses.lm_head_cross_entropy`
-        (tied: the wte table; untied: the head kernel transposed)."""
+        (tied: the wte table; untied: the head kernel transposed).
+        Quantized trees (models/quant.py) reconstruct full precision
+        here — an offline/loss-side consumer, never the decode hot
+        path."""
         if "head" in params:
-            return params["head"]["kernel"].T
-        return params["wte"]["table"]
+            hp = params["head"]
+            if "qkernel" in hp:
+                return _dequant_kernel(hp).T
+            return hp["kernel"].T
+        wte = params["wte"]
+        if "qtable" in wte:
+            return wte["qtable"].astype(jnp.float32) * wte["qscale"]
+        return wte["table"]
 
 
 def _check_pos(params: dict, cfg: GPTConfig,
@@ -487,15 +500,30 @@ def qkv_to_tp_major(params: dict, cfg: GPTConfig, tp_size: int,
     if inverse:
         perm = onp.argsort(perm)
     qkv = params["blocks"]["attn_qkv"]
-    new_qkv = {"kernel": jnp.take(qkv["kernel"], perm, axis=2)}
+    # column-layout leaves permute together: the full-precision kernel
+    # OR the quantized pair (models/quant.py) — qkernel's out axis is
+    # 2 in both formats (int4 packs along the INPUT axis, so the
+    # column permute never crosses a packed byte) and qscale's out
+    # axis is 2 for both the per-channel (L, 1, out) and per-group
+    # (L, G, out) shapes
+    new_qkv = {k: v for k, v in qkv.items()
+               if k not in ("kernel", "qkernel", "qscale", "bias")
+               and not k.startswith(_TP_MAJOR_PREFIX)}
+    for key in ("kernel", "qkernel", "qscale"):
+        if key in qkv:
+            new_qkv[key] = jnp.take(qkv[key], perm, axis=2)
     if "bias" in qkv:
         new_qkv["bias"] = jnp.take(qkv["bias"], perm, axis=1)
     if not inverse:
         # stacked (n_layers,) zeros: scans/shards/checkpoints like any
         # block leaf, and the tp size rides in the KEY so optimizer
         # updates to the value cannot erase the layout fact
+        ref = qkv.get("kernel", qkv.get("qkernel"))
+        mark_dt = ref.dtype if jnp.issubdtype(ref.dtype,
+                                              jnp.floating) \
+            else jnp.float32
         new_qkv[f"{_TP_MAJOR_PREFIX}{tp_size}"] = jnp.zeros(
-            (qkv["kernel"].shape[0],), qkv["kernel"].dtype)
+            (ref.shape[0],), mark_dt)
     return {**params,
             "blocks": {**params["blocks"], "attn_qkv": new_qkv}}
 
@@ -739,12 +767,25 @@ def _dropout(x: jax.Array, rate: float,
     return jnp.where(mask, x / keep, 0.0).astype(x.dtype)
 
 
-def _row_dense(params: dict, x: jax.Array, reduce) -> jax.Array:
+def _row_dense(params: dict, x: jax.Array, reduce,
+               delta: jax.Array | None = None) -> jax.Array:
     """Row-parallel dense: ``reduce`` (a psum over the tp axis, or
     identity) runs BETWEEN the matmul and the bias add — each device
     holds a row slice of the kernel, so partial products sum across
-    devices while the (replicated) bias is added exactly once."""
-    y = reduce(x @ params["kernel"].astype(x.dtype))
+    devices while the (replicated) bias is added exactly once.
+    Quantized kernels (``qkernel``, models/quant.py) dequantize inside
+    the matmul read; the int8 per-output-channel scale is replicated
+    across row shards, so scaling before the psum is exact. ``delta``
+    (the LoRA ranked product, serving) adds to the PARTIAL products —
+    its own A-factor is row-sliced like the kernel, so it rides the
+    same single psum."""
+    if "qkernel" in params:
+        y = _qmatmul(params, x)
+    else:
+        y = x @ params["kernel"].astype(x.dtype)
+    if delta is not None:
+        y = y + delta
+    y = reduce(y)
     if "bias" in params:
         y = y + params["bias"].astype(x.dtype)
     return y
@@ -758,7 +799,8 @@ def _block_core(bp: dict, x: jax.Array, cfg: GPTConfig, attend,
                 dropout_key: jax.Array | None = None,
                 tp: tuple[str, int] | None = None,
                 tp_attn: tuple[str, int] | None = None,
-                ep: tuple[str, int] | None = None
+                ep: tuple[str, int] | None = None,
+                lora: tuple | None = None
                 ) -> tuple[jax.Array, jax.Array, Any]:
     """The transformer block math, shared by every path (training
     forward, prefill, cached decode) so they cannot drift apart.
@@ -782,6 +824,20 @@ def _block_core(bp: dict, x: jax.Array, cfg: GPTConfig, attend,
     ``ep=(axis, size)``: MANUAL expert parallelism — bp's expert
     tensors hold this rank's slice (``moe_apply(ep=...)``). The
     auto-SPMD paths leave both None and let XLA place the collectives.
+    ``lora=((a_qkv, b_qkv, a_proj, b_proj), lane_ids)``: batched
+    multi-adapter LoRA deltas (serving/adapters.py) — this LAYER's
+    adapter stacks ``(lanes, d, r)`` / ``(lanes, r, qkv_out)`` /
+    ``(lanes, d, r)`` / ``(lanes, r, d)`` plus the per-row lane ids
+    ``(B,)`` (lane 0 = the all-zero base adapter). Each row's ranked
+    products ``h @ A[g] @ B[g]`` add to the qkv and O projections;
+    everything is a traced VALUE gather, so adapter churn never
+    recompiles. Under ``tp_attn`` the stacks arrive FULL (replicated
+    host operands): ``b_qkv`` (rank-major-permuted columns, the
+    registry's load-time layout) and ``a_proj`` rows slice to this
+    rank's shard at ``axis_index``, so the qkv delta lands on the
+    local columns and the proj delta rides the partial products
+    through the ONE existing psum. Serving layouts only — the
+    training ``tp`` path rejects it.
     Returns (x, aux_loss, extras)."""
     b, s, d = x.shape
     n_heads, kv_heads = cfg.n_heads, cfg.kv_heads
@@ -791,6 +847,11 @@ def _block_core(bp: dict, x: jax.Array, cfg: GPTConfig, attend,
     if tp is not None and tp_attn is not None:
         raise ValueError("_block_core: tp and tp_attn are mutually "
                          "exclusive manual-parallelism modes")
+    if lora is not None and tp is not None:
+        raise ValueError(
+            "_block_core: lora rides the serving layouts (single-chip "
+            "or tp_attn) — the training tp path shards the MLP too "
+            "and has no adapter surface")
     if tp is not None:
         tp_axis, tp_size = tp
         n_heads //= tp_size
@@ -807,6 +868,24 @@ def _block_core(bp: dict, x: jax.Array, cfg: GPTConfig, attend,
 
     h = L.layer_norm(bp["ln1"], x)
     qkv = L.dense(bp["attn_qkv"], h)
+    la_p = lb_p = lane_ids = None
+    if lora is not None:
+        (la_q, lb_q, la_p, lb_p), lane_ids = lora
+        if tp_attn is not None:
+            # full replicated stacks -> this rank's shard: b_qkv's
+            # columns are rank-major (the registry permuted them at
+            # load time to match qkv_to_tp_major's layout), a_proj's
+            # input rows follow the local heads
+            i = jax.lax.axis_index(tp_axis)
+            w_loc = qkv.shape[-1]
+            lb_q = jax.lax.dynamic_slice_in_dim(
+                lb_q, i * w_loc, w_loc, axis=2)
+            la_p = jax.lax.dynamic_slice_in_dim(
+                la_p, i * q_width, q_width, axis=1)
+        dq = jnp.einsum("bsd,bdr->bsr", h,
+                        la_q[lane_ids].astype(h.dtype))
+        qkv = qkv + jnp.einsum("bsr,bro->bso", dq,
+                               lb_q[lane_ids].astype(h.dtype))
     q = qkv[..., :q_width].reshape(b, s, n_heads, head_dim)
     kv_dim = kv_heads * head_dim
     k = qkv[..., q_width:q_width + kv_dim].reshape(b, s, kv_heads,
@@ -822,9 +901,16 @@ def _block_core(bp: dict, x: jax.Array, cfg: GPTConfig, attend,
     else:
         k_attn = k_mlp = None
     o, extras = attend(q, k, v)
+    o_flat = o.reshape(b, s, q_width)
+    proj_delta = None
+    if lora is not None:
+        dp = jnp.einsum("bsd,bdr->bsr", o_flat,
+                        la_p[lane_ids].astype(o_flat.dtype))
+        proj_delta = jnp.einsum("bsr,bro->bso", dp,
+                                lb_p[lane_ids].astype(o_flat.dtype))
     x = constrain(x + _dropout(
-        _row_dense(bp["attn_proj"], o.reshape(b, s, q_width),
-                   attn_reduce),
+        _row_dense(bp["attn_proj"], o_flat, attn_reduce,
+                   delta=proj_delta),
         dropout, k_attn))
     h = L.layer_norm(bp["ln2"], x)
     if cfg.n_experts > 0:
@@ -1001,7 +1087,15 @@ def _lm_head(params: dict, x: jax.Array) -> jax.Array:
     x = L.layer_norm(params["ln_f"], x)
     if "head" in params:
         return L.dense(params["head"], x)
-    return x @ params["wte"]["table"].astype(x.dtype).T
+    wte = params["wte"]
+    if "qtable" in wte:
+        # tied head over the per-row int8 table: the dot streams the
+        # 1-byte rows and each row's scale lands on the VOCAB axis of
+        # the logits — the transposed analogue of qmatmul's
+        # factored-out per-output-channel scale (models/quant.py)
+        y = x @ wte["qtable"].astype(x.dtype).T
+        return y * wte["qscale"][:, 0].astype(x.dtype)
+    return x @ wte["table"].astype(x.dtype).T
 
 
 def _mask_logits(logits: jax.Array, mask: jax.Array | None

@@ -58,7 +58,16 @@ def dense_init(rng: jax.Array, din: int, dout: int, use_bias: bool = True,
 
 
 def dense(params: dict, x: jax.Array) -> jax.Array:
-    y = x @ params["kernel"].astype(x.dtype)
+    if "qkernel" in params:
+        # quantized weight serving (models/quant.py): the kernel
+        # streams 1 byte/elem (0.5 packed int4) and widens inside the
+        # dot's operand read — the same fused-convert contract as the
+        # int8 KV pages
+        from torchbooster_tpu.models.quant import qmatmul
+
+        y = qmatmul(params, x)
+    else:
+        y = x @ params["kernel"].astype(x.dtype)
     if "bias" in params:
         y = y + params["bias"].astype(x.dtype)
     return y
@@ -231,6 +240,13 @@ def embedding_init(rng: jax.Array, vocab: int, dim: int, std: float = 0.02,
 
 def embedding(params: dict, ids: jax.Array,
               dtype: Any = None) -> jax.Array:
+    if "qtable" in params:
+        # per-row int8 table (models/quant.py): gather the narrow
+        # rows and their scales, dequantize only the gathered handful
+        rows = jnp.take(params["qtable"], ids, axis=0)
+        scales = jnp.take(params["qscale"], ids, axis=0)
+        out = rows.astype(jnp.float32) * scales
+        return out.astype(dtype) if dtype is not None else out
     table = params["table"]
     if dtype is not None:
         table = table.astype(dtype)

@@ -83,6 +83,11 @@ _DTYPE = np.dtype([
     # stall post-mortem must show how much of the batch was
     # constrained when the step ran
     ("structured", np.int16),
+    # live slots decoding through a non-zero LoRA adapter lane
+    # (batched multi-adapter serving): per-tenant attribution in the
+    # post-mortem — a stall with 7/8 slots on adapters reads very
+    # differently from one on pure base-model traffic
+    ("adapters", np.int16),
 ])
 
 # watchdog cadence/thresholds: p99 refresh interval (records), minimum
@@ -131,7 +136,7 @@ class FlightRecorder:
                wall_s: float, recompiled: bool = False,
                inflight: Iterable[str] = (), tp: int = 1,
                branches: int = 0, structured: int = 0,
-               pages_host: int = 0,
+               adapters: int = 0, pages_host: int = 0,
                spills: int = 0, promotions: int = 0,
                host_hit_pages: int = 0) -> None:
         """Write one step record in place and run the watchdog."""
@@ -156,6 +161,7 @@ class FlightRecorder:
         row["tp"] = tp
         row["branches"] = branches
         row["structured"] = structured
+        row["adapters"] = adapters
         self._seq = seq + 1
         if recompiled:
             self._anomalies.append({

@@ -64,6 +64,7 @@ PagedEngine` (or via ``ServingConfig.make`` from YAML), wrap it in a
 it :class:`~torchbooster_tpu.serving.batcher.Request`s — or serve it
 over HTTP with ``ServingConfig.frontend.make(batcher)``.
 """
+from torchbooster_tpu.serving.adapters import AdapterRegistry
 from torchbooster_tpu.serving.batcher import ContinuousBatcher, Request
 from torchbooster_tpu.serving.engine import PagedEngine
 from torchbooster_tpu.serving.frontend import (
@@ -102,7 +103,8 @@ def __getattr__(name: str):
         f"module {__name__!r} has no attribute {name!r}")
 
 
-__all__ = ["AffinityRouting", "BlockTables", "ContinuousBatcher",
+__all__ = ["AdapterRegistry", "AffinityRouting", "BlockTables",
+           "ContinuousBatcher",
            "EngineFleet", "FCFSPolicy", "HostPagePool",
            "InProcessReplica", "NO_DRAFT", "NULL_PAGE", "PagedEngine",
            "PrefixDirectory", "PriorityClass", "PromptLookupDrafter",

@@ -140,6 +140,15 @@ class Request:
     # structured generation: an OpenAI response_format object (None =
     # unconstrained, same as {"type": "text"})
     response_format: dict | None = None
+    # multi-LoRA serving: the adapter NAME this request decodes
+    # through (the HTTP surface's ``model`` field; "" = the base
+    # model). Validated at submit against the engine's registry — an
+    # unknown name is a 400 before any pages move. The batcher
+    # acquires a registry pin at seat time and releases it on every
+    # retire path; a preempted request re-acquires on re-seat
+    # (possibly a different device lane — lanes are traced values,
+    # so nothing recompiles).
+    adapter: str = ""
     # stable identity for tracing and the HTTP surface: auto-generated
     # when empty; the front door honors a client X-Request-Id header
     # by passing it through here
@@ -188,6 +197,11 @@ class Request:
             raise TypeError(
                 f"request_id must be a str ('' = auto-generate), got "
                 f"{type(self.request_id).__name__}")
+        if not isinstance(self.adapter, str):
+            raise TypeError(
+                f"adapter must be a registered adapter NAME (str, "
+                f"'' = base model), got "
+                f"{type(self.adapter).__name__} {self.adapter!r}")
         if not isinstance(self.n, int) or self.n < 1:
             raise ValueError(f"n must be an int >= 1, got {self.n!r}")
         if self.best_of is not None and (
@@ -283,6 +297,15 @@ class _Session:
         self.structured0 = eng.structured_requests
         self.smasked0 = eng.structured_masked_sum
         self.srows0 = eng.structured_masked_rows
+        # per-tenant (adapter) attribution: terminal-event token/
+        # request tallies keyed by adapter name ("" = base), plus the
+        # registry's load/evict/hit counter baselines — all zero/empty
+        # on a lora-less engine
+        self.per_adapter: dict[str, dict] = {}
+        ad = eng.adapters
+        self.aloads0 = ad.loads if ad is not None else 0
+        self.aevict0 = ad.evictions if ad is not None else 0
+        self.ahits0 = ad.hits if ad is not None else 0
         self.closed = False
 
     def sample(self, series: list[float], value: float) -> None:
@@ -443,6 +466,21 @@ class ContinuousBatcher:
                         "the schema can emit — the EOS bit would "
                         "shadow a legal content token; pick an EOS "
                         "id outside the schema alphabet")
+        if req.adapter:
+            # the multi-LoRA 400 surface: an unknown adapter name (or
+            # any adapter at all on a lora-less engine) fails at
+            # submit, before any pages move — the seat-time acquire
+            # can then only ever fail on PIN pressure (backpressure,
+            # not an error)
+            if not self.engine.lora:
+                raise ValueError(
+                    f"request names adapter {req.adapter!r} but the "
+                    "engine has no LoRA lanes: set serving.adapters."
+                    "rank > 0")
+            if not self.engine.adapters.known(req.adapter):
+                raise ValueError(
+                    f"unknown adapter {req.adapter!r} — registered: "
+                    f"{self.engine.adapters.names}")
 
     def est_ttft_s(self, req: Request) -> float:
         """Estimated seconds from now to ``req``'s first token were it
@@ -576,6 +614,10 @@ class ContinuousBatcher:
         for slot, req in seated:
             if retire_seated:
                 self.engine.retire(slot)
+            # the registry is HOST bookkeeping on this batcher's
+            # engine: drop the pin even when the (dead) engine isn't
+            # retired, so refcounts stay balanced either way
+            self._release_adapter(req)
             folded = len(req.prompt) - req.base_len
             if self.tracer.enabled:
                 self.tracer.emit(req.request_id, "drained", slot=slot,
@@ -749,6 +791,26 @@ class ContinuousBatcher:
             inst["host_hits"] = reg.counter(
                 "serving_host_hit_pages_total",
                 "prompt pages matched in the host spill tier")
+        if self.engine.lora:
+            # multi-LoRA serving only (absent with lora off so the
+            # single-tenant registry view is untouched): billing-grade
+            # per-tenant attribution (labels adapter; "base" is
+            # un-adaptered traffic) plus the registry's lane churn —
+            # host integer adds at terminal events, never a device
+            # read
+            inst["adapter_tokens"] = reg.counter(
+                "serving_adapter_tokens_total",
+                "tokens delivered per adapter name (per-tenant "
+                "billing attribution)")
+            inst["adapter_reqs"] = reg.counter(
+                "serving_adapter_requests_total",
+                "requests reaching a terminal state per adapter name")
+            inst["adapter_loads"] = reg.counter(
+                "serving_adapter_loads_total",
+                "adapter lane hot-loads (cold load or refresh)")
+            inst["adapter_evictions"] = reg.counter(
+                "serving_adapter_evictions_total",
+                "cached adapter lanes displaced (LRU)")
         if self.engine.tp > 1:
             # tensor-parallel serving only (absent at tp=1 so the
             # single-chip registry view is untouched): the modeled
@@ -839,6 +901,33 @@ class ContinuousBatcher:
         name = self.policy.cls_of(req).name
         return self._s.per_class[name]
 
+    def _release_adapter(self, req: Request) -> None:
+        """Drop the request's registry pin — exactly one per SEATED
+        slot (fork branches each pin at fork time), so every path
+        that retires a seated slot funnels through here exactly once;
+        queued-only exits (shed, queued cancel) never acquired."""
+        if req.adapter:
+            self.engine.adapters.release(req.adapter)
+
+    def _account_adapter(self, req: Request) -> None:
+        """Per-tenant attribution at a request's TERMINAL event
+        (finish/cancel/shed): tokens delivered and requests closed
+        under each adapter name ('' = base). Feeds the
+        ``serving_adapter_*`` families and ``_metrics()['adapters']``
+        — absent entirely on a lora-less engine so the single-tenant
+        view is untouched."""
+        if not self.engine.lora:
+            return
+        ad = self._s.per_adapter.setdefault(
+            req.adapter, {"n_requests": 0, "new_tokens": 0})
+        ad["n_requests"] += 1
+        ad["new_tokens"] += len(req.tokens)
+        label = req.adapter or "base"
+        self._inst["adapter_reqs"].inc(adapter=label)
+        if req.tokens:
+            self._inst["adapter_tokens"].inc(len(req.tokens),
+                                             adapter=label)
+
     def _finish_request(self, slot: int) -> None:
         s, inst = self._s, self._inst
         req = s.live.pop(slot)
@@ -846,12 +935,14 @@ class ContinuousBatcher:
         req.finished_at = self.clock() - s.t0
         inst["retired"].inc()
         s.new_tokens += len(req.tokens)
+        self._account_adapter(req)
         s.sample(s.lat, req.finished_at - req.arrival)
         inst["lat"].observe(req.finished_at - req.arrival)
         if req.first_token_at is not None:
             s.sample(s.ttft, req.first_token_at - req.arrival)
             inst["ttft"].observe(req.first_token_at - req.arrival)
         self.engine.retire(slot)
+        self._release_adapter(req)
         if self.tracer.enabled:
             self.tracer.emit(req.request_id, "retired",
                              reason=req.finish_reason or "",
@@ -937,6 +1028,7 @@ class ContinuousBatcher:
                              n_tokens=len(req.tokens))
         s.n_cancelled += 1
         s.new_tokens += len(req.tokens)  # delivered before the cancel
+        self._account_adapter(req)       # delivered tokens are billed
         events.append((req, []))
         cs = self._class_stats(req)
         if cs is not None:
@@ -968,6 +1060,7 @@ class ContinuousBatcher:
                         table.pop(slot)
                         s.admit_order.remove(slot)
                         self.engine.retire(slot)
+                        self._release_adapter(req)
                         self._cancel_request(req, events)
                         break
 
@@ -981,6 +1074,7 @@ class ContinuousBatcher:
                              waited_s=round(req.finished_at
                                             - req.arrival, 6))
         s.n_shed += 1
+        self._account_adapter(req)       # terminal: 0 tokens billed
         events.append((req, []))
         cs = self._class_stats(req)
         if cs is not None:
@@ -1006,6 +1100,11 @@ class ContinuousBatcher:
                else s.filling.pop(victim))
         s.admit_order.remove(victim)
         self.engine.retire(victim)
+        # the victim's adapter pin drops with its seat (NOT a
+        # terminal event — no billing): its lane may be evicted while
+        # it queues, and the re-seat re-acquires whatever lane the
+        # registry then lands it on
+        self._release_adapter(req)
         # fold generated tokens into the prompt so it resumes
         # from its full context on re-admission — only the
         # NOT-yet-folded suffix: a second preemption would
@@ -1059,10 +1158,18 @@ class ContinuousBatcher:
                 priority=req.priority, deadline_ms=req.deadline_ms,
                 arrival_time=req.arrival_time,
                 request_id=f"{req.request_id}#{b}", seed=req.seed,
-                response_format=req.response_format)
+                response_format=req.response_format,
+                adapter=req.adapter)
             child.parent = req
             child.branch = b
             child.admitted_at = req.admitted_at
+            if child.adapter:
+                # one pin per SEATED slot: the sibling pins the
+                # (necessarily resident — the parent holds a pin)
+                # lane the engine's fork just copied into its slot,
+                # so every retire path releases uniformly and a
+                # preempted sibling re-acquires alone
+                self.engine.adapters.acquire(child.adapter)
             s.live[sb] = child
             s.admit_order.append(sb)
             family.append(child)
@@ -1148,7 +1255,8 @@ class ContinuousBatcher:
                           if recompiled else ()),
                 tp=eng.tp,
                 branches=eng.branch_slot_count,
-                structured=eng.structured_slot_count)
+                structured=eng.structured_slot_count,
+                adapters=eng.adapter_slot_count)
         return events
 
     def _step_body(self, s: _Session, st: dict,
@@ -1194,8 +1302,23 @@ class ContinuousBatcher:
             if self._free_slot_count() - self._reserved_slots() < need:
                 slot = None
             else:
-                slot = self.engine.admit_begin(
-                    req.prompt, seed=req.seed, branch=req.branch)
+                # adapter pin BEFORE the engine seat: acquire returns
+                # None when every lane is pinned by seated slots —
+                # the same keep-it-queued backpressure as pool
+                # exhaustion (never an error; unknown names already
+                # 400'd at submit). A seat that fails AFTER the
+                # acquire must drop the pin, or the lane leaks pinned
+                # forever.
+                lane = (self.engine.adapters.acquire(req.adapter)
+                        if req.adapter else 0)
+                if lane is None:
+                    slot = None
+                else:
+                    slot = self.engine.admit_begin(
+                        req.prompt, seed=req.seed, branch=req.branch,
+                        adapter_lane=lane)
+                    if slot is None and req.adapter:
+                        self.engine.adapters.release(req.adapter)
             if slot is None:
                 if self.policy.stop_on_admit_failure:
                     break         # no slot/pages: keep FCFS order
@@ -1221,7 +1344,12 @@ class ContinuousBatcher:
                     req.request_id, "seated", slot=slot,
                     prefix_hit_pages=int(
                         self.engine.prefix_hit_pages - hits0),
-                    readmission=req.admitted_at is not None)
+                    readmission=req.admitted_at is not None,
+                    # adapter attribution only when one is in play:
+                    # base-traffic event payloads stay byte-identical
+                    # with the feature off
+                    **({"adapter": req.adapter} if req.adapter
+                       else {}))
             if req.admitted_at is None:
                 req.admitted_at = now()
         # --- ONE prefill chunk per iteration, interleaved with
@@ -1416,6 +1544,7 @@ class ContinuousBatcher:
             d = {
                 "request_id": req.request_id, "state": state,
                 "priority": req.priority,
+                "adapter": req.adapter,
                 "prompt_len": int(req.base_len),
                 "n_tokens": len(req.tokens),
                 "arrival_s": round(req.arrival, 6),
@@ -1481,6 +1610,10 @@ class ContinuousBatcher:
                 self.engine.promotions - s.promotions0)
             inst["host_hits"].inc(
                 self.engine.host_hit_pages - s.host_hits0)
+        if "adapter_loads" in inst:
+            ad = self.engine.adapters
+            inst["adapter_loads"].inc(ad.loads - s.aloads0)
+            inst["adapter_evictions"].inc(ad.evictions - s.aevict0)
         if self.policy.slo:
             for name, cs in s.per_class.items():
                 inst["slo_ttft_rate"].set(
@@ -1578,6 +1711,21 @@ class ContinuousBatcher:
                 (self.engine.structured_masked_sum - s.smasked0)
                 / max(self.engine.structured_masked_rows - s.srows0,
                       1), 4),
+            # multi-LoRA serving (all zero/empty on a lora-less
+            # engine): per-tenant billing attribution — terminal
+            # requests and delivered tokens keyed by adapter name
+            # ("" = base) — plus the registry's lane churn
+            "n_adapter_loads": (
+                self.engine.adapters.loads - s.aloads0
+                if self.engine.adapters is not None else 0),
+            "n_adapter_evictions": (
+                self.engine.adapters.evictions - s.aevict0
+                if self.engine.adapters is not None else 0),
+            "n_adapter_hits": (
+                self.engine.adapters.hits - s.ahits0
+                if self.engine.adapters is not None else 0),
+            "adapters": {name: dict(ad) for name, ad
+                         in sorted(s.per_adapter.items())},
             # SLO scheduler stats — stable keys on EVERY return path
             # (the established contract): zero/empty under FCFS,
             # populated per configured class under an SLO policy
@@ -1608,6 +1756,8 @@ class ContinuousBatcher:
                     "spec_mean_accepted": 0.0,
                     "n_forks": 0, "fork_pages": 0, "n_cow_copies": 0,
                     "n_structured": 0, "structured_masked_frac": 0.0,
+                    "n_adapter_loads": 0, "n_adapter_evictions": 0,
+                    "n_adapter_hits": 0, "adapters": {},
                     "n_shed": 0, "n_cancelled": 0,
                     "deadline_hit_rate": 1.0, "classes": {
                         name: {"n_requests": 0, "n_completed": 0,

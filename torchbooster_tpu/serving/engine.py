@@ -62,6 +62,10 @@ import numpy as np
 
 from torchbooster_tpu.models import layers as L
 from torchbooster_tpu.observability import span
+from torchbooster_tpu.models.quant import (
+    weight_stream_bytes as _weight_stream_bytes,
+    weights_dtype as _weights_dtype,
+)
 from torchbooster_tpu.models.gpt import (
     GPTConfig,
     _block_core,
@@ -76,6 +80,7 @@ from torchbooster_tpu.models.gpt import (
     qkv_to_tp_major,
 )
 from torchbooster_tpu.ops.paged_attention import paged_attention
+from torchbooster_tpu.serving.adapters import AdapterRegistry
 from torchbooster_tpu.serving.kv_pages import (
     NULL_PAGE,
     BlockTables,
@@ -235,7 +240,9 @@ class PagedEngine:
                  host_spill: bool = False,
                  host_spill_mb: float = 64.0,
                  structured: bool = False,
-                 structured_vocab: Any = None):
+                 structured_vocab: Any = None,
+                 lora_rank: int = 0,
+                 lora_max_live: int = 0):
         if cfg.seq_len % page_size:
             # a last partial page per slot would shift page_pos math;
             # geometry is static, so fail loudly at construction
@@ -453,6 +460,61 @@ class PagedEngine:
                 # spec step and filled per constrained slot
                 self._smask_verify = np.ones(
                     (max_slots, 1 + draft_len, cfg.vocab), bool)
+        # batched multi-LoRA decode (serving/adapters.py): adapters
+        # live STACKED on a device lane axis (lane 0 = the all-zero
+        # base adapter) and every compiled step gathers each slot's
+        # lane by a traced per-slot id operand — adapter churn
+        # (hot-load/evict/mixed batches) moves VALUES, never shapes,
+        # so the zero-recompile contract holds; off (the default) no
+        # lora operand crosses the jit boundary and every call
+        # signature is byte-identical to the pre-feature engine (the
+        # same collapse contract as the structured mask)
+        if (lora_rank > 0) != (lora_max_live > 0):
+            raise ValueError(
+                f"lora_rank={lora_rank} with lora_max_live="
+                f"{lora_max_live}: enable batched LoRA with BOTH "
+                "positive — rank and lane count are trace SHAPES, "
+                "half a configuration cannot compile")
+        self.lora = lora_rank > 0
+        self.lora_rank = int(lora_rank)
+        self.lora_max_live = int(lora_max_live)
+        self._slot_lanes = np.zeros(max_slots, np.int32)
+        self._lora_buf = None
+        self._lora_load_jit = None
+        self.adapters = None
+        if self.lora:
+            lanes = self.lora_max_live + 1
+            d = cfg.d_model
+            qkv_out = d + 2 * cfg.kv_heads * (d // cfg.n_heads)
+            shapes = {
+                "a_qkv": (cfg.n_layers, lanes, d, self.lora_rank),
+                "b_qkv": (cfg.n_layers, lanes, self.lora_rank,
+                          qkv_out),
+                "a_proj": (cfg.n_layers, lanes, d, self.lora_rank),
+                "b_proj": (cfg.n_layers, lanes, self.lora_rank, d),
+            }
+            buf = {k: jnp.zeros(s, compute_dtype)
+                   for k, s in shapes.items()}
+            if self.tp > 1:
+                # replicated beside the head-sharded attention they
+                # delta: _block_core slices B_qkv's columns and
+                # A_proj's rows to each rank's shard in-step, so the
+                # qkv delta lands on local columns and the proj delta
+                # is a true partial product riding the ONE existing
+                # psum — replication adds zero collectives
+                from jax.sharding import NamedSharding
+                from torchbooster_tpu.serving.tp import REP
+                rep_ns = NamedSharding(mesh, REP)
+                buf = {k: jax.device_put(v, rep_ns)
+                       for k, v in buf.items()}
+                self._lora_load_jit = jax.jit(
+                    self._lora_write_fn, donate_argnums=(0,),
+                    out_shardings=rep_ns)
+            else:
+                self._lora_load_jit = jax.jit(
+                    self._lora_write_fn, donate_argnums=(0,))
+            self._lora_buf = buf
+            self.adapters = AdapterRegistry(self)
         # the pool crosses the jit boundary EVERY call — donate it so
         # XLA updates the pages in place; an undonated pool would copy
         # pool-sized bytes per step, re-taxing exactly the HBM traffic
@@ -470,16 +532,19 @@ class PagedEngine:
         # structured mode threads one replicated legality-mask operand
         # into the chunk, decode, and verify signatures
         n_struct = 1 if self.structured else 0
+        # lora threads five trailing operands (four adapter stacks +
+        # the per-slot lane ids) into all three signatures
+        n_lora = 5 if self.lora else 0
         self._branch_pick = _make_branch_pick(
             temperature, top_k, top_p, jnp.int32)
         if self.tp > 1:
             pspecs = _tp_param_specs(self.params)
             self._chunk_jit = _shard_engine_fn(
-                self._chunk_fn, mesh, pspecs, 5 + n_struct,
+                self._chunk_fn, mesh, pspecs, 5 + n_struct + n_lora,
                 3 if self.parallel else 1)
             self._decode_jit = _shard_engine_fn(
                 self._decode_fn, mesh, pspecs,
-                7 + n_extra + n_struct + n_par, 1 + n_par)
+                7 + n_extra + n_struct + n_par + n_lora, 1 + n_par)
         else:
             self._chunk_jit = jax.jit(self._chunk_fn,
                                       donate_argnums=(1, 2))
@@ -538,7 +603,7 @@ class PagedEngine:
             if self.tp > 1:
                 self._verify_jit = _shard_engine_fn(
                     verify_fn, mesh, pspecs,
-                    7 + n_tree + n_extra + n_struct, 2)
+                    7 + n_tree + n_extra + n_struct + n_lora, 2)
             else:
                 self._verify_jit = jax.jit(verify_fn,
                                            donate_argnums=(1, 2))
@@ -598,6 +663,13 @@ class PagedEngine:
         exactly — and the return grows the pick's logprob plus the
         final-position logits ``fork()`` samples sibling branches'
         first tokens from."""
+        # lora operands ride LAST (appended after every other mode's),
+        # so they strip from the end FIRST — the earlier modes' reads
+        # (structured extra[0] below) then see their PR-era layout
+        lora_w = lane1 = None
+        if self.lora:
+            lora_w, lane1 = extra[-5:-1], extra[-1]
+            extra = extra[:-5]
         cfg, ps = self.cfg, self.page_size
         C = ids.shape[1]
         n_cp = C // ps
@@ -631,7 +703,7 @@ class PagedEngine:
         vis_chunk = (local[:, None] >= local[None, :])[None, None, None]
 
         def layer(x, inputs):
-            bp, pk, pv = inputs
+            bp, pk, pv = inputs[:3]
 
             def attend(q, k, v):
                 g = k.shape[2]
@@ -678,11 +750,16 @@ class PagedEngine:
                 capacity_factor=max(cfg.capacity_factor,
                                     float(cfg.n_experts)),
                 positions=positions[None],      # per-slot rope depth
-                tp_attn=self._tp_core)
+                tp_attn=self._tp_core,
+                lora=(inputs[3], lane1) if self.lora else None)
             return x, (pk, pv)
 
-        x, (pool_k, pool_v) = jax.lax.scan(
-            layer, x, (params["blocks"], pool_k, pool_v))
+        xs = (params["blocks"], pool_k, pool_v)
+        if self.lora:
+            # the adapter stacks scan per layer beside the block
+            # params (each xs leaf's leading axis is n_layers)
+            xs = xs + (lora_w,)
+        x, (pool_k, pool_v) = jax.lax.scan(layer, x, xs)
         last = jax.lax.dynamic_slice_in_dim(
             x, jnp.clip(s0 - 1 - start, 0, C - 1), 1, axis=1)
         logits = _lm_head(params, last)[:, 0]
@@ -710,6 +787,12 @@ class PagedEngine:
         parallel-sampling mode — so the default engine's jitted call
         signature is byte-identical to the pre-feature one."""
         work_pages = work_refs = work_pos = slot_keys = smask = None
+        # lora strips from the END first (its operands append last),
+        # leaving the earlier modes' front/back reads untouched
+        lora_w = lane_ids = None
+        if self.lora:
+            lora_w, lane_ids = extra[-5:-1], extra[-1]
+            extra = extra[:-5]
         if self.decode_backend == "pallas":
             work_pages, work_refs, work_pos = extra[:3]
             extra = extra[3:]
@@ -761,7 +844,7 @@ class PagedEngine:
         w_off = lengths % ps
 
         def layer(x, inputs):
-            bp, pk, pv = inputs
+            bp, pk, pv = inputs[:3]
 
             def attend(q, k, v):
                 if self.quantized:
@@ -833,11 +916,14 @@ class PagedEngine:
                 capacity_factor=max(cfg.capacity_factor,
                                     float(cfg.n_experts)),
                 positions=lengths[:, None],     # per-slot rope depth
-                tp_attn=self._tp_core)
+                tp_attn=self._tp_core,
+                lora=(inputs[3], lane_ids) if self.lora else None)
             return x, (pk, pv)
 
-        x, (pool_k, pool_v) = jax.lax.scan(
-            layer, x, (params["blocks"], pool_k, pool_v))
+        xs = (params["blocks"], pool_k, pool_v)
+        if self.lora:
+            xs = xs + (lora_w,)
+        x, (pool_k, pool_v) = jax.lax.scan(layer, x, xs)
         logits = _lm_head(params, x)[:, 0]
         # constrained slots' rows knock illegal tokens to finfo.min;
         # unconstrained rows are all-True (bitwise no-op — greedy and
@@ -1033,7 +1119,8 @@ class PagedEngine:
                 <= self.tables.n_available_pages)
 
     def admit_begin(self, prompt_ids: np.ndarray, seed: int | None = None,
-                    branch: int = 0) -> int | None:
+                    branch: int = 0,
+                    adapter_lane: int = 0) -> int | None:
         """Seat one request: map cached prefix pages into its block
         table, allocate private pages for the rest, and queue its
         chunked prefill. Returns the slot, or None when no slot or
@@ -1046,7 +1133,19 @@ class PagedEngine:
         fork branch re-seating on its own (its stream must resume
         token-exact), and the contract the parity tests drive: branch
         b of an n-way fork equals an independent run admitted with
-        the same seed and ``branch=b``."""
+        the same seed and ``branch=b``.
+
+        ``adapter_lane`` (lora mode) is the slot's device lane from
+        ``AdapterRegistry.acquire`` — 0 (the zero adapter) serves
+        base-model traffic; the caller holds the pin until retire."""
+        if adapter_lane and not self.lora:
+            raise ValueError(
+                f"adapter_lane={adapter_lane} on an engine without "
+                "lora: build with lora_rank/lora_max_live")
+        if not 0 <= adapter_lane <= self.lora_max_live:
+            raise ValueError(
+                f"adapter_lane {adapter_lane} out of range "
+                f"[0, {self.lora_max_live}]")
         prompt = np.ascontiguousarray(prompt_ids, np.int32).reshape(-1)
         s0 = len(prompt)
         slot = self.tables.free_slot()
@@ -1124,6 +1223,7 @@ class PagedEngine:
         # matched pages' chunks: HBM hits are mapped shares, host
         # hits get filled by the promotion stream before the first
         # chunk issues; pad the tail to a whole chunk
+        self._slot_lanes[slot] = int(adapter_lane)
         start = (n_matched + n_host) * self.page_size
         n_chunks = -(-(s0 - start) // self.chunk_tokens)
         padded = np.zeros(start + n_chunks * self.chunk_tokens,
@@ -1186,6 +1286,9 @@ class PagedEngine:
             # unconstrained — exact no-op)
             sextra = (jnp.asarray(
                 self._cursors.mask[p["slot"]][None]),)
+        # the chunk's (1,) lane id: the seating slot's adapter
+        sextra = sextra + self._lora_operands(
+            self._slot_lanes[p["slot"]:p["slot"] + 1])
         # span: host wall time in the event log + the same label on a
         # captured device trace (observability/spans.py); no-op when
         # telemetry is disabled
@@ -1388,6 +1491,11 @@ class PagedEngine:
         out = [(parent_slot, int(self.tables.last_ids[parent_slot]),
                 st["logprob"])]
         for b, child in enumerate(children, start=1):
+            # branches decode through the parent's adapter — the
+            # request carries ONE model; the registry's pin is held
+            # once per seated request, so no extra acquire here (the
+            # batcher releases once at the request's retirement)
+            self._slot_lanes[child] = self._slot_lanes[parent_slot]
             self._base_keys[child] = base
             key = jax.random.fold_in(jnp.asarray(base), b)
             self._slot_keys[child] = np.asarray(key)
@@ -1430,6 +1538,60 @@ class PagedEngine:
                 starved.append(int(slot))
         return starved
 
+    def _lora_write_fn(self, buf, lane, a_qkv, b_qkv, a_proj, b_proj):
+        """The ONE compiled adapter hot-load: overwrite lane ``lane``
+        of all four stacks. The lane index is a traced VALUE
+        (dynamic_update_index_in_dim), so any load/evict churn the
+        registry produces reuses this single executable — the
+        ``_cow_fn``/``_promote_fn`` pattern; the buffer donates, so a
+        hot-load is an in-place lane write, never a stack copy."""
+        new = {"a_qkv": a_qkv, "b_qkv": b_qkv,
+               "a_proj": a_proj, "b_proj": b_proj}
+        return {k: jax.lax.dynamic_update_index_in_dim(
+            buf[k], new[k].astype(buf[k].dtype), lane, axis=1)
+            for k in buf}
+
+    def lora_load(self, lane: int, stacks: dict) -> None:
+        """Write one adapter's host stacks into device lane ``lane``
+        (AdapterRegistry calls this; direct drivers may too). The
+        stacks are lane-less ``(n_layers, ...)`` arrays in the
+        registry's convention — already rank-padded and (at tp>1)
+        qkv-column-permuted."""
+        if not self.lora:
+            raise RuntimeError(
+                "lora_load() needs a PagedEngine(lora_rank=...,"
+                " lora_max_live=...)")
+        if not 1 <= lane <= self.lora_max_live:
+            raise ValueError(
+                f"lane {lane} out of range [1, {self.lora_max_live}]"
+                " — lane 0 is the reserved zero adapter")
+        with span("lora_load"):
+            self._lora_buf = self._lora_load_jit(
+                self._lora_buf, jnp.asarray(lane, jnp.int32),
+                jnp.asarray(stacks["a_qkv"]),
+                jnp.asarray(stacks["b_qkv"]),
+                jnp.asarray(stacks["a_proj"]),
+                jnp.asarray(stacks["b_proj"]))
+
+    def _lora_operands(self, lanes: np.ndarray) -> tuple:
+        """The lora modes' five trailing step operands: the four lane
+        stacks plus the per-slot (or per-chunk ``(1,)``) lane ids —
+        all VALUES; empty when lora is off so the default engine's
+        call signatures stay byte-identical."""
+        if not self.lora:
+            return ()
+        b = self._lora_buf
+        return (b["a_qkv"], b["b_qkv"], b["a_proj"], b["b_proj"],
+                jnp.asarray(lanes, jnp.int32))
+
+    @property
+    def lora_load_compiles(self) -> int:
+        """Compiled adapter-writer count — exactly ONE whatever
+        hot-load/evict churn the registry drives (the lane index is
+        traced); 0 until the first load, 0 forever with lora off."""
+        return (self._lora_load_jit._cache_size()
+                if self._lora_load_jit is not None else 0)
+
     def _kernel_operands(self) -> tuple:
         """The pallas backend's extra decode/verify operands (the
         compacted live-page walk); empty on the XLA sweep, so the
@@ -1461,6 +1623,7 @@ class PagedEngine:
             extra = extra + (jnp.asarray(self._cursors.mask),)
         if self.parallel:
             extra = extra + (jnp.asarray(self._slot_keys),)
+        extra = extra + self._lora_operands(self._slot_lanes)
         with span("decode_step"):
             outs = self._decode_jit(
                 self.params, self.pool["k"], self.pool["v"],
@@ -1562,6 +1725,7 @@ class PagedEngine:
             depth, tvis = tree_masks(parents)
             extra = (jnp.asarray(parents), jnp.asarray(depth),
                      jnp.asarray(tvis)) + extra
+        extra = extra + self._lora_operands(self._slot_lanes)
         in_ids = jnp.concatenate(
             [args["last_ids"][:, None], jnp.asarray(drafts)], axis=1)
         with span("spec_verify_step"):
@@ -1646,6 +1810,10 @@ class PagedEngine:
             self._base_keys[slot] = 0
             self._slot_keys[slot] = 0
             self._branch_of[slot] = 0
+        # lane 0 = zero adapter: a reused slot decodes base-model
+        # until its next seat assigns a lane (the registry pin is the
+        # BATCHER's to release — the engine only clears the gather id)
+        self._slot_lanes[slot] = 0
         self.tables.retire(slot)
 
     def debug_stats(self) -> dict:
@@ -1695,10 +1863,18 @@ class PagedEngine:
             "structured_requests": self.structured_requests,
             "structured_slots": self.structured_slot_count,
             "structured_schemas": len(self._sdfa_cache),
+            "weights_dtype": _weights_dtype(self.params),
+            "weight_stream_bytes": _weight_stream_bytes(self.params),
+            "lora": self.lora,
+            "lora_rank": self.lora_rank,
+            "lora_max_live": self.lora_max_live,
+            "adapters": (self.adapters.debug()
+                         if self.adapters is not None else None),
             "compiles": {"decode": self.decode_compiles,
                          "prefill": self.prefill_compiles,
                          "verify": self.verify_compiles,
-                         "promote": self.promote_compiles},
+                         "promote": self.promote_compiles,
+                         "lora_load": self.lora_load_compiles},
         }
 
     @property
@@ -1710,6 +1886,18 @@ class PagedEngine:
             return 0
         return int(np.count_nonzero(
             self.tables.active & (self._branch_of > 0)))
+
+    @property
+    def adapter_slot_count(self) -> int:
+        """Active slots currently decoding through a non-zero LoRA
+        adapter lane — host integers only (the ``/debug/engine`` and
+        flight-recorder per-tenant observable). Retire resets a
+        slot's lane to 0, so the count is exactly the seated
+        adaptered population."""
+        if not self.lora:
+            return 0
+        return int(np.count_nonzero(
+            self.tables.active & (self._slot_lanes > 0)))
 
     def tp_step_traffic(self, s_q: int = 1) -> dict:
         """Modeled per-chip wire bytes of one decode (``s_q=1``) or
@@ -1733,6 +1921,7 @@ class PagedEngine:
             extra = extra + (jnp.asarray(self._cursors.mask),)
         if self.parallel:
             extra = extra + (jnp.asarray(self._slot_keys),)
+        extra = extra + self._lora_operands(self._slot_lanes)
         lowered = self._decode_jit.lower(
             self.params, self.pool["k"], self.pool["v"],
             args["tables"], args["lengths"], args["refs"],

@@ -611,6 +611,17 @@ class ServingFrontend:
         deadline = payload.get("deadline_ms")
         seed = payload.get("seed")
         best_of = payload.get("best_of")
+        # the OpenAI `model` field doubles as the ADAPTER selector
+        # (multi-LoRA serving): the server's own model name (or an
+        # absent field) is the base model; anything else names a
+        # registered adapter — validated at submit, where an unknown
+        # name maps to a 400 before any pages move
+        model = payload.get("model", "")
+        if not isinstance(model, str):
+            raise HttpError(400, "`model` must be a string (the "
+                            "served model or a registered adapter "
+                            "name)")
+        adapter = "" if model in ("", self.model_name) else model
         try:
             req = Request(
                 prompt=ids,
@@ -628,6 +639,7 @@ class ServingFrontend:
                 # and again at submit (schema compile) — both map to
                 # a 400 naming the offending value here
                 response_format=payload.get("response_format"),
+                adapter=adapter,
             )
         except (TypeError, ValueError) as exc:
             raise HttpError(400, str(exc)) from None
@@ -718,9 +730,15 @@ class ServingFrontend:
             "deadline under current load", {"Retry-After": str(
                 self.batcher.policy.retry_after_s(self.batcher))})
 
+    def _model_of(self, req) -> str:
+        """The `model` echoed in responses: the adapter name when the
+        request decodes through one (OpenAI convention — you get back
+        what you asked for), else the served base-model name."""
+        return req.adapter or self.model_name
+
     def _chunk(self, rid: str, created: int, tokens: list[int],
                finish: str | None, chat: bool,
-               index: int = 0) -> dict:
+               index: int = 0, model: str | None = None) -> dict:
         text = self.codec.decode(tokens) if tokens else ""
         if chat:
             delta = {"content": text} if text else {}
@@ -732,7 +750,8 @@ class ServingFrontend:
                       "token_ids": tokens, "finish_reason": finish}
             obj = "text_completion"
         return {"id": rid, "object": obj, "created": created,
-                "model": self.model_name, "choices": [choice]}
+                "model": model if model is not None
+                else self.model_name, "choices": [choice]}
 
     async def _stream_response(self, req, stream, writer, rid,
                                created, chat) -> None:
@@ -765,7 +784,7 @@ class ServingFrontend:
                 # exactly as OpenAI's dialect does
                 writer.write(sse_event(self._chunk(
                     rid, created, tokens, finish, chat,
-                    index=branch)))
+                    index=branch, model=self._model_of(req))))
                 await writer.drain()
             elif finish is not None:
                 # a branch finished without tokens on this event: the
@@ -774,7 +793,8 @@ class ServingFrontend:
                 # only covers pre-head failures, and a crash-truncated
                 # stream must not read as a clean completion)
                 writer.write(sse_event(self._chunk(
-                    rid, created, [], finish, chat, index=branch)))
+                    rid, created, [], finish, chat, index=branch,
+                    model=self._model_of(req))))
                 await writer.drain()
             if done:
                 writer.write(SSE_DONE)
@@ -826,7 +846,7 @@ class ServingFrontend:
         # best_of convention
         writer.write(json_response(200, {
             "id": rid, "object": obj, "created": created,
-            "model": self.model_name, "choices": choices,
+            "model": self._model_of(req), "choices": choices,
             "usage": {"prompt_tokens": req.base_len,
                       "completion_tokens": completion_tokens,
                       "total_tokens": req.base_len

@@ -150,7 +150,8 @@ def replay_inprocess(batcher, workload: Workload,
                     deadline_ms=rec.deadline_ms,
                     request_id=rec.request_id,
                     n=rec.n, best_of=rec.best_of,
-                    response_format=rec.response_format)
+                    response_format=rec.response_format,
+                    adapter=rec.adapter)
             for rec in workload.requests]
     arrivals = [rec.arrival_s / speed for rec in workload.requests]
     cancels = [(req, rec.cancel_after_tokens)

@@ -86,8 +86,13 @@ __all__ = ["Workload", "WorkloadCapture", "WorkloadRequest",
 # generation) — absent means unconstrained, v1/v2 files still load,
 # and the fingerprint folds the spec in ONLY when set, so plain
 # traffic keeps verifying against its recorded v1/v2 fingerprints.
-FORMAT_VERSION = 3
-SUPPORTED_VERSIONS = (1, 2, 3)
+# v4 (PR 19): optional per-request ``adapter`` (multi-LoRA serving,
+# the HTTP `model` field) — absent/"" means the base model, v1-v3
+# files still load, and the fingerprint folds the name in ONLY when
+# set (the established only-when-set discipline), so base traffic
+# keeps verifying against every earlier recorded fingerprint.
+FORMAT_VERSION = 4
+SUPPORTED_VERSIONS = (1, 2, 3, 4)
 
 SYNTHETIC_KINDS = ("poisson", "bursty", "diurnal", "sharegpt")
 
@@ -120,6 +125,10 @@ class WorkloadRequest:
     # structured generation (OpenAI response_format; needs a
     # serving.structured engine on replay): None = unconstrained
     response_format: dict | None = None
+    # multi-LoRA serving (the HTTP `model` field; needs a
+    # serving.adapters engine with the name registered on replay):
+    # "" = the base model
+    adapter: str = ""
 
     def __post_init__(self):
         if self.prompt is not None:
@@ -169,6 +178,10 @@ class WorkloadRequest:
                 raise ValueError(
                     "a constraining response_format requires eos_id "
                     "(the automaton terminates by forcing EOS)")
+        if not isinstance(self.adapter, str):
+            raise ValueError(
+                f"adapter must be a name (str, '' = base model), "
+                f"got {self.adapter!r}")
 
     def prompt_ids(self, vocab: int) -> np.ndarray:
         """The prompt to serve: recorded ids, or the scrub recipe's
@@ -202,6 +215,11 @@ class WorkloadRequest:
             key.append(["response_format", json.dumps(
                 self.response_format, sort_keys=True,
                 separators=(",", ":"))])
+        if self.adapter:
+            # only-when-set again: base traffic keeps its v1-v3
+            # fingerprints while any adapter routing is provably
+            # covered by the hash
+            key.append(["adapter", self.adapter])
         return key
 
     def to_json(self) -> dict:
@@ -223,6 +241,7 @@ class WorkloadRequest:
             "n": int(self.n),
             "best_of": self.best_of,
             "response_format": self.response_format,
+            "adapter": self.adapter,
         }
 
     @classmethod
@@ -245,7 +264,9 @@ class WorkloadRequest:
             # files carry no response_format: unconstrained
             n=d.get("n", 1),
             best_of=d.get("best_of"),
-            response_format=d.get("response_format"))
+            response_format=d.get("response_format"),
+            # v1-v3 files carry no adapter: base model
+            adapter=d.get("adapter", ""))
 
 
 @dataclass
@@ -455,7 +476,8 @@ class WorkloadCapture:
                               if r.cancelled
                               and r.finished_at is not None else None),
                 n=r.n, best_of=r.best_of,
-                response_format=r.response_format))
+                response_format=r.response_format,
+                adapter=getattr(r, "adapter", "")))
         return Workload(
             requests=out, kind="capture", vocab=vocab or max_id,
             meta={"captured_at": round(self._captured_at, 3),
@@ -503,7 +525,8 @@ def synthesize(kind: str = "poisson", *, n_requests: int = 32,
                n_max: int = 4, structured_frac: float = 0.0,
                tenants: int = 0,
                prefix_pages: int = 0,
-               page_size: int = 64) -> Workload:
+               page_size: int = 64,
+               adapter_mix: str = "") -> Workload:
     """Synthetic workloads in the capture format, deterministic from
     ``seed`` — so a synthetic A/B carries a fingerprint exactly like a
     captured one and flows through the same replay driver.
@@ -542,7 +565,15 @@ def synthesize(kind: str = "poisson", *, n_requests: int = 32,
     exercise the host tier. All tenant draws come from their own
     seed-derived stream, so ``tenants: 0`` (the default) traffic is
     byte-identical to pre-knob workloads and the format version is
-    unchanged (a tenant prefix is just prompt tokens)."""
+    unchanged (a tenant prefix is just prompt tokens).
+
+    ``adapter_mix`` (multi-LoRA serving, v4) is a ``"name:weight,
+    ..."`` mix assigning each request an adapter by weighted draw —
+    the literal name ``base`` (or an empty name) means the base
+    model, anything else must be registered on the replay engine
+    (``serving.adapters``). Draws come from their own seed-derived
+    stream, so ``adapter_mix: ""`` (the default) traffic is
+    byte-identical to pre-v4 workloads for a given seed."""
     if kind not in SYNTHETIC_KINDS:
         raise ValueError(
             f"unknown synthetic workload kind {kind!r}: expected one "
@@ -644,6 +675,18 @@ def synthesize(kind: str = "poisson", *, n_requests: int = 32,
     # tenant prefixes likewise draw from their OWN stream (same
     # reasoning as the fan-out draws: tenants=0 traffic must stay
     # byte-identical to pre-knob workloads for a given seed)
+    # adapter draws from their OWN stream too (same byte-identity
+    # argument: adapter_mix="" traffic must reproduce pre-v4 bytes)
+    adp_names: list[str] = []
+    adp_idx = np.zeros(n_requests, np.int64)
+    if adapter_mix:
+        adp_names, adp_weights = _class_names_weights(adapter_mix)
+        adp_names = ["" if n in ("", "base") else n
+                     for n in adp_names]
+        rs_adp = np.random.RandomState(
+            (seed ^ 0x0ADA97E4) & 0xFFFFFFFF)
+        adp_idx = rs_adp.choice(len(adp_names), n_requests,
+                                p=adp_weights)
     tenant_prefixes: list[np.ndarray] = []
     tenant_idx = np.zeros(n_requests, np.int64)
     if tenants > 0:
@@ -683,8 +726,12 @@ def synthesize(kind: str = "poisson", *, n_requests: int = 32,
             request_id=f"w{seed}-{i:05d}",
             cancel_after_tokens=cancel,
             n=n_i,
-            response_format=rf_i))
+            response_format=rf_i,
+            adapter=(adp_names[int(adp_idx[i])]
+                     if adp_names else "")))
     meta = {"seed": int(seed), "rate": float(rate)}
+    if adapter_mix:
+        meta["adapter_mix"] = adapter_mix
     if tenants > 0:
         meta["tenants"] = int(tenants)
         meta["prefix_pages"] = int(prefix_pages)

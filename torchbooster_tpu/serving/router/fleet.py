@@ -540,6 +540,9 @@ class EngineFleet:
             "replica": rid,
             "reason": reason,
             "key": key,
+            # the second affinity dimension (multi-LoRA serving):
+            # which adapter the key folded in, "" = base traffic
+            "adapter": getattr(req, "adapter", ""),
             "candidates": [{
                 "replica": r.replica_id,
                 "queue_depth": r.queue_depth,

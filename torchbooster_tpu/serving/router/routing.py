@@ -44,19 +44,32 @@ __all__ = ["AffinityRouting", "RoundRobinRouting", "RoutingPolicy",
 
 
 def prefix_affinity_key(prompt: np.ndarray, page_size: int,
-                        affinity_pages: int) -> int | None:
+                        affinity_pages: int,
+                        adapter: str = "") -> int | None:
     """The request's affinity key: crc32 over its leading full pages
     (at most ``affinity_pages`` of them — enough to separate tenants'
     system prompts without hashing whole contexts), or ``None`` when
     the prompt has no full page to key by. Page alignment matches the
     prefix index exactly: two prompts sharing a key share at least
-    that many cached pages on whatever replica served either first."""
+    that many cached pages on whatever replica served either first.
+
+    ``adapter`` (multi-LoRA serving) is a SECOND affinity dimension
+    folded into the key: same-adapter traffic lands on one replica so
+    its lane stays device-resident there (pinned or LRU-cached)
+    instead of hot-load-thrashing across the fleet — the adapter
+    analogue of the warm-page argument. A sub-page prompt WITH an
+    adapter still keys (by the adapter alone); adapter-less requests
+    produce byte-identical keys to the pre-adapter router."""
     n_full = len(prompt) // page_size
-    if n_full < 1:
-        return None
-    take = min(n_full, max(affinity_pages, 1)) * page_size
-    head = np.ascontiguousarray(prompt[:take], np.int32)
-    return zlib.crc32(head.tobytes()) & 0xFFFFFFFF
+    base = None
+    if n_full >= 1:
+        take = min(n_full, max(affinity_pages, 1)) * page_size
+        head = np.ascontiguousarray(prompt[:take], np.int32)
+        base = zlib.crc32(head.tobytes()) & 0xFFFFFFFF
+    if adapter:
+        return zlib.crc32(adapter.encode(),
+                          0 if base is None else base) & 0xFFFFFFFF
+    return base
 
 
 class RoutingPolicy:
@@ -173,7 +186,8 @@ class AffinityRouting(RoutingPolicy):
         self.last_spill = False
         self.last_directory_hit = False
         key = prefix_affinity_key(
-            req.prompt, fleet.page_size, self.affinity_pages)
+            req.prompt, fleet.page_size, self.affinity_pages,
+            adapter=getattr(req, "adapter", ""))
         self.last_key = key
         if key is None:
             self.last_reason = "least_loaded"

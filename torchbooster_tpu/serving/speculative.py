@@ -360,6 +360,12 @@ def make_verify_fn(engine):
         # not a NameError-at-trace trap for the next refactor
         t_parent = t_depth = t_vis = None
         work_pages = work_refs = work_pos = smask = None
+        # lora operands append LAST (spec_step), so strip from the
+        # end FIRST — the front reads below keep their layout
+        lora_w = lane_ids = None
+        if engine.lora:
+            lora_w, lane_ids = extra[-5:-1], extra[-1]
+            extra = extra[:-5]
         if tree:
             t_parent, t_depth, t_vis = extra[:3]
             extra = extra[3:]
@@ -450,7 +456,7 @@ def make_verify_fn(engine):
                               & sel)).reshape(-1, n_lanes * S, ps)
 
         def layer(x, inputs):
-            bp, pk, pv = inputs
+            bp, pk, pv = inputs[:3]
 
             def attend(q, k_new, v_new):
                 # q/k_new/v_new (n_slots, S, heads, Dh): write ALL
@@ -520,11 +526,17 @@ def make_verify_fn(engine):
                 capacity_factor=max(cfg.capacity_factor,
                                     float(cfg.n_experts)),
                 positions=pos_c,                # per-slot rope depths
-                tp_attn=engine._tp_core)
+                tp_attn=engine._tp_core,
+                lora=(inputs[3], lane_ids) if engine.lora else None)
             return x, (pk, pv)
 
-        x, (pool_k, pool_v) = jax.lax.scan(
-            layer, x, (params["blocks"], pool_k, pool_v))
+        xs = (params["blocks"], pool_k, pool_v)
+        if engine.lora:
+            # per-layer adapter stacks scan beside the block params —
+            # the verify sweep applies the SAME slot lanes the decode
+            # step does, so accepted drafts are adapter-consistent
+            xs = xs + (lora_w,)
+        x, (pool_k, pool_v) = jax.lax.scan(layer, x, xs)
         logits = _lm_head(params, x)            # (n_slots, S, vocab)
         # structured: mask every position's logits with its automaton
         # row BEFORE the pick/accept rule, so fallback and bonus

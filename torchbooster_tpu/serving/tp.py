@@ -111,16 +111,35 @@ def param_specs(params: Any) -> Any:
     qkv column-parallel (rank-major columns — the caller permuted with
     ``qkv_to_tp_major`` first), O-projection row-parallel, everything
     else (embeddings, MLP, norms, LM head, the ``_tp_major`` marker
-    leaf) replicated. Leading ``None`` is the stacked layer axis."""
+    leaf) replicated. Leading ``None`` is the stacked layer axis.
+
+    Quantized weights (models/quant.py) shard their SCALES alongside
+    their kernels, per the SNIPPETS partition-spec table: qkv's
+    qkernel/qscale follow the column split (out axis — both the int8
+    per-channel ``(L, 1, out)`` and int4 per-group ``(L, G, out)``
+    scale shapes carry out last); attn_proj's qkernel follows the row
+    split (input axis — int4's packed bytes and groups both live
+    there, so its ``(L, G, d)`` qscale row-shards too), while the
+    int8 per-OUTPUT-channel proj scale ``(L, 1, d)`` is the same for
+    every row shard and stays replicated (the scale multiply commutes
+    with the psum)."""
 
     def assign(path: tuple, leaf: Any) -> P:
         name = path_str(path)
-        if name.endswith("attn_qkv/kernel"):
+        if name.endswith("attn_qkv/kernel") \
+                or name.endswith("attn_qkv/qkernel") \
+                or name.endswith("attn_qkv/qscale"):
             return P(None, None, "tp")
         if name.endswith("attn_qkv/bias"):
             return P(None, "tp")
-        if name.endswith("attn_proj/kernel"):
+        if name.endswith("attn_proj/kernel") \
+                or name.endswith("attn_proj/qkernel"):
             return P(None, "tp", None)
+        if name.endswith("attn_proj/qscale"):
+            # int4 group scales ride the (row-sharded) input axis;
+            # the int8 per-channel scale's input axis is 1 — nothing
+            # to shard, every rank applies the same channel scales
+            return P(None, "tp", None) if leaf.shape[1] > 1 else P()
         return P()
 
     return jax.tree_util.tree_map_with_path(assign, params)
