@@ -132,7 +132,8 @@ def main() -> None:
                  "obs_trace", "replay", "replay_http",
                  "serve_fleet", "serve_fleet_affinity",
                  "serve_spill", "serve_structured", "obs_fleet",
-                 "serve_wq", "serve_wq_int4", "serve_lora")
+                 "serve_wq", "serve_wq_int4", "serve_lora",
+                 "serve_disagg")
     for name in sorted(attempts):
         if name in METRICS or (name in multi_key and name in latest):
             continue  # multi-key ok rows print below; failures fall through
@@ -569,6 +570,40 @@ def main() -> None:
         print(f"| mixed adapters "
               f"| {r.get('serve_lora_tok_s_mix', '—')} "
               f"({r.get('serve_lora_overhead_pct', '—')}% overhead) |")
+
+    # serve_disagg row: the prefill/decode split A/B — one unified
+    # batcher vs the DisaggPair under longprompt_burst, with the
+    # parity / compile / bytes-EQUAL gates in the header and the
+    # decode-class p99 TPOT ratio as the headline (gated >= 1.5 only
+    # when perf_gated=True, i.e. an accelerator backend ran it —
+    # a 1-core CPU host time-slices the two pools and the ratio is
+    # reported informationally)
+    e = latest.get("serve_disagg")
+    if e is not None:
+        r = e.get("result") or {}
+        print(f"\nserve_disagg ({r.get('serve_disagg_requests', '?')} "
+              f"reqs / {r.get('serve_disagg_long_requests', '?')} "
+              "long, token parity "
+              f"{r.get('serve_disagg_token_parity', '?')}, dense "
+              f"parity {r.get('serve_disagg_dense_parity', '?')}, one "
+              f"compile {r.get('serve_disagg_one_compile', '?')}, "
+              "bytes match "
+              f"{r.get('serve_disagg_bytes_match', '?')} "
+              f"({r.get('serve_disagg_page_bytes', '?')} == "
+              f"{r.get('serve_disagg_modeled_bytes', '?')} modeled), "
+              f"perf gated {r.get('serve_disagg_perf_gated', '?')}, "
+              f"verdict ok={r.get('serve_disagg_ok', '?')}):")
+        print("| arm | decode-class p99 TPOT (ms) | long TTFT (s) |")
+        print("|---|---|---|")
+        print(f"| unified "
+              f"| {r.get('serve_disagg_tpot_p99_uni_ms', '—')} "
+              f"| {r.get('serve_disagg_ttft_long_uni_s', '—')} |")
+        print(f"| disagg "
+              f"| {r.get('serve_disagg_tpot_p99_dis_ms', '—')} "
+              f"| {r.get('serve_disagg_ttft_long_dis_s', '—')} |")
+        print(f"| ratio "
+              f"| {r.get('serve_disagg_tpot_ratio', '—')}x "
+              "(gate >= 1.5 when perf gated) | — |")
 
     # obs_fleet row: the fleet signal-plane A/B — plane off vs on
     # decode tok/s with the <3% headline, the routing byte-identity +

@@ -64,6 +64,7 @@ BLOCKS = {
     "structured": "StructuredConfig",
     "weights": "WeightsConfig",
     "adapters": "AdaptersConfig",
+    "disagg": "DisaggConfig",
 }
 
 _FENCE = re.compile(r"^```yaml\s*$")

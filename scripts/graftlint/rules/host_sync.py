@@ -62,7 +62,16 @@ HOT_PATHS = (
     # bookkeeping runs at every admit/retire and the one compiled
     # lane-write at every hot-load — pure host dict/LRU arithmetic by
     # design, and a sync there would serialize adapter churn against
-    # the decode stream
+    # the decode stream. It likewise covers serving/router/rpc.py and
+    # serving/disagg.py (PR 20): the RPC codec frames bytes on the
+    # router's step cadence (encode/decode runs per submit/step pump)
+    # and DisaggPair.step() lands page transfers between decode
+    # dispatches — both are pure host bytes/numpy bookkeeping by
+    # design; a stray .item()/time.time()/float(<call>) there would
+    # stall either the router pump or the decode loop. RemoteReplica's
+    # socket timeouts use monotonic deadlines computed OUTSIDE flagged
+    # patterns, and DisaggPair's prefill worker runs on its own
+    # thread, so neither needs allowlist entries.
     "torchbooster_tpu/serving/",
     # the paged flash-decode kernel wrapper sits INSIDE the compiled
     # decode/verify steps (serving/engine.py calls it per layer per

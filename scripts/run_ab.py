@@ -223,6 +223,18 @@ QUEUE: list[tuple[str, str, dict, int]] = [
     # evictions). (bench.bench_serve_lora; serve_lora_ok is the
     # verdict bit)
     ("serve_lora", "serve_lora", {}, 1800),
+    # disaggregated prefill/decode (the PR-20 tentpole): one unified
+    # batcher vs a split prefill pool + decode pool joined by the
+    # framed int8 page stream, under a longprompt_burst workload —
+    # bitwise token parity (incl. dense control), promote/decode
+    # compiles exactly 1 on the decode side, streamed payload bytes
+    # EQUAL to comms.accounting.disagg_traffic's closed form, and the
+    # decode-class p99 TPOT ratio >= 1.5 (the perf gate arms on
+    # accelerator backends only: on a 1-core CPU host the two pools
+    # time-slice one core and the ratio is physics, not the design —
+    # serve_disagg_perf_gated says which mode ran).
+    # (bench.bench_serve_disagg; serve_disagg_ok is the verdict bit)
+    ("serve_disagg", "serve_disagg", {}, 1800),
     # fleet signal plane (the PR-17 tentpole): plane-off vs plane-on
     # (audit ring + health scorer + SLO burn engine, health_aware OFF)
     # over the serve_fleet workload — < 3% decode tok/s overhead, zero

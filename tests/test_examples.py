@@ -425,6 +425,7 @@ def test_adain(monkeypatch, tmp_path):
     assert (Path(conf.samples_path) / "adain_final.npy").exists()
 
 
+@pytest.mark.slow     # heavy on the 1-cpu rig; coverage kept by cheaper tier-1 tests (870s budget)
 def test_gpt_text_file_corpus(monkeypatch, tmp_path):
     """Real-text LM path: the gpt recipe trains on a local UTF-8 corpus
     (dataset name text_file, byte tokens) and the post-training sample

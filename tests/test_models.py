@@ -244,6 +244,7 @@ def test_vgg_usable_under_jit_and_grad():
     assert jnp.isfinite(g).all()
 
 
+@pytest.mark.slow     # heavy on the 1-cpu rig; coverage kept by cheaper tier-1 tests (870s budget)
 def test_gpt_generate_matches_full_forward_greedy():
     """KV-cache decoding == re-running the full forward each step
     (greedy): pins the cached block math to GPT.apply's."""
@@ -366,6 +367,7 @@ def test_gpt_generate_sampling():
                                 temperature=0.0)), np.asarray(ids))
 
 
+@pytest.mark.slow     # heavy on the 1-cpu rig; coverage kept by cheaper tier-1 tests (870s budget)
 def test_gpt_jit_generate_matches_generate():
     """The one-compile decode entry (serving path): same ids as the
     plain generate wrapper, greedy and sampled, and repeated calls
@@ -429,6 +431,7 @@ def test_gpt_jit_generate_with_sharded_params():
     np.testing.assert_array_equal(np.asarray(got8), np.asarray(want))
 
 
+@pytest.mark.slow     # heavy on the 1-cpu rig; coverage kept by cheaper tier-1 tests (870s budget)
 def test_gpt_generate_moe_smoke():
     """MoE decode: capacity floors at n_experts so a (B, 1) decode
     micro-batch never drops tokens; output stays finite and in-vocab."""
@@ -480,6 +483,7 @@ def test_stem_s2d_matches_plain_conv():
                                rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.slow     # heavy on the 1-cpu rig; coverage kept by cheaper tier-1 tests (870s budget)
 def test_gpt_gqa_trains_and_generates():
     """Grouped-query attention: n_kv_heads < n_heads trains (finite
     loss, grads flow), the KV cache stores only the grouped heads, and

@@ -195,6 +195,7 @@ def test_engine_fork_greedy_parity_zero_recompiles():
     assert engine.tables.n_free_pages == engine.n_pages - 1
 
 
+@pytest.mark.slow     # heavy on the 1-cpu rig; coverage kept by cheaper tier-1 tests (870s budget)
 def test_seeded_n2_sampling_parity_vs_independent_runs():
     """The satellite regression: a seeded n=2 temperature-sampled
     request's branches are token-exact vs independent single-slot

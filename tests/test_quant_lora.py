@@ -147,6 +147,7 @@ def test_int8_paged_matches_fullprec_and_dense():
     np.testing.assert_array_equal(np.asarray(dense[0, 5:]), got)
 
 
+@pytest.mark.slow     # heavy on the 1-cpu rig; coverage kept by cheaper tier-1 tests (870s budget)
 def test_weight_stream_ratio_needs_width():
     """The modeled bf16/quant byte ratio: >= 1.9 at the bench's
     d_model=128 floor, and measurably BELOW it at d_model=64 — the

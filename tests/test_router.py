@@ -99,6 +99,7 @@ def _tenant_workload(n=10, tenants=2, seed=0, page=4, rate=100.0):
 
 # ---- multi-replica replay determinism (ISSUE satellite) --------------
 
+@pytest.mark.slow     # heavy on the 1-cpu rig; coverage kept by cheaper tier-1 tests (870s budget)
 def test_fleet_replay_determinism_round_robin_and_affinity():
     """Same capture + same routing policy under the ReplayClock ⇒
     identical per-replica assignment sequence and identical token
@@ -190,6 +191,7 @@ def test_affinity_spill_protects_hot_replica():
 
 # ---- replica death (ISSUE acceptance) --------------------------------
 
+@pytest.mark.slow     # heavy on the 1-cpu rig; coverage kept by cheaper tier-1 tests (870s budget)
 def test_replica_death_readmits_without_loss_or_duplication():
     """Kill one replica mid-trace: its queued + in-flight requests
     re-admit elsewhere, every request completes exactly once with

@@ -550,6 +550,7 @@ def test_admit_begin_matched_pages_not_counted_as_capacity():
     engine.tables.check()
 
 
+@pytest.mark.slow     # heavy on the 1-cpu rig; coverage kept by cheaper tier-1 tests (870s budget)
 def test_batcher_prefix_cache_shared_prompt_end_to_end():
     """Continuous batching with the prefix cache + chunked prefill on,
     over the shared-system-prompt traffic shape (one shared prefix,
@@ -1369,6 +1370,7 @@ def test_tp_validation():
         PagedEngine(params, mha, page_size=4, tp=3, mesh=_tp_mesh(2))
 
 
+@pytest.mark.slow     # heavy on the 1-cpu rig; coverage kept by cheaper tier-1 tests (870s budget)
 def test_tp_yaml_config_roundtrip_builds_batcher(tmp_path):
     """YAML → ``ServingConfig`` → batcher round-trip at tp=2: the
     typed ``serving.tp`` key reaches the engine, the batcher serves a

@@ -514,6 +514,7 @@ def _bind_and_churn(directory, prefix):
     return fleet, clock, home
 
 
+@pytest.mark.slow     # heavy on the 1-cpu rig; coverage kept by cheaper tier-1 tests (870s budget)
 def test_fleet_directory_routes_to_holder_and_beats_control():
     """The fleet acceptance: after the affinity map resets, a
     re-arriving tenant with the directory routes BACK to the replica

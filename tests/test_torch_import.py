@@ -143,6 +143,7 @@ def test_vgg_torch_import_exact():
                                rtol=1e-3, atol=1e-3)
 
 
+@pytest.mark.slow     # heavy on the 1-cpu rig; coverage kept by cheaper tier-1 tests (870s budget)
 def test_gpt2_import_matches_transformers_forward():
     """load_torch_gpt2 vs the REAL HuggingFace implementation: a tiny
     GPT2LMHeadModel built from config (no network), eval-mode logits

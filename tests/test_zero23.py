@@ -136,6 +136,7 @@ def test_stage2_overlap_on_off_trajectory_identity(wire):
                                       np.asarray(off.comms["ef1"]))
 
 
+@pytest.mark.slow     # heavy on the 1-cpu rig; coverage kept by cheaper tier-1 tests (870s budget)
 def test_stage2_int8_error_feedback_composes():
     """int8 + ZeRO-2: the per-shard residuals carry (nonzero after a
     step, bounded) and the compressed run tracks the fp32 stage-2 run

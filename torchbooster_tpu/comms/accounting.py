@@ -28,8 +28,8 @@ from __future__ import annotations
 import re
 from typing import Any
 
-__all__ = ["overlap_report", "promotion_traffic", "spill_breakeven",
-           "step_traffic", "record_step_traffic",
+__all__ = ["disagg_traffic", "overlap_report", "promotion_traffic",
+           "spill_breakeven", "step_traffic", "record_step_traffic",
            "xla_collective_traffic"]
 
 SCALE_BYTES = 4      # fp32 per-bucket scales
@@ -186,6 +186,32 @@ def promotion_traffic(n_pages: int, *, page_size: int, kv_heads: int,
         "per_page_bytes": per_page,
         "total_bytes": per_page * int(n_pages),
     }
+
+
+def disagg_traffic(prompt_len: int, *, page_size: int, kv_heads: int,
+                   head_dim: int, n_layers: int,
+                   scale_bytes: int = SCALE_BYTES) -> dict:
+    """Prefill->decode wire bytes of disaggregating ONE request —
+    what the page stream between a prefill pool and a decode pool
+    carries instead of the decode pool burning prefill FLOPs. The
+    stream ships the request's leading FULL prompt pages
+    (``(prompt_len - 1) // page_size`` — the prefix matcher's cap;
+    the decode side always re-runs the final chunk itself) in the
+    demotion payload format, so the per-page cost is byte-identical
+    to :func:`promotion_traffic`'s: K and V as int8 plus one fp32
+    scale per (layer, token, head). Integer bytes: the serve_disagg
+    bench gates this model EQUAL to the pair's measured
+    ``page_bytes_streamed`` counter (payload frames only — the JSON
+    routing header is transport overhead the model deliberately
+    excludes, reported separately as ``framed_bytes_streamed``)."""
+    if prompt_len < 1:
+        raise ValueError(f"prompt_len must be >= 1, got {prompt_len}")
+    n_pages = (int(prompt_len) - 1) // int(page_size)
+    out = promotion_traffic(
+        n_pages, page_size=page_size, kv_heads=kv_heads,
+        head_dim=head_dim, n_layers=n_layers, scale_bytes=scale_bytes)
+    out["prompt_len"] = int(prompt_len)
+    return out
 
 
 def spill_breakeven(*, n_params: int, page_size: int,

@@ -899,6 +899,39 @@ class RouterHealthConfig(BaseConfig):
 
 
 @dataclass
+class DisaggConfig(BaseConfig):
+    """Prefill/decode disaggregation (torchbooster_tpu/serving/
+    disagg.py). Nested under ``serving:`` as its ``disagg:``
+    sub-block. No reference analogue — this is the DistServe/
+    Splitwise split applied to the paged engine.
+
+    ``enabled: true`` makes ``ServingConfig.make`` return a
+    :class:`~torchbooster_tpu.serving.disagg.DisaggPair` instead of a
+    single batcher: a dedicated PREFILL engine (``prefill_only`` —
+    its decode paths raise) plus the normal decode batcher, joined by
+    a framed KV page stream in the host-spill demotion format (int8
+    K/V + fp32 per-(layer, token, head) scales). Requests with at
+    least ``min_prefill_pages`` full prompt pages prefill on the
+    prefill pool and enter the decode pool through its host spill
+    tier's promotion lane — zero new decode compiles; shorter ones
+    go straight to the decode batcher. Needs ``prefix_cache: true``
+    and ``host_spill.enabled: true`` (the stream lands in the host
+    pool) and a single-replica router block (disaggregate AND
+    replicate by building the fleet directly).
+
+    ``prefill_n_pages`` / ``prefill_max_slots`` size the prefill
+    pool independently (0 = inherit the serving geometry) — prefill
+    needs pages for one long prompt at a time, not for a decode
+    working set.
+    """
+
+    enabled: bool = False              # split prefill/decode pools
+    min_prefill_pages: int = 1         # full pages to route long
+    prefill_n_pages: int = 0           # 0 = serving.n_pages
+    prefill_max_slots: int = 0         # 0 = serving.max_slots
+
+
+@dataclass
 class RouterConfig(BaseConfig):
     """The engine-fleet router (torchbooster_tpu/serving/router):
     N data-parallel engine replicas behind one front door. Nested
@@ -947,6 +980,18 @@ class RouterConfig(BaseConfig):
     ``router_directory_evictions`` counter) and rescues its host-tier
     chains onto a survivor. ``directory: false`` is the A/B control.
 
+    ``replicas`` (PR 20) builds a MIXED fleet by explicit spec
+    instead of ``n_replicas`` identical local ones: each entry is
+    either the literal ``inproc`` (build a local engine + batcher,
+    exactly one of the ``n_replicas`` clones) or a ``host:port``
+    endpoint — a :class:`~torchbooster_tpu.serving.router.rpc.
+    RemoteReplica` socket to a ``python -m torchbooster_tpu.serving.
+    replica_server`` process pumping its own batcher. Routing,
+    affinity, spill, health, and death-readmission semantics are
+    identical either way (that's the socket-parity gate in the
+    serve_disagg bench family); a dropped connection is replica
+    death. Non-empty ``replicas`` overrides ``n_replicas``.
+
     ``audit`` sizes the routing-decision audit ring (``0`` disables
     it): one bounded record per choice — reason, affinity key, the
     per-candidate load picture — surfaced at ``GET /debug/router``
@@ -959,6 +1004,8 @@ class RouterConfig(BaseConfig):
     """
 
     n_replicas: int = 1                # 1 = plain single batcher
+    replicas: list = dataclasses.field(
+        default_factory=list)          # "inproc" | "host:port" specs
     policy: str = "affinity"           # round_robin | affinity
     affinity_pages: int = 2            # full pages hashed into the key
     spill_queue: int = 4               # hot-prefix spill threshold
@@ -1120,6 +1167,8 @@ class ServingConfig(BaseConfig):
         default_factory=WeightsConfig)  # int8/int4 weight serving
     adapters: AdaptersConfig = dataclasses.field(
         default_factory=AdaptersConfig)  # batched multi-LoRA lanes
+    disagg: DisaggConfig = dataclasses.field(
+        default_factory=DisaggConfig)  # split prefill/decode pools
 
     def make(self, params: Any, model_cfg: Any,
              compute_dtype: Any = None,
@@ -1171,11 +1220,13 @@ class ServingConfig(BaseConfig):
                 "replica would shard over the SAME tp mesh axis — "
                 "build EngineFleet directly with per-replica meshes")
 
-        def build_engine():
+        def build_engine(*, prefill_only=False, n_pages=None,
+                         max_slots=None, host_spill=None):
             return PagedEngine(
                 params, model_cfg,
-                page_size=self.page_size, n_pages=self.n_pages,
-                max_slots=self.max_slots,
+                page_size=self.page_size,
+                n_pages=n_pages if n_pages else self.n_pages,
+                max_slots=max_slots if max_slots else self.max_slots,
                 cache_dtype=self.cache_dtype or None,
                 compute_dtype=(jnp.bfloat16 if compute_dtype is None
                                else compute_dtype),
@@ -1189,8 +1240,10 @@ class ServingConfig(BaseConfig):
                 tree_width=self.spec_tree_width,
                 parallel_sampling=self.parallel_sampling,
                 decode_backend=self.decode_backend,
-                host_spill=self.host_spill.enabled,
+                host_spill=(self.host_spill.enabled
+                            if host_spill is None else host_spill),
                 host_spill_mb=self.host_spill.budget_mb,
+                prefill_only=prefill_only,
                 structured=self.structured.enabled,
                 lora_rank=self.adapters.rank,
                 lora_max_live=(self.adapters.max_live
@@ -1201,6 +1254,52 @@ class ServingConfig(BaseConfig):
         # validate/backpressure surface (policies are stateless over
         # their class tables, so sharing is safe by construction)
         policy = self.frontend.make_policy()
+        if self.disagg.enabled:
+            from torchbooster_tpu.serving.disagg import DisaggPair
+
+            if n_replicas > 1 or self.router.replicas:
+                raise ValueError(
+                    "serving.disagg.enabled with a multi-replica "
+                    "router block: disaggregate AND replicate by "
+                    "building the fleet directly over DisaggPairs")
+            if not (self.prefix_cache and self.host_spill.enabled):
+                raise ValueError(
+                    "serving.disagg needs prefix_cache: true and "
+                    "host_spill.enabled: true — the page stream "
+                    "lands in the decode pool's host tier")
+            if self.disagg.min_prefill_pages < 1:
+                raise ValueError(
+                    f"serving.disagg.min_prefill_pages must be >= 1, "
+                    f"got {self.disagg.min_prefill_pages}")
+            decode = ContinuousBatcher(build_engine(),
+                                       on_recompile=on_recompile,
+                                       policy=policy, tracer=tracer)
+            prefill = build_engine(
+                prefill_only=True,
+                n_pages=self.disagg.prefill_n_pages or None,
+                max_slots=self.disagg.prefill_max_slots or None,
+                host_spill=False)
+            return DisaggPair(
+                prefill, decode,
+                min_prefill_pages=self.disagg.min_prefill_pages)
+        if self.router.replicas:
+            from torchbooster_tpu.serving.router.rpc import (
+                RemoteReplica)
+
+            members = []
+            for i, spec in enumerate(self.router.replicas):
+                spec = str(spec).strip()
+                if spec == "inproc":
+                    members.append(ContinuousBatcher(
+                        build_engine(), on_recompile=on_recompile,
+                        policy=policy, tracer=tracer))
+                elif ":" in spec:
+                    members.append(RemoteReplica(spec, replica_id=i))
+                else:
+                    raise ValueError(
+                        f"serving.router.replicas[{i}]={spec!r}: "
+                        "expected 'inproc' or a 'host:port' endpoint")
+            return self.router.make(members)
         if n_replicas == 1:
             return ContinuousBatcher(build_engine(),
                                      on_recompile=on_recompile,
@@ -1228,7 +1327,11 @@ class LoadgenConfig(BaseConfig):
     instead of ad-hoc Poisson loops.
 
     ``source`` is either a synthetic generator name (``poisson`` |
-    ``bursty`` | ``diurnal`` | ``sharegpt``) or a path to a captured
+    ``bursty`` | ``diurnal`` | ``sharegpt`` | ``longprompt_burst`` —
+    the last adds ``long_frac`` × ``n_requests`` EXTRA long prompts
+    in ``long_prompt_len``, bursting once per workload period on top
+    of byte-identical Poisson base traffic: the disaggregation
+    stressor) or a path to a captured
     workload JSONL (``serving.frontend.capture_path`` writes one; a
     path is recognized by its ``.jsonl``/``.json`` suffix or by
     existing on disk). Both produce the SAME versioned format, so
@@ -1286,6 +1389,8 @@ class LoadgenConfig(BaseConfig):
     tenants: int = 0                   # 0 = no shared tenant prefixes
     prefix_pages: int = 0              # tenant system-prompt pages
     prefix_page_size: int = 64         # page alignment of the prefix
+    long_prompt_len: tuple(int, int) = (256, 512)  # longprompt_burst
+    long_frac: float = 0.25            # extra long requests / n_requests
 
     def make(self) -> Any:
         from torchbooster_tpu.serving.loadgen.workload import (
@@ -1314,7 +1419,9 @@ class LoadgenConfig(BaseConfig):
                 structured_frac=self.structured_frac,
                 tenants=self.tenants,
                 prefix_pages=self.prefix_pages,
-                page_size=self.prefix_page_size)
+                page_size=self.prefix_page_size,
+                long_prompt_len=tuple(self.long_prompt_len),
+                long_frac=self.long_frac)
         # the block's replay default: drivers called without an
         # explicit speed= read it back from the workload, so the
         # YAML knob actually governs the replay (meta never enters

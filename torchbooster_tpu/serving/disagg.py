@@ -1,0 +1,256 @@
+"""Prefill/decode disaggregation: two pools, one framed page stream.
+
+Long prompts are the decode batch's worst neighbour — every prefill
+chunk the scheduler interleaves steals a full compiled-step slot from
+requests that are mid-generation, so one 4k-token arrival spikes every
+other request's time-per-output-token. The classic fix (DistServe,
+Splitwise) is to split the work across TWO pools: a **prefill pool**
+that only runs chunked prefill, and a **decode pool** that only ever
+sees prompts whose KV pages already exist. What crosses between them
+is the KV state itself — and this repo already has a wire format for
+exactly that: the host-spill demotion payload (int8 K/V plus fp32
+per-(layer, token, head) scales, ``PagedEngine._spill_fetch``), and a
+fixed-shape donated promotion lane on the decode side that re-imports
+it with ZERO new compiles (``_promote_fn``).
+
+:class:`DisaggPair` wires the split:
+
+- ``submit`` routes by prompt length: requests with at least
+  ``min_prefill_pages`` FULL prompt pages (``(len(prompt)-1) //
+  page_size`` — the prefix matcher's cap, because the decode side
+  always re-runs the final chunk and samples the first token itself)
+  go to the prefill pool; everything else goes straight to the decode
+  batcher, which prefills short prompts faster than a page transfer
+  would.
+- a background **prefill worker** drains the long-prompt queue one
+  request at a time: ``admit_begin`` → ``prefill_step`` until done →
+  ``export_pages`` → ``retire``, then packs the pages with the
+  router RPC's framed codec (:func:`~torchbooster_tpu.serving.router.
+  rpc.pack_pages` / :func:`~...rpc.frame_blob`) — byte-identical to
+  what a socket between two hosts would carry.
+- ``step`` (the driver's pump) first lands any finished transfers:
+  unframe → ``host_pool.put`` on the decode engine → ``submit`` to
+  the decode batcher with the request's ORIGINAL arrival stamp (TTFT
+  honestly includes the prefill wait). The decode batcher's normal
+  admission then finds the pages in its host tier (``match_tiered``)
+  and pulls them through the donated promotion lane.
+
+Losslessness: int8 demotion round-trips exactly for an int8 device
+cache (the PR-16 spill-tier contract), and the decode side re-runs
+the last chunk from real token ids — so the token stream is
+byte-identical to the same request served by one unified batcher.
+The first token the prefill pool sampled is DISCARDED for the same
+reason the spill tier never caches a partial page: the decode side
+must own sampling state from token one.
+
+Failure semantics: the worker thread marks itself dead on any
+exception and ``step`` re-raises it on the driver thread — a dead
+prefill pool fails the pump loudly rather than silently stranding
+queued requests. Host-side counters only; the only device work is
+the two engines' own compiled functions.
+"""
+from __future__ import annotations
+
+import threading
+from collections import deque
+
+from torchbooster_tpu.serving.batcher import ContinuousBatcher, Request
+from torchbooster_tpu.serving.engine import PagedEngine
+from torchbooster_tpu.serving.router.rpc import (
+    frame_blob,
+    pack_pages,
+    unframe_blob,
+    unpack_pages,
+)
+
+__all__ = ["DisaggPair"]
+
+
+class DisaggPair:
+    """A prefill engine and a decode batcher joined by a framed page
+    stream (see module docstring). Pump-compatible with a
+    :class:`ContinuousBatcher`: ``start_session`` / ``submit`` /
+    ``step`` / ``has_work`` / ``finish_session``."""
+
+    def __init__(self, prefill_engine: PagedEngine,
+                 decode_batcher: ContinuousBatcher, *,
+                 min_prefill_pages: int = 1):
+        if not isinstance(prefill_engine, PagedEngine):
+            raise TypeError(
+                f"prefill_engine must be a PagedEngine, got "
+                f"{type(prefill_engine).__name__}")
+        if not isinstance(decode_batcher, ContinuousBatcher):
+            raise TypeError(
+                f"decode_batcher must be a ContinuousBatcher, got "
+                f"{type(decode_batcher).__name__}")
+        if decode_batcher.engine.tables.host_pool is None:
+            raise ValueError(
+                "disaggregation needs the decode engine's host spill "
+                "tier (host_spill=True): streamed pages land in its "
+                "host pool and enter through the promotion lane")
+        if min_prefill_pages < 1:
+            raise ValueError(
+                f"min_prefill_pages must be >= 1, got "
+                f"{min_prefill_pages}")
+        if prefill_engine.page_size != decode_batcher.engine.page_size:
+            raise ValueError(
+                f"page_size mismatch: prefill "
+                f"{prefill_engine.page_size} vs decode "
+                f"{decode_batcher.engine.page_size} — chain keys "
+                f"would never match")
+        self.prefill = prefill_engine
+        self.decode = decode_batcher
+        self.min_prefill_pages = int(min_prefill_pages)
+        # one-at-a-time worker pipeline: submit() feeds _q, the worker
+        # moves finished transfers to _out, step() lands them
+        self._q: deque[tuple[Request, float]] = deque()
+        self._out: deque[tuple[Request, float, bytes]] = deque()
+        self._inflight = 0  # routed to prefill, not yet handed over
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._worker: threading.Thread | None = None
+        self._worker_exc: BaseException | None = None
+        # transfer accounting (worker-thread writes, read after join
+        # or between steps — plain ints are fine under the GIL)
+        self.prefill_requests = 0
+        self.pages_streamed = 0
+        self.page_bytes_streamed = 0   # payload frames only (the
+        #                                disagg_traffic() unit)
+        self.framed_bytes_streamed = 0  # full blobs incl. headers
+
+    # ---- lifecycle -----------------------------------------------
+    def start_session(self) -> None:
+        self.decode.start_session()
+        self._q.clear()
+        self._out.clear()
+        self._inflight = 0
+        self._worker_exc = None
+        self._stop.clear()
+        self._worker = threading.Thread(
+            target=self._run_worker, name="disagg-prefill",
+            daemon=True)
+        self._worker.start()
+
+    def finish_session(self) -> dict:
+        """Stop the worker, close the decode session, and return its
+        metrics with a ``disagg`` block merged in. Callers should
+        pump :meth:`step` until ``has_work`` clears first — anything
+        still queued here is reported, not served."""
+        self._stop.set()
+        if self._worker is not None:
+            self._worker.join(timeout=30.0)
+            self._worker = None
+        metrics = self.decode.finish_session()
+        metrics["disagg"] = {
+            "min_prefill_pages": self.min_prefill_pages,
+            "prefill_requests": self.prefill_requests,
+            "pages_streamed": self.pages_streamed,
+            "page_bytes_streamed": self.page_bytes_streamed,
+            "framed_bytes_streamed": self.framed_bytes_streamed,
+            "stranded": self._inflight,
+        }
+        return metrics
+
+    # ---- offer ---------------------------------------------------
+    def submit(self, req: Request, arrival: float | None = None) -> None:
+        """Route one request: long prompts to the prefill pool, short
+        ones straight to decode. Raises (caller-side) when the
+        request can never fit EITHER pool — same submit-time contract
+        as the batcher's."""
+        self.decode._check_fits(req)
+        full_pages = (req.base_len - 1) // self.prefill.page_size
+        if full_pages < self.min_prefill_pages:
+            self.decode.submit(req, arrival=arrival)
+            return
+        if req.base_len + 1 > self.prefill.cfg.seq_len:
+            raise ValueError(
+                f"prompt ({req.base_len}) exceeds the prefill pool's "
+                f"seq_len ({self.prefill.cfg.seq_len})")
+        need = self.prefill.tables.pages_for(req.base_len + 1)
+        if need > self.prefill.tables.n_pages:
+            raise ValueError(
+                f"prompt needs {need} pages; the prefill pool has "
+                f"{self.prefill.tables.n_pages} total")
+        stamp = arrival if arrival is not None \
+            else self.decode.session_now()
+        with self._lock:
+            self._inflight += 1
+            self._q.append((req, float(stamp)))
+
+    # ---- pump ----------------------------------------------------
+    def step(self) -> list:
+        """One driver iteration: land finished page transfers on the
+        decode side, then run one decode-batcher step."""
+        if self._worker_exc is not None:
+            raise RuntimeError(
+                "disagg prefill worker died") from self._worker_exc
+        while True:
+            with self._lock:
+                if not self._out:
+                    break
+                req, stamp, blob = self._out.popleft()
+            header, frames = unframe_blob(blob)
+            pool = self.decode.engine.tables.host_pool
+            for key, payload in unpack_pages(header, frames):
+                pool.put(key, payload)
+            self.decode.submit(req, arrival=stamp)
+            with self._lock:
+                self._inflight -= 1
+        return self.decode.step()
+
+    @property
+    def has_work(self) -> bool:
+        with self._lock:
+            pending = self._inflight > 0 or bool(self._q) \
+                or bool(self._out)
+        return pending or self.decode.has_work
+
+    # ---- the prefill worker --------------------------------------
+    def _run_worker(self) -> None:
+        try:
+            while not self._stop.is_set():
+                with self._lock:
+                    item = self._q.popleft() if self._q else None
+                if item is None:
+                    self._stop.wait(0.001)
+                    continue
+                req, stamp = item
+                blob = self._prefill_one(req)
+                if blob is None:  # stopped mid-request
+                    return
+                with self._lock:
+                    self._out.append((req, stamp, blob))
+        except BaseException as exc:  # surfaced by step()
+            self._worker_exc = exc
+
+    def _prefill_one(self, req: Request) -> bytes | None:
+        eng = self.prefill
+        slot = None
+        while slot is None:
+            if self._stop.is_set():
+                return None
+            slot = eng.admit_begin(req.prompt, seed=req.seed)
+            if slot is None:
+                # pool momentarily full (cached pages from earlier
+                # exports); allocation evicts them as decode-side
+                # admission would, so just retry
+                self._stop.wait(0.001)
+        while True:
+            done = eng.prefill_step()
+            if done is not None and done[0] == slot:
+                break  # first token discarded: decode owns sampling
+            if done is None and not eng.has_pending:
+                raise RuntimeError(
+                    f"prefill pipeline lost slot {slot} for "
+                    f"{req.request_id}")
+        pages = eng.export_pages(slot, req.prompt)
+        eng.retire(slot)
+        header, frames = pack_pages(pages)
+        header["op"] = "page_stream"
+        header["request_id"] = req.request_id
+        blob = frame_blob(header, frames)
+        self.prefill_requests += 1
+        self.pages_streamed += len(pages)
+        self.page_bytes_streamed += int(header["page_bytes"])
+        self.framed_bytes_streamed += len(blob)
+        return blob

@@ -242,7 +242,8 @@ class PagedEngine:
                  structured: bool = False,
                  structured_vocab: Any = None,
                  lora_rank: int = 0,
-                 lora_max_live: int = 0):
+                 lora_max_live: int = 0,
+                 prefill_only: bool = False):
         if cfg.seq_len % page_size:
             # a last partial page per slot would shift page_pos math;
             # geometry is static, so fail loudly at construction
@@ -410,6 +411,14 @@ class PagedEngine:
         self.promotions = 0      # pages promoted host -> HBM
         self.host_hit_pages = 0  # seat-time matches served host-tier
         self.promoted_bytes = 0  # measured H2D payload bytes staged
+        # prefill-only mode (serving/disagg.py's prefill pool): the
+        # engine admits and prefills but its decode entries refuse to
+        # run — a disaggregated prefill host exports finished pages
+        # over the wire instead of decoding, and a driver bug that
+        # would silently decode on the prefill pool must fail loudly
+        self.prefill_only = bool(prefill_only)
+        self.exported_pages = 0  # pages exported via export_pages
+        self.exported_bytes = 0  # their payload bytes (quantized)
         self.spec_proposed = 0
         self.spec_accepted = 0
         self.spec_steps = 0
@@ -1097,6 +1106,44 @@ class PagedEngine:
             n += len(keys)
         return n
 
+    def export_pages(self, slot: int,
+                     prompt_ids: np.ndarray) -> list[tuple[bytes, dict]]:
+        """Read the slot's leading FULL prompt pages out as
+        ``(chain_key, payload)`` pairs — the demotion payload
+        (:meth:`_spill_fetch`: int8 K/V + fp32 per-(token, head)
+        scales, lossless for int8 pools), keyed by the same
+        content-hash chain the prefix index and host pool use. This
+        is the disaggregation export seam: a prefill host calls it
+        once per finished prefill and ships the pairs over the wire;
+        the decode host drops them into its ``HostPagePool`` and its
+        next ``admit_begin`` seats them through the fixed-shape
+        donated promotion lane (zero new compiles). The ``(len - 1)
+        // page_size`` cap matches the matcher's — the final token's
+        page is never exported, so the importer always re-runs at
+        least one prefill chunk and samples the first token itself
+        (the spill tier's parity contract). Call it BEFORE
+        :meth:`retire` frees the pages. Deliberate device->host
+        reads on the per-REQUEST cadence — never inside a decode
+        step."""
+        prompt = np.ascontiguousarray(prompt_ids,
+                                      np.int32).reshape(-1)
+        limit = (len(prompt) - 1) // self.page_size
+        row = self.tables.tables[slot]
+        out: list[tuple[bytes, dict]] = []
+        for i in range(limit):
+            p = int(row[i])
+            if p == NULL_PAGE:
+                break
+            key = prompt[:(i + 1) * self.page_size].tobytes()
+            payload = self._spill_fetch(p)
+            self.spills -= 1  # _spill_fetch counts demotions; an
+            # export is not a demotion (the page stays seated)
+            self.exported_pages += 1
+            self.exported_bytes += sum(
+                int(a.nbytes) for a in payload.values())
+            out.append((key, payload))
+        return out
+
     # ---- host lifecycle ------------------------------------------
     def can_admit(self, prompt_ids: np.ndarray) -> bool:
         """Dry-run of :meth:`admit_begin`'s checks (slot, horizon, and
@@ -1607,6 +1654,11 @@ class PagedEngine:
         """One decode step over every ACTIVE slot; advances lengths/
         last_ids for those and returns the (max_slots,) token ids
         (garbage at inactive or mid-prefill slots)."""
+        if self.prefill_only:
+            raise RuntimeError(
+                "step() on a prefill_only engine: the disaggregated "
+                "prefill pool exports pages (export_pages) instead "
+                "of decoding — route decode to the decode host")
         active = self.tables.active.copy()
         if active.any():
             full = self.tables.lengths[active] >= self.cfg.seq_len
@@ -1664,6 +1716,11 @@ class PagedEngine:
         Returns ``{slot: [tokens]}`` in slot order — multi-token
         emission is why this cannot share :meth:`step`'s fixed
         ``(max_slots,)`` return. Requires ``speculative=True``."""
+        if self.prefill_only:
+            raise RuntimeError(
+                "spec_step() on a prefill_only engine: the "
+                "disaggregated prefill pool exports pages "
+                "(export_pages) instead of decoding")
         if not self.speculative:
             raise RuntimeError(
                 "spec_step() needs a PagedEngine(speculative=True); "

@@ -94,7 +94,8 @@ __all__ = ["Workload", "WorkloadCapture", "WorkloadRequest",
 FORMAT_VERSION = 4
 SUPPORTED_VERSIONS = (1, 2, 3, 4)
 
-SYNTHETIC_KINDS = ("poisson", "bursty", "diurnal", "sharegpt")
+SYNTHETIC_KINDS = ("poisson", "bursty", "diurnal", "sharegpt",
+                   "longprompt_burst")
 
 
 @dataclass
@@ -526,7 +527,9 @@ def synthesize(kind: str = "poisson", *, n_requests: int = 32,
                tenants: int = 0,
                prefix_pages: int = 0,
                page_size: int = 64,
-               adapter_mix: str = "") -> Workload:
+               adapter_mix: str = "",
+               long_prompt_len: tuple = (256, 512),
+               long_frac: float = 0.25) -> Workload:
     """Synthetic workloads in the capture format, deterministic from
     ``seed`` — so a synthetic A/B carries a fingerprint exactly like a
     captured one and flows through the same replay driver.
@@ -566,6 +569,19 @@ def synthesize(kind: str = "poisson", *, n_requests: int = 32,
     seed-derived stream, so ``tenants: 0`` (the default) traffic is
     byte-identical to pre-knob workloads and the format version is
     unchanged (a tenant prefix is just prompt tokens).
+
+    ``longprompt_burst`` is the disaggregation stressor (PR 20):
+    steady short-prompt decode traffic — the Poisson base, drawn
+    byte-identically to ``kind="poisson"`` for the same seed/params —
+    plus ``long_frac`` (of ``n_requests``, as EXTRA requests) long
+    prompts in ``long_prompt_len`` arriving as periodic bursts, one
+    burst every ``period_s`` seconds (mid-window, round-robin across
+    bursts). Long requests take the LAST class of ``classes`` (list
+    the decode class first and the prefill class last) and are always
+    plain (no cancel/fan-out/structured/adapter/tenant decoration —
+    they exist to spike prefill work, nothing else). All their draws
+    come from their own seed-derived stream, so ``long_frac: 0``
+    traffic is byte-identical to plain Poisson for a given seed.
 
     ``adapter_mix`` (multi-LoRA serving, v4) is a ``"name:weight,
     ..."`` mix assigning each request an adapter by weighted draw —
@@ -613,6 +629,18 @@ def synthesize(kind: str = "poisson", *, n_requests: int = 32,
             "surely a config typo)")
     if tenants > 0 and page_size < 1:
         raise ValueError(f"page_size must be >= 1, got {page_size}")
+    if not 0.0 <= long_frac <= 1.0:
+        raise ValueError(
+            f"long_frac must be in [0, 1], got {long_frac}")
+    l_lo, l_hi = int(long_prompt_len[0]), int(long_prompt_len[1])
+    if kind == "longprompt_burst" and not 1 <= l_lo <= l_hi:
+        raise ValueError(
+            f"long_prompt_len must satisfy 1 <= lo <= hi, got "
+            f"{long_prompt_len}")
+    if kind == "longprompt_burst" and period_s <= 0:
+        raise ValueError(
+            f"period_s must be > 0 (the burst cadence), got "
+            f"{period_s}")
     p_lo, p_hi = int(prompt_len[0]), int(prompt_len[1])
     o_lo, o_hi = int(max_new_tokens[0]), int(max_new_tokens[1])
     if not 1 <= p_lo <= p_hi or not 1 <= o_lo <= o_hi:
@@ -729,7 +757,33 @@ def synthesize(kind: str = "poisson", *, n_requests: int = 32,
             response_format=rf_i,
             adapter=(adp_names[int(adp_idx[i])]
                      if adp_names else "")))
+    if kind == "longprompt_burst":
+        # long-prompt bursts from their OWN stream (the established
+        # byte-identity discipline: the base traffic above must stay
+        # identical to plain poisson for a given seed). Bursts land
+        # mid-window — every period_s a clump of long prompts arrives
+        # together, the moment an interleaved prefill would steal the
+        # most decode slots.
+        n_long = int(round(n_requests * long_frac))
+        rs_long = np.random.RandomState(
+            (seed ^ 0x10A6B057) & 0xFFFFFFFF)
+        span = float(arrivals[-1])
+        n_bursts = max(1, int(np.ceil(span / period_s)))
+        for j in range(n_long):
+            burst = j % n_bursts
+            jitter = rs_long.uniform(0.0, 0.05)
+            at = period_s * (burst + 0.5) + float(jitter)
+            llen = int(rs_long.randint(l_lo, l_hi + 1))
+            requests.append(WorkloadRequest(
+                arrival_s=at,
+                max_new_tokens=int(rs_long.randint(o_lo, o_hi + 1)),
+                prompt=rs_long.randint(0, vocab, llen, dtype=np.int32),
+                priority=names[-1],
+                request_id=f"w{seed}-L{j:05d}"))
     meta = {"seed": int(seed), "rate": float(rate)}
+    if kind == "longprompt_burst":
+        meta["long_frac"] = float(long_frac)
+        meta["period_s"] = float(period_s)
     if adapter_mix:
         meta["adapter_mix"] = adapter_mix
     if tenants > 0:

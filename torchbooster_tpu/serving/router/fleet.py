@@ -120,12 +120,11 @@ class EngineFleet:
         # pricing, deadline lookup): the replicas share one class
         # table by construction (ServingConfig.make passes one policy
         # object to every batcher)
-        self.policy = self.replicas[0].batcher.policy \
-            if isinstance(self.replicas[0], InProcessReplica) \
-            else None
-        self.page_size = (
-            self.replicas[0].batcher.engine.page_size
-            if isinstance(self.replicas[0], InProcessReplica) else 1)
+        # every Replica carries these now (a remote ships them in its
+        # hello), so remote-first fleets price and validate exactly
+        # like in-process ones
+        self.policy = self.replicas[0].policy
+        self.page_size = self.replicas[0].page_size
         # thread-safe inboxes, the batcher discipline: the event loop
         # submits/cancels while the pump thread steps
         self._inbox_submit: deque[Request] = deque()
@@ -142,18 +141,19 @@ class EngineFleet:
         # BlockTables tier events, consulted by AffinityRouting on a
         # map miss so a re-arriving tenant lands where its pages
         # actually ARE (HBM or host tier) instead of recomputing.
-        # `directory=False` is the A/B control arm; socket replicas
-        # would maintain it from their event streams instead of a
-        # callback, which is why it lives here and not in the engine.
+        # `directory=False` is the A/B control arm. Socket replicas
+        # maintain it from their RPC event streams: set_tier_observer
+        # asks the server to buffer tier events and the client
+        # replays each response's batch through this same observer —
+        # which is why the directory lives here and not in the engine.
         self.directory: PrefixDirectory | None = None
-        if directory and isinstance(wrapped[0], InProcessReplica):
+        if directory:
             self.directory = PrefixDirectory(
                 self.page_size,
                 max_pages=getattr(self.routing, "affinity_pages", 2))
             for rep in wrapped:
-                if isinstance(rep, InProcessReplica):
-                    rep.batcher.engine.tables.on_tier_event = \
-                        self.directory.observer(rep.replica_id)
+                rep.set_tier_observer(
+                    self.directory.observer(rep.replica_id))
         # router session stats (the metrics-dict "router" block)
         self.n_routed = 0
         self.n_affinity_hits = 0
@@ -168,6 +168,12 @@ class EngineFleet:
         self.assignment_log: list[tuple[str, int]] = []
         self.last_error: BaseException | None = None
         self._inst: dict | None = None
+        # lazily-built stand-ins for remote-only fleets (tracer /
+        # flight properties): remote batchers trace in their own
+        # processes, so the fleet-local objects just keep the front
+        # door's hooks satisfied
+        self._fallback_tracer = None
+        self._fallback_flight = None
         # the routing decision audit trail (audit.py): one bounded
         # record per routed request — 0 disables the ring (and the
         # /debug/router decision tail with it)
@@ -193,12 +199,12 @@ class EngineFleet:
     # ---- clock plumbing (replay swaps it, every replica follows) --
     @property
     def clock(self):
-        return self.replicas[0].batcher.clock
+        return self.replicas[0].clock
 
     @clock.setter
     def clock(self, fn) -> None:
         for rep in self.replicas:
-            rep.batcher.clock = fn
+            rep.clock = fn
 
     # ---- probe surface -------------------------------------------
     @property
@@ -229,7 +235,7 @@ class EngineFleet:
         live = self.live_replicas
         if not live:
             return 1.0
-        return max(r.batcher.occupancy for r in live)
+        return max(r.occupancy for r in live)
 
     @property
     def est_step_s(self) -> float:
@@ -242,22 +248,51 @@ class EngineFleet:
     def engine(self):
         """A REPRESENTATIVE engine (geometry/backpressure pricing —
         all replicas are built identical); never a place to mutate
-        fleet state through."""
-        live = self.live_replicas
-        return (live[0] if live else self.replicas[0]).batcher.engine
+        fleet state through. Necessarily in-process: a remote-only
+        fleet has no local engine object, and the consumers of this
+        property (the front door's retry pricing and /debug/engine
+        single-batcher form) price from geometry the hello already
+        shipped — they should read ``page_size``/probe fields
+        instead."""
+        for rep in [*self.live_replicas, *self.replicas]:
+            if isinstance(rep, InProcessReplica):
+                return rep.batcher.engine
+        raise RuntimeError(
+            "no in-process replica: a remote-only fleet has no local "
+            "engine (read geometry from fleet.page_size / the "
+            "readiness payload instead)")
 
     @property
     def tracer(self):
         """The shared request tracer (ServingConfig.make hands one
         tracer to every replica so /debug/trace follows a request
-        across the fleet)."""
-        return self.replicas[0].batcher.tracer
+        across the fleet). Remote-only fleets get a local (disabled)
+        tracer — remote batchers trace in their own processes."""
+        for rep in self.replicas:
+            if isinstance(rep, InProcessReplica):
+                return rep.batcher.tracer
+        if self._fallback_tracer is None:
+            from torchbooster_tpu.observability.tracing import (
+                RequestTracer)
+
+            self._fallback_tracer = RequestTracer()
+        return self._fallback_tracer
 
     @property
     def flight(self):
         """Replica 0's flight ring (the front door's crash-dump hook;
-        per-replica rings are in :meth:`debug_fleet`)."""
-        return self.replicas[0].batcher.flight
+        per-replica rings are in :meth:`debug_fleet`). Remote-only
+        fleets get a local empty ring — remote flight tails arrive
+        via ``debug_row`` instead."""
+        for rep in self.replicas:
+            if isinstance(rep, InProcessReplica):
+                return rep.batcher.flight
+        if self._fallback_flight is None:
+            from torchbooster_tpu.observability.flight import (
+                FlightRecorder)
+
+            self._fallback_flight = FlightRecorder()
+        return self._fallback_flight
 
     def session_now(self) -> float:
         if not self._session:
@@ -295,7 +330,7 @@ class EngineFleet:
                 raise RuntimeError(
                     f"replica {rep.replica_id} is dead; build a fresh "
                     "fleet (dead replicas never resurrect mid-object)")
-            rep.batcher.start_session()
+            rep.start_session()
         self._inbox_submit.clear()
         self._inbox_cancel.clear()
         self._pending.clear()
@@ -366,7 +401,7 @@ class EngineFleet:
         per_replica: list[dict] = []
         for rep in self.replicas:
             try:
-                per_replica.append(rep.batcher.finish_session())
+                per_replica.append(rep.finish_session())
             except Exception:  # noqa: BLE001 — a dead replica's
                 # session is best-effort post-mortem; the survivors'
                 # numbers (and the fleet merge) must still land
@@ -388,7 +423,7 @@ class EngineFleet:
         live = self.live_replicas
         if not live:
             raise RuntimeError("no live replicas")
-        live[0].batcher._check_fits(req)
+        live[0].check_fits(req)
         if self.policy is not None:
             self.policy.validate(req)
         req.arrival = (self.clock() - self._t0) if arrival is None \
@@ -609,7 +644,7 @@ class EngineFleet:
         if self._hot_streak < self.rebalance_after:
             return
         self._hot_streak = 0
-        moved = hot.batcher.drain_queued(max(gap // 2, 1))
+        moved = hot.drain_queued(max(gap // 2, 1))
         others = [r for r in live if r is not hot]
         for req in moved:
             self._owner.pop(id(req), None)
@@ -697,8 +732,7 @@ class EngineFleet:
         for rep in self.replicas:
             if not rep.alive:
                 continue
-            snap = rep.batcher.debug_snapshot(
-                timeline_tail=timeline_tail)
+            snap = rep.debug_snapshot(timeline_tail=timeline_tail)
             for row in snap["requests"]:
                 row["replica"] = rep.replica_id
                 out["requests"].append(row)
@@ -708,26 +742,12 @@ class EngineFleet:
         """The ``/debug/engine`` payload for a fleet: router stats +
         one row per replica (alive flag, engine/pool stats, its
         flight-recorder tail) — the per-replica rows the flight dump
-        grows in fleet mode."""
-        rows = []
-        for rep in self.replicas:
-            flight = rep.batcher.flight
-            row = {
-                "replica": rep.replica_id,
-                "alive": rep.alive,
-                "queue_depth": rep.queue_depth if rep.alive else 0,
-                "flight": {
-                    "n_recorded": flight.n_recorded,
-                    "capacity": flight.capacity,
-                    "records": flight.tail(32),
-                    "anomalies": flight.anomaly_log(),
-                },
-            }
-            if rep.alive:
-                row["engine"] = rep.batcher.engine.debug_stats()
-                row["occupancy"] = round(rep.batcher.occupancy, 4)
-            rows.append(row)
-        return {"router": self.router_stats(), "replicas": rows}
+        grows in fleet mode. Each replica builds its own row
+        (``Replica.debug_row``), so a remote's arrives over the wire
+        with its endpoint attached."""
+        return {"router": self.router_stats(),
+                "replicas": [rep.debug_row()
+                             for rep in self.replicas]}
 
     def debug_router(self, tail: int = 64) -> dict:
         """The ``GET /debug/router`` payload: router stats (policy,

@@ -195,14 +195,27 @@ class FleetHealth:
             + ready.get("pages_cached", 0)
         if claimable <= self.min_free_pages:
             strikes.append("pages")
-        # readiness staleness: the batcher stamps (step_seq,
-        # stamped_s); a frozen step_seq with work on the plate for
-        # stale_s of stamped time means the replica stopped making
-        # progress (for in-process replicas the fleet steps them
-        # itself, so this guards the out-of-process readiness path)
+        # readiness staleness: a frozen step_seq with work on the
+        # plate means the replica stopped making progress. Payloads
+        # from a REMOTE replica carry `age_s` — how old the payload
+        # itself is, summed from SAME-HOST clock deltas on each side
+        # of the wire — and the strike reads it directly: no term
+        # ever differences two hosts' clocks, so skew can't mark a
+        # healthy remote unhealthy, and a hung server's cached
+        # payload ages honestly (its frozen stamped_s never would).
+        # In-process payloads have no age_s and keep the historic
+        # stamped-delta rule (the fleet steps those replicas itself,
+        # so this mostly guards the out-of-process path).
         seq = ready.get("step_seq")
         stamped = ready.get("stamped_s")
-        if seq is not None and stamped is not None:
+        age = ready.get("age_s")
+        if seq is not None and age is not None:
+            prev = self._stamp.get(rid)
+            if prev is None or seq != prev[0]:
+                self._stamp[rid] = (seq, stamped)
+            elif rep.has_work and age >= self.stale_s:
+                strikes.append("stale")
+        elif seq is not None and stamped is not None:
             prev = self._stamp.get(rid)
             if prev is None or seq != prev[0]:
                 self._stamp[rid] = (seq, stamped)

@@ -30,13 +30,23 @@ when its ``step()`` raises (or ``EngineFleet.kill`` forces it) and
 never steps it again; ``alive`` gates routing. Host-side bookkeeping
 only — nothing in this module touches the device or a wall clock.
 
-Scope honesty: the surface above is the ROUTING core — every
-decision input and the readmission path. The fleet's LIFECYCLE
-plumbing (session open/close, replay clock injection, the
-debug/trace/flight merges, hot-spot queue drains) still reaches
-through ``InProcessReplica.batcher`` today; promoting those onto
-this surface is the remaining work when the first socket-backed
-replica lands, and the routing layer itself will not change.
+The LIFECYCLE plumbing the PR 14 docstring promised to promote "when
+the first socket-backed replica lands" is now part of the surface
+(that replica exists — :class:`~torchbooster_tpu.serving.router.rpc.
+RemoteReplica`): session open/close (``start_session`` /
+``finish_session``), replay clock injection (the ``clock`` property —
+a remote replica freezes its server's wire clock), admission pricing
+(``check_fits``), hot-spot queue drains (``drain_queued``), the
+prefix-directory feed (``set_tier_observer`` — in-process wires the
+engine's tier-event callback, remote replays the event stream its
+responses carry), the scheduler-policy handle and pool geometry
+(``policy`` / ``page_size`` — a remote ships them in its hello), and
+the debug payloads (``debug_snapshot`` / ``debug_row``). The fleet
+reaches through NONE of these by ``.batcher`` anymore; the only
+remaining in-process-only seam is host-page reassignment on death
+(``fleet._reassign_host_pages``), which moves host-RAM payloads
+between LOCAL pools and is correctly a no-op for remotes (their
+pages died with their host).
 """
 from __future__ import annotations
 
@@ -52,6 +62,41 @@ class Replica:
 
     replica_id: int = -1
     alive: bool = True
+
+    # ---- lifecycle -----------------------------------------------
+    @property
+    def policy(self):
+        """The replica's scheduler policy (shared table in-process;
+        reconstructed from the hello spec over a socket)."""
+        raise NotImplementedError
+
+    @property
+    def page_size(self) -> int:
+        raise NotImplementedError
+
+    @property
+    def clock(self):
+        raise NotImplementedError
+
+    @clock.setter
+    def clock(self, fn) -> None:
+        raise NotImplementedError
+
+    def start_session(self) -> None:
+        raise NotImplementedError
+
+    def finish_session(self) -> dict:
+        raise NotImplementedError
+
+    def check_fits(self, req: Request) -> None:
+        """Raise if ``req`` can never be admitted (the fleet's
+        submit-time geometry/validation gate)."""
+        raise NotImplementedError
+
+    def set_tier_observer(self, fn) -> None:
+        """Feed page tier events (register/promote/demote/evict) to
+        ``fn(event, key)`` — the fleet prefix directory's input."""
+        raise NotImplementedError
 
     # ---- offer/withdraw ------------------------------------------
     def submit(self, req: Request, arrival: float) -> None:
@@ -85,11 +130,29 @@ class Replica:
     def has_work(self) -> bool:
         raise NotImplementedError
 
+    @property
+    def occupancy(self) -> float:
+        raise NotImplementedError
+
     def readiness(self) -> dict:
         raise NotImplementedError
 
     # ---- readmission ---------------------------------------------
     def drain_unfinished(self, retire_seated: bool) -> list:
+        raise NotImplementedError
+
+    def drain_queued(self, n: int) -> list:
+        """Pop up to ``n`` queued (never seated) requests — the
+        fleet's hot-spot rebalance donor path."""
+        raise NotImplementedError
+
+    # ---- introspection -------------------------------------------
+    def debug_snapshot(self, timeline_tail: int = 20) -> dict:
+        raise NotImplementedError
+
+    def debug_row(self) -> dict:
+        """One ``/debug/engine`` fleet row: queue depth, the flight
+        ring tail, engine/pool stats, occupancy."""
         raise NotImplementedError
 
 
@@ -109,6 +172,35 @@ class InProcessReplica(Replica):
         self.replica_id = int(replica_id)
         self.batcher = batcher
         self.alive = True
+
+    # ---- lifecycle -----------------------------------------------
+    @property
+    def policy(self):
+        return self.batcher.policy
+
+    @property
+    def page_size(self) -> int:
+        return self.batcher.engine.page_size
+
+    @property
+    def clock(self):
+        return self.batcher.clock
+
+    @clock.setter
+    def clock(self, fn) -> None:
+        self.batcher.clock = fn
+
+    def start_session(self) -> None:
+        self.batcher.start_session()
+
+    def finish_session(self) -> dict:
+        return self.batcher.finish_session()
+
+    def check_fits(self, req: Request) -> None:
+        self.batcher._check_fits(req)
+
+    def set_tier_observer(self, fn) -> None:
+        self.batcher.engine.tables.on_tier_event = fn
 
     def submit(self, req: Request, arrival: float) -> None:
         self.batcher.submit(req, arrival=arrival)
@@ -139,6 +231,10 @@ class InProcessReplica(Replica):
     def has_work(self) -> bool:
         return self.batcher.has_work
 
+    @property
+    def occupancy(self) -> float:
+        return self.batcher.occupancy
+
     def readiness(self) -> dict:
         out = self.batcher.readiness()
         out["replica"] = self.replica_id
@@ -148,3 +244,31 @@ class InProcessReplica(Replica):
     def drain_unfinished(self, retire_seated: bool) -> list:
         return self.batcher.drain_unfinished(
             retire_seated=retire_seated)
+
+    def drain_queued(self, n: int) -> list:
+        return self.batcher.drain_queued(n)
+
+    # ---- introspection -------------------------------------------
+    def debug_snapshot(self, timeline_tail: int = 20) -> dict:
+        return self.batcher.debug_snapshot(
+            timeline_tail=timeline_tail)
+
+    def debug_row(self) -> dict:
+        flight = self.batcher.flight
+        row = {
+            "replica": self.replica_id,
+            "alive": self.alive,
+            "queue_depth": self.batcher.queue_depth if self.alive
+            else 0,
+            "flight": {
+                "n_recorded": flight.n_recorded,
+                "capacity": flight.capacity,
+                "records": flight.tail(32),
+                "anomalies": flight.anomaly_log(),
+            },
+        }
+        if self.alive:
+            # a DEAD replica's engine is not to be trusted: no stats
+            row["engine"] = self.batcher.engine.debug_stats()
+            row["occupancy"] = round(self.batcher.occupancy, 4)
+        return row
